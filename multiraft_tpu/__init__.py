@@ -1,0 +1,19 @@
+"""multiraft_tpu — a TPU-native multi-Raft framework.
+
+A ground-up rebuild of the capabilities of ``yusong-yan/MultiRaft`` (an
+MIT-6.824-style Go stack: simulated fault-injecting RPC network, complete
+Raft, linearizable KV, shard controller, sharded multi-group KV, porcupine
+linearizability checker) designed for JAX/XLA/Pallas:
+
+* ``sim``       — deterministic virtual-time event loop (the host runtime)
+* ``transport`` — fault-injecting network + codec (labrpc/labgob equiv)
+* ``raft``      — single-group event-driven Raft (the correctness oracle)
+* ``services``  — kvraft, shardctrler, shardkv replicated state machines
+* ``porcupine`` — linearizability checker + KV model + visualizer
+* ``engine``    — the batched TPU consensus engine: a jit tick function
+                  over ``(groups, peers)`` state tensors, Pallas kernels
+                  for quorum-commit/vote-tally hot ops
+* ``harness``   — test fixtures: partitions, crashes, churn drivers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,110 @@
+"""Unit tests for the virtual-time event scheduler."""
+
+import pytest
+
+from multiraft_tpu.sim.scheduler import (
+    TIMEOUT,
+    DeadlockError,
+    Future,
+    Scheduler,
+)
+
+
+def test_ordering_and_virtual_time():
+    s = Scheduler()
+    fired = []
+    s.call_after(0.5, fired.append, "b")
+    s.call_after(0.1, fired.append, "a")
+    s.call_after(0.9, fired.append, "c")
+    s.run_until(deadline=1.0)
+    assert fired == ["a", "b", "c"]
+    assert s.now == 1.0
+
+
+def test_same_time_fifo():
+    s = Scheduler()
+    fired = []
+    for i in range(10):
+        s.call_at(1.0, fired.append, i)
+    s.run_until(deadline=2.0)
+    assert fired == list(range(10))
+
+
+def test_timer_cancel():
+    s = Scheduler()
+    fired = []
+    t = s.call_after(0.1, fired.append, "x")
+    t.cancel()
+    s.run_until(deadline=1.0)
+    assert fired == []
+
+
+def test_run_for_partial():
+    s = Scheduler()
+    fired = []
+    s.call_after(1.0, fired.append, 1)
+    s.call_after(3.0, fired.append, 2)
+    s.run_for(2.0)
+    assert fired == [1] and s.now == 2.0
+    s.run_for(2.0)
+    assert fired == [1, 2] and s.now == 4.0
+
+
+def test_coroutine_sleep_and_return():
+    s = Scheduler()
+
+    def co():
+        yield 0.25
+        yield 0.25
+        return "done"
+
+    fut = s.spawn(co())
+    assert s.run_until(fut) == "done"
+    assert s.now == pytest.approx(0.5)
+
+
+def test_coroutine_waits_future():
+    s = Scheduler()
+    gate = Future()
+
+    def co():
+        v = yield gate
+        return v * 2
+
+    fut = s.spawn(co())
+    s.call_after(1.0, gate.resolve, 21)
+    assert s.run_until(fut) == 42
+
+
+def test_with_timeout_times_out_and_wins():
+    s = Scheduler()
+    slow, fast = Future(), Future()
+    t1 = s.with_timeout(slow, 0.1)
+    t2 = s.with_timeout(fast, 5.0)
+    s.call_after(1.0, slow.resolve, "late")
+    s.call_after(0.5, fast.resolve, "early")
+    s.run_until(deadline=2.0)
+    assert t1.value is TIMEOUT
+    assert not t1.value  # falsy, like a failed RPC
+    assert t2.value == "early"
+
+
+def test_deadlock_detection():
+    s = Scheduler()
+    never = Future()
+    with pytest.raises(DeadlockError):
+        s.run_until(never)
+
+
+def test_nested_coroutines():
+    s = Scheduler()
+
+    def inner():
+        yield 0.1
+        return 7
+
+    def outer():
+        v = yield s.spawn(inner())
+        return v + 1
+
+    assert s.run_until(s.spawn(outer())) == 8

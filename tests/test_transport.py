@@ -1,0 +1,256 @@
+"""Transport tests — port of the labrpc self-test suite
+(reference: labrpc/test_test.go, SURVEY §4.5)."""
+
+import dataclasses
+
+import pytest
+
+from multiraft_tpu.sim.scheduler import Scheduler
+from multiraft_tpu.transport import codec
+from multiraft_tpu.transport.network import Network, Server, Service
+
+
+@codec.registered
+@dataclasses.dataclass
+class JunkArgs:
+    x: int = 0
+
+
+@codec.registered
+@dataclasses.dataclass
+class JunkReply:
+    x: str = ""
+
+
+class JunkServer:
+    """Test service (reference: labrpc/test_test.go:21-67)."""
+
+    def __init__(self):
+        self.log1 = []
+        self.log2 = []
+
+    def handler1(self, args: str) -> int:
+        self.log1.append(args)
+        return len(self.log1) + len(args)
+
+    def handler2(self, args: int) -> str:
+        self.log2.append(args)
+        return f"handler2-{args}"
+
+    def handler3(self, args: int):
+        """Slow handler: 20 ms of virtual work — exercises coroutine
+        handlers (reference handler sleeps 20s; scaled)."""
+        yield 0.02
+        return -args
+
+    def handler4(self, args: JunkArgs) -> JunkReply:
+        return JunkReply(x="pointer")
+
+    def handler5(self, args: JunkArgs) -> JunkReply:
+        return JunkReply(x="no pointer")
+
+
+def make_net(seed=0):
+    sched = Scheduler()
+    net = Network(sched, seed=seed)
+    return sched, net
+
+
+def setup_basic(seed=0):
+    sched, net = make_net(seed)
+    js = JunkServer()
+    srv = Server()
+    srv.add_service(Service(js, name="JunkServer"))
+    net.add_server("server99", srv)
+    end = net.make_end("end1-99")
+    net.connect("end1-99", "server99")
+    net.enable("end1-99", True)
+    return sched, net, js, srv, end
+
+
+def test_basics():
+    sched, net, js, srv, end = setup_basic()
+    fut = end.call("JunkServer.handler2", 111)
+    reply = sched.run_until(fut)
+    assert reply == "handler2-111"
+    assert js.log2 == [111]
+
+
+def test_types():
+    sched, net, js, srv, end = setup_basic()
+    reply = sched.run_until(end.call("JunkServer.handler4", JunkArgs(x=5)))
+    assert reply == JunkReply(x="pointer")
+    reply = sched.run_until(end.call("JunkServer.handler5", JunkArgs()))
+    assert reply == JunkReply(x="no pointer")
+
+
+def test_disconnect():
+    """Calls to a disabled end fail; re-enabling restores service
+    (reference: labrpc/test_test.go:146-183)."""
+    sched, net, js, srv, end = setup_basic()
+    net.enable("end1-99", False)
+    reply = sched.run_until(end.call("JunkServer.handler2", 111))
+    assert reply is None
+    assert js.log2 == []
+    net.enable("end1-99", True)
+    reply = sched.run_until(end.call("JunkServer.handler1", "hello"))
+    assert reply == 6
+
+
+def test_counts():
+    """Per-server delivered-RPC counter (reference: labrpc/test_test.go:185)."""
+    sched, net, js, srv, end = setup_basic()
+    for i in range(17):
+        reply = sched.run_until(end.call("JunkServer.handler2", i))
+        assert reply == f"handler2-{i}"
+    assert net.get_count("server99") == 17
+    assert net.get_total_count() == 17
+
+
+def test_bytes():
+    """Byte counter scales with payload (reference: labrpc/test_test.go:221)."""
+    sched, net, js, srv, end = setup_basic()
+    for _ in range(17):
+        args = "x" * 4096
+        sched.run_until(end.call("JunkServer.handler1", args))
+    n = net.get_total_bytes()
+    assert 17 * 4096 <= n <= 17 * 4096 + 50_000
+
+
+def test_concurrent_many():
+    """20 concurrent clients × 5 calls each; all succeed and counters add
+    up (reference: labrpc/test_test.go:275-331)."""
+    sched, net = make_net()
+    js = JunkServer()
+    srv = Server()
+    srv.add_service(Service(js, name="JunkServer"))
+    net.add_server("big", srv)
+
+    nclients, nrpcs = 20, 5
+    results = []
+
+    def client(i):
+        name = f"end-{i}"
+        end = net.make_end(name)
+        net.connect(name, "big")
+        net.enable(name, True)
+        n = 0
+        for j in range(nrpcs):
+            arg = i * 100 + j
+            reply = yield end.call("JunkServer.handler2", arg)
+            assert reply == f"handler2-{arg}"
+            n += 1
+        return n
+
+    futs = [sched.spawn(client(i)) for i in range(nclients)]
+    for f in futs:
+        results.append(sched.run_until(f))
+    assert sum(results) == nclients * nrpcs
+    assert net.get_count("big") == nclients * nrpcs
+
+
+def test_unreliable_drops_some():
+    """In unreliable mode roughly 10%+10% of calls fail
+    (reference: labrpc/test_test.go:333-390)."""
+    sched, net = make_net(seed=7)
+    js = JunkServer()
+    srv = Server()
+    srv.add_service(Service(js, name="JunkServer"))
+    net.add_server("u", srv)
+    net.set_reliable(False)
+
+    total, ok = 300, 0
+    for i in range(total):
+        name = f"u-end-{i}"
+        end = net.make_end(name)
+        net.connect(name, "u")
+        net.enable(name, True)
+        reply = sched.run_until(end.call("JunkServer.handler2", i))
+        if reply is not None:
+            assert reply == f"handler2-{i}"
+            ok += 1
+    # ~81% expected (0.9 * 0.9); allow generous slack.
+    assert 0.6 * total < ok < total
+
+
+def test_slow_handler_coroutine():
+    sched, net, js, srv, end = setup_basic()
+    fut = end.call("JunkServer.handler3", 99)
+    reply = sched.run_until(fut)
+    assert reply == -99
+    assert sched.now >= 0.02
+
+
+def test_killed_reply_suppressed():
+    """A reply from a server deleted while the handler runs must be
+    suppressed (reference: labrpc/test_test.go:523-566 and the
+    DeleteServer race regression at :448)."""
+    sched, net, js, srv, end = setup_basic()
+    fut = end.call("JunkServer.handler3", 5)  # 20 ms handler
+    sched.call_after(0.01, net.delete_server, "server99")
+    reply = sched.run_until(fut)
+    assert reply is None
+
+
+def test_replaced_server_instance_suppresses_old_reply():
+    """Crash-and-restart: old instance's replies must not leak
+    (zombie-instance safety, reference: raft/config.go:113-142)."""
+    sched, net, js, srv, end = setup_basic()
+    fut = end.call("JunkServer.handler3", 5)
+
+    def replace():
+        srv2 = Server()
+        srv2.add_service(Service(JunkServer(), name="JunkServer"))
+        net.add_server("server99", srv2)
+
+    sched.call_after(0.01, replace)
+    assert sched.run_until(fut) is None
+    # New instance works.
+    assert sched.run_until(end.call("JunkServer.handler2", 1)) == "handler2-1"
+
+
+def test_unknown_server_times_out():
+    sched, net = make_net()
+    end = net.make_end("lost")
+    net.connect("lost", "nonexistent")
+    net.enable("lost", True)
+    t0 = sched.now
+    assert sched.run_until(end.call("JunkServer.handler2", 1)) is None
+    assert sched.now - t0 <= 0.1
+
+
+def test_long_delays_timeout():
+    sched, net = make_net(seed=3)
+    net.set_long_delays(True)
+    end = net.make_end("ld")
+    net.connect("ld", "nonexistent")
+    net.enable("ld", True)
+    times = []
+    for _ in range(20):
+        t0 = sched.now
+        assert sched.run_until(end.call("X.y", 1)) is None
+        times.append(sched.now - t0)
+    assert max(times) > 1.0  # long-delay mode: up to 7 s
+
+
+def test_long_reordering_delays_replies():
+    sched, net, js, srv, end = setup_basic(seed=11)
+    net.set_long_reordering(True)
+    delays = []
+    for i in range(30):
+        t0 = sched.now
+        assert sched.run_until(end.call("JunkServer.handler2", i)) is not None
+        delays.append(sched.now - t0)
+    assert max(delays) > 0.2  # some replies delayed 200-2400 ms
+    assert min(delays) < 0.01  # and some fast
+
+def test_throughput():
+    """10k serial RPCs complete; virtual latency stays tiny
+    (reference: labrpc/test_test.go:568-597 — 22 µs/RPC on 2016 hardware)."""
+    sched, net, js, srv, end = setup_basic()
+    n = 10_000
+    t0 = sched.now
+    for i in range(n):
+        sched.run_until(end.call("JunkServer.handler2", i))
+    per_rpc = (sched.now - t0) / n
+    assert per_rpc < 100e-6  # virtual 22 µs-ish per RPC
