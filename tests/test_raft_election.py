@@ -1,0 +1,81 @@
+"""Raft 2A election tests (reference: raft/test_test.go:24-127)."""
+
+import pytest
+
+from multiraft_tpu.harness.raft_harness import RaftHarness
+from multiraft_tpu.raft.node import ELECTION_TIMEOUT
+
+
+def test_initial_election():
+    """(reference: raft/test_test.go:24-53)"""
+    cfg = RaftHarness(3, seed=1)
+    cfg.check_one_leader()
+    cfg.sched.run_for(0.05)
+    term1 = cfg.check_terms()
+    assert term1 >= 1
+    # Term should stay stable if there's no failure.
+    cfg.sched.run_for(2 * ELECTION_TIMEOUT[1])
+    term2 = cfg.check_terms()
+    assert term1 == term2
+    cfg.check_one_leader()
+    cfg.cleanup()
+
+
+def test_reelection():
+    """(reference: raft/test_test.go:55-93)"""
+    cfg = RaftHarness(3, seed=2)
+    leader1 = cfg.check_one_leader()
+
+    # Leader disconnects: a new one appears.
+    cfg.disconnect(leader1)
+    cfg.check_one_leader()
+
+    # Old leader rejoins: no disturbance to the new leader.
+    cfg.connect(leader1)
+    leader2 = cfg.check_one_leader()
+
+    # No quorum: no leader.
+    cfg.disconnect(leader2)
+    cfg.disconnect((leader2 + 1) % 3)
+    cfg.sched.run_for(2 * ELECTION_TIMEOUT[1])
+    cfg.check_no_leader()
+
+    # Quorum restored.
+    cfg.connect((leader2 + 1) % 3)
+    cfg.check_one_leader()
+
+    # Everyone back.
+    cfg.connect(leader2)
+    cfg.check_one_leader()
+    cfg.cleanup()
+
+
+def test_many_elections():
+    """7 servers, repeated random 3-server disconnects
+    (reference: raft/test_test.go:95-127)."""
+    cfg = RaftHarness(7, seed=3)
+    cfg.check_one_leader()
+    for it in range(10):
+        i1 = cfg.rng.randrange(7)
+        i2 = cfg.rng.randrange(7)
+        i3 = cfg.rng.randrange(7)
+        cfg.disconnect(i1)
+        cfg.disconnect(i2)
+        cfg.disconnect(i3)
+        # Either the current leader survives, or a quorum elects a new one.
+        cfg.check_one_leader()
+        cfg.connect(i1)
+        cfg.connect(i2)
+        cfg.connect(i3)
+    cfg.check_one_leader()
+    cfg.cleanup()
+
+
+def test_terms_monotonic_per_server():
+    cfg = RaftHarness(3, seed=4)
+    cfg.check_one_leader()
+    terms = [r.current_term for r in cfg.rafts]
+    cfg.sched.run_for(1.0)
+    for r, t0 in zip(cfg.rafts, terms):
+        assert r.current_term >= t0
+    cfg.cleanup()

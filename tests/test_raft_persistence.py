@@ -1,0 +1,230 @@
+"""Raft 2C persistence tests (reference: raft/test_test.go:685-1107).
+
+The Figure-8 and churn iteration counts are scaled down from the
+reference's 1000 (wall-clock-bound in Go, event-bound here); the
+scenario structure is identical.
+"""
+
+import pytest
+
+from multiraft_tpu.harness.raft_harness import RaftHarness
+from multiraft_tpu.raft.node import ELECTION_TIMEOUT
+
+
+def test_persist1():
+    """Crash/restart permutations (reference: raft/test_test.go:685-729)."""
+    cfg = RaftHarness(3, seed=20)
+    cfg.one(11, 3, retry=True)
+
+    # Crash and re-start all.
+    for i in range(3):
+        cfg.start1(i)
+    for i in range(3):
+        cfg.disconnect(i)
+        cfg.connect(i)
+    cfg.one(12, 3, retry=True)
+
+    leader1 = cfg.check_one_leader()
+    cfg.disconnect(leader1)
+    cfg.start1(leader1)
+    cfg.connect(leader1)
+    cfg.one(13, 3, retry=True)
+
+    leader2 = cfg.check_one_leader()
+    cfg.disconnect(leader2)
+    cfg.one(14, 2, retry=True)
+    cfg.start1(leader2)
+    cfg.connect(leader2)
+    cfg.wait(4, 3, -1)  # wait for leader2 to join
+
+    i3 = (cfg.check_one_leader() + 1) % 3
+    cfg.disconnect(i3)
+    cfg.one(15, 2, retry=True)
+    cfg.start1(i3)
+    cfg.connect(i3)
+    cfg.one(16, 3, retry=True)
+    cfg.cleanup()
+
+
+def test_persist2():
+    """More persistence with rolling partitions + crashes
+    (reference: raft/test_test.go:731-775)."""
+    cfg = RaftHarness(5, seed=21)
+    index = 1
+    for _ in range(5):
+        cfg.one(10 + index, 5, retry=True)
+        index += 1
+        leader1 = cfg.check_one_leader()
+
+        cfg.disconnect((leader1 + 1) % 5)
+        cfg.disconnect((leader1 + 2) % 5)
+        cfg.one(10 + index, 3, retry=True)
+        index += 1
+
+        cfg.disconnect((leader1 + 0) % 5)
+        cfg.disconnect((leader1 + 3) % 5)
+        cfg.disconnect((leader1 + 4) % 5)
+
+        cfg.start1((leader1 + 1) % 5)
+        cfg.start1((leader1 + 2) % 5)
+        cfg.connect((leader1 + 1) % 5)
+        cfg.connect((leader1 + 2) % 5)
+        cfg.sched.run_for(ELECTION_TIMEOUT[1])
+        cfg.start1((leader1 + 3) % 5)
+        cfg.connect((leader1 + 3) % 5)
+        cfg.one(10 + index, 3, retry=True)
+        index += 1
+        cfg.connect((leader1 + 4) % 5)
+        cfg.connect((leader1 + 0) % 5)
+    cfg.one(1000, 5, retry=True)
+    cfg.cleanup()
+
+
+def test_persist3():
+    """Partitioned leader and one follower crash; leader restarts
+    (reference: raft/test_test.go:777-815)."""
+    cfg = RaftHarness(3, seed=22)
+    cfg.one(101, 3, retry=True)
+    leader = cfg.check_one_leader()
+    cfg.disconnect((leader + 2) % 3)
+    cfg.one(102, 2, retry=True)
+    cfg.crash1((leader + 0) % 3)
+    cfg.connect((leader + 2) % 3)
+    cfg.one(103, 2, retry=True)
+    cfg.start1((leader + 0) % 3)
+    cfg.connect((leader + 0) % 3)
+    cfg.one(104, 3, retry=True)
+    cfg.cleanup()
+
+
+def _figure8(unreliable: bool, iters: int, seed: int) -> None:
+    """Raft paper Figure 8 safety scenario
+    (reference: raft/test_test.go:817-871,:902-955)."""
+    cfg = RaftHarness(5, unreliable=unreliable, seed=seed)
+    if unreliable:
+        cfg.net.set_long_reordering(True)
+    rng = cfg.rng
+    cfg.one(rng.randrange(1 << 30), 1, retry=True)
+
+    nup = 5
+    for it in range(iters):
+        leader = -1
+        for i in range(5):
+            if cfg.rafts[i] is not None:
+                _, _, ok = cfg.rafts[i].start(rng.randrange(1 << 30))
+                if ok and cfg.connected[i]:
+                    leader = i
+        if rng.randrange(1000) < 100:
+            cfg.sched.run_for(rng.uniform(0, ELECTION_TIMEOUT[0] / 2))
+        else:
+            cfg.sched.run_for(rng.uniform(0, 0.013))
+        if leader != -1 and (rng.randrange(1000) < 500 or not unreliable):
+            cfg.crash1(leader)
+            nup -= 1
+        if nup < 3:
+            s = rng.randrange(5)
+            if cfg.rafts[s] is None:
+                cfg.start1(s)
+                cfg.connect(s)
+                nup += 1
+    for i in range(5):
+        if cfg.rafts[i] is None:
+            cfg.start1(i)
+            cfg.connect(i)
+        elif not cfg.connected[i]:
+            cfg.connect(i)
+    cfg.one(rng.randrange(1 << 30), 5, retry=True)
+    cfg.cleanup()
+
+
+def test_figure8():
+    _figure8(unreliable=False, iters=60, seed=23)
+
+
+def test_figure8_unreliable():
+    _figure8(unreliable=True, iters=60, seed=24)
+
+
+def test_unreliable_agree():
+    """Agreement over an unreliable network
+    (reference: raft/test_test.go:873-900)."""
+    cfg = RaftHarness(5, unreliable=True, seed=25)
+    for iters in range(1, 20):
+        for j in range(4):
+            cfg.one((100 * iters) + j, 1, retry=True)
+        cfg.one(iters, 1, retry=True)
+    cfg.net.set_reliable(True)
+    cfg.sched.run_for(1.0)
+    cfg.one(100, 5, retry=True)
+    cfg.cleanup()
+
+
+def _churn(unreliable: bool, seed: int) -> None:
+    """Concurrent clients + crash/restart/partition churn
+    (reference: raft/test_test.go:957-1107)."""
+    cfg = RaftHarness(5, unreliable=unreliable, seed=seed)
+    rng = cfg.rng
+    stop = [False]
+
+    def client(me: int):
+        values = []
+        while not stop[0]:
+            x = rng.randrange(1 << 30)
+            index = -1
+            # Try all servers, like the reference's cfg loop.
+            for i in range(5):
+                rf = cfg.rafts[i]
+                if rf is not None:
+                    ix, _, ok = rf.start(x)
+                    if ok:
+                        index = ix
+                        break
+            if index != -1:
+                values.append((index, x))
+            yield rng.uniform(0.01, 0.09)
+        return values
+
+    clients = [cfg.sched.spawn(client(i)) for i in range(3)]
+
+    # Churn driver: random disconnects, crashes, restarts.
+    t_end = cfg.sched.now + 7.0
+    while cfg.sched.now < t_end:
+        action = rng.randrange(4)
+        victim = rng.randrange(5)
+        if action == 0 and cfg.connected[victim]:
+            cfg.disconnect(victim)
+        elif action == 1 and cfg.rafts[victim] is not None:
+            if not cfg.connected[victim]:
+                cfg.connect(victim)
+        elif action == 2 and cfg.rafts[victim] is not None:
+            cfg.crash1(victim)
+        elif action == 3 and cfg.rafts[victim] is None:
+            cfg.start1(victim)
+            cfg.connect(victim)
+        cfg.sched.run_for(rng.uniform(0.05, 0.2))
+
+    # Heal everything.
+    for i in range(5):
+        if cfg.rafts[i] is None:
+            cfg.start1(i)
+        cfg.connect(i)
+    if unreliable:
+        cfg.net.set_reliable(True)
+    stop[0] = True
+    cfg.sched.run_for(0.5)
+    for c in clients:
+        assert c.done
+
+    # Final agreement proves the cluster recovered; the invariant
+    # appliers have been checking safety throughout.
+    lastidx = cfg.one(rng.randrange(1 << 30), 5, retry=True)
+    assert lastidx > 0
+    cfg.cleanup()
+
+
+def test_reliable_churn():
+    _churn(unreliable=False, seed=26)
+
+
+def test_unreliable_churn():
+    _churn(unreliable=True, seed=27)

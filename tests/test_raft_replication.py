@@ -1,0 +1,255 @@
+"""Raft 2B replication tests (reference: raft/test_test.go:128-683)."""
+
+import pytest
+
+from multiraft_tpu.harness.raft_harness import HarnessError, RaftHarness
+from multiraft_tpu.raft.node import ELECTION_TIMEOUT
+
+
+def test_basic_agree():
+    """(reference: raft/test_test.go:128-153)"""
+    cfg = RaftHarness(3, seed=10)
+    for index in range(1, 4):
+        nd, _ = cfg.n_committed(index)
+        assert nd == 0, "some have committed before start()"
+        xindex = cfg.one(index * 100, 3, retry=False)
+        assert xindex == index
+    cfg.cleanup()
+
+
+def test_rpc_bytes():
+    """Byte overhead gate: ≤ 3×payload + 50 KB for 10 × 5 KB commands
+    (reference: raft/test_test.go:155-187)."""
+    cfg = RaftHarness(3, seed=11)
+    cfg.one(99, 3, retry=False)
+    bytes0 = cfg.bytes_total()
+    sent = 0
+    for index in range(2, 12):
+        cmd = "x" * 5000
+        xindex = cfg.one(cmd, 3, retry=False)
+        assert xindex == index
+        sent += len(cmd)
+    got = cfg.bytes_total() - bytes0
+    expected = 3 * sent  # each server must receive it once; allow 3x
+    assert got <= expected + 50_000, f"too many RPC bytes: {got} > {expected + 50000}"
+    cfg.cleanup()
+
+
+def test_fail_agree():
+    """Agreement despite a disconnected follower, which then catches up
+    (reference: raft/test_test.go:279-311)."""
+    cfg = RaftHarness(3, seed=12)
+    cfg.one(101, 3, retry=False)
+    leader = cfg.check_one_leader()
+    cfg.disconnect((leader + 1) % 3)
+
+    cfg.one(102, 2, retry=False)
+    cfg.one(103, 2, retry=False)
+    cfg.sched.run_for(ELECTION_TIMEOUT[1])
+    cfg.one(104, 2, retry=False)
+    cfg.one(105, 2, retry=False)
+
+    cfg.connect((leader + 1) % 3)
+    cfg.one(106, 3, retry=True)
+    cfg.sched.run_for(ELECTION_TIMEOUT[1])
+    cfg.one(107, 3, retry=True)
+    cfg.cleanup()
+
+
+def test_fail_no_agree():
+    """No agreement without a quorum; no double-commit at the same index
+    after the partition heals (reference: raft/test_test.go:313-362)."""
+    cfg = RaftHarness(5, seed=13)
+    cfg.one(10, 5, retry=False)
+
+    leader = cfg.check_one_leader()
+    cfg.disconnect((leader + 1) % 5)
+    cfg.disconnect((leader + 2) % 5)
+    cfg.disconnect((leader + 3) % 5)
+
+    index, _, ok = cfg.rafts[leader].start(20)
+    assert ok, "leader rejected start()"
+    assert index == 2, f"expected index 2, got {index}"
+    cfg.sched.run_for(2 * ELECTION_TIMEOUT[1])
+    nd, _ = cfg.n_committed(index)
+    assert nd == 0, f"{nd} committed but no majority"
+
+    cfg.connect((leader + 1) % 5)
+    cfg.connect((leader + 2) % 5)
+    cfg.connect((leader + 3) % 5)
+
+    leader2 = cfg.check_one_leader()
+    index2, _, ok2 = cfg.rafts[leader2].start(30)
+    assert ok2, "leader2 rejected start()"
+    assert 2 <= index2 <= 3, f"unexpected index {index2}"
+    cfg.one(1000, 5, retry=True)
+    cfg.cleanup()
+
+
+def test_concurrent_starts():
+    """Concurrent Start()s in one term all commit
+    (reference: raft/test_test.go:364-463)."""
+    cfg = RaftHarness(3, seed=14)
+    success = False
+    for attempt in range(5):
+        leader = cfg.check_one_leader()
+        term, is_leader = cfg.rafts[leader].get_state()
+        if not is_leader:
+            continue
+        results = []
+        for i in range(5):
+            ix, tm, ok = cfg.rafts[leader].start(100 + i)
+            if ok and tm == term:
+                results.append((i, ix))
+        if len(results) != 5:
+            continue  # term moved; retry
+        cfg.sched.run_for(1.0)
+        values = []
+        for i, ix in results:
+            cmd = cfg.wait(ix, 3, term)
+            if cmd == -1:
+                break
+            values.append(cmd)
+        else:
+            for i in range(5):
+                assert (100 + i) in values, f"cmd {100+i} missing from {values}"
+            success = True
+            break
+    assert success, "term changed too often"
+    cfg.cleanup()
+
+
+def test_rejoin():
+    """Partitioned leader with divergent uncommitted entries rejoins
+    safely (reference: raft/test_test.go:465-501)."""
+    cfg = RaftHarness(3, seed=15)
+    cfg.one(101, 3, retry=True)
+
+    leader1 = cfg.check_one_leader()
+    cfg.disconnect(leader1)
+
+    # Old leader appends entries that can never commit.
+    cfg.rafts[leader1].start(102)
+    cfg.rafts[leader1].start(103)
+    cfg.rafts[leader1].start(104)
+
+    # New leader commits at index 2.
+    cfg.one(103, 2, retry=True)
+
+    # New leader network failure; old leader connected.
+    leader2 = cfg.check_one_leader()
+    cfg.disconnect(leader2)
+    cfg.connect(leader1)
+    cfg.one(104, 2, retry=True)
+
+    cfg.connect(leader2)
+    cfg.one(105, 3, retry=True)
+    cfg.cleanup()
+
+
+def test_backup():
+    """Fast log backup over 50+50+50 divergent entries
+    (reference: raft/test_test.go:503-573)."""
+    cfg = RaftHarness(5, seed=16)
+    rng = cfg.rng
+    cfg.one(rng.randrange(1 << 30), 5, retry=True)
+
+    # Put leader and one follower in a partition.
+    leader1 = cfg.check_one_leader()
+    cfg.disconnect((leader1 + 2) % 5)
+    cfg.disconnect((leader1 + 3) % 5)
+    cfg.disconnect((leader1 + 4) % 5)
+
+    # Lots of commands that won't commit.
+    for _ in range(50):
+        cfg.rafts[leader1].start(rng.randrange(1 << 30))
+    cfg.sched.run_for(ELECTION_TIMEOUT[0] / 2)
+
+    cfg.disconnect((leader1 + 0) % 5)
+    cfg.disconnect((leader1 + 1) % 5)
+
+    # Allow the other partition to recover and commit 50.
+    cfg.connect((leader1 + 2) % 5)
+    cfg.connect((leader1 + 3) % 5)
+    cfg.connect((leader1 + 4) % 5)
+    for _ in range(50):
+        cfg.one(rng.randrange(1 << 30), 3, retry=True)
+
+    # Now another partitioned leader and one follower.
+    leader2 = cfg.check_one_leader()
+    other = (leader1 + 2) % 5
+    if leader2 == other:
+        other = (leader2 + 1) % 5
+    cfg.disconnect(other)
+
+    # 50 more that won't commit.
+    for _ in range(50):
+        cfg.rafts[leader2].start(rng.randrange(1 << 30))
+    cfg.sched.run_for(ELECTION_TIMEOUT[0] / 2)
+
+    # Bring original leader back to life.
+    for i in range(5):
+        cfg.disconnect(i)
+    cfg.connect((leader1 + 0) % 5)
+    cfg.connect((leader1 + 1) % 5)
+    cfg.connect(other)
+
+    for _ in range(50):
+        cfg.one(rng.randrange(1 << 30), 3, retry=True)
+
+    for i in range(5):
+        cfg.connect(i)
+    cfg.one(rng.randrange(1 << 30), 5, retry=True)
+    cfg.cleanup()
+
+
+def test_rpc_counts():
+    """RPC budgets: ≤30 to elect, ≤42 to agree on 10 entries, ≤20/s idle
+    (reference: raft/test_test.go:575-683)."""
+    cfg = RaftHarness(3, seed=17)
+    cfg.check_one_leader()
+    total1 = cfg.rpc_total()
+    assert 1 <= total1 <= 30, f"too many RPCs ({total1}) to elect a leader"
+
+    success = False
+    for attempt in range(5):
+        if attempt > 0:
+            cfg.sched.run_for(3.0)  # give solution some time to settle
+        leader = cfg.check_one_leader()
+        total1 = cfg.rpc_total()
+        iters = 10
+        starti, term, ok = cfg.rafts[leader].start(1)
+        if not ok:
+            continue
+        cmds = []
+        failed = False
+        for i in range(1, iters + 2):
+            x = cfg.rng.randrange(1 << 30)
+            cmds.append(x)
+            index1, term1, ok = cfg.rafts[leader].start(x)
+            if term1 != term or not ok:
+                failed = True  # term changed mid-iteration; retry
+                break
+            assert starti + i == index1, "Start() gave wrong index"
+        if failed:
+            continue
+        for i in range(1, iters + 1):
+            cmd = cfg.wait(starti + i, 3, term)
+            if cmd == -1:
+                failed = True
+                break
+            assert cmd == cmds[i - 1], f"wrong value {cmd} committed"
+        if failed:
+            continue
+        total2 = cfg.rpc_total() - total1
+        assert total2 <= (iters + 1 + 3) * 3, f"too many RPCs ({total2}) for agreement"
+        success = True
+        break
+    assert success, "term changed too often"
+
+    cfg.sched.run_for(1.0)
+    total3 = cfg.rpc_total()
+    cfg.sched.run_for(1.0)
+    idle = cfg.rpc_total() - total3
+    assert idle <= 3 * 20, f"too many RPCs ({idle}) for 1 second of idleness"
+    cfg.cleanup()

@@ -1,0 +1,138 @@
+"""Raft 2D snapshot tests (reference: raft/test_test.go:1110-1295).
+
+``snapcommon`` reproduces the reference's {disconnect, reliable, crash}
+matrix with the MAXLOGSIZE gate; the harness applier snapshots every
+SNAPSHOT_INTERVAL applies (reference: raft/config.go:215-274).
+"""
+
+import pytest
+
+from multiraft_tpu.harness.raft_harness import (
+    MAX_LOG_SIZE,
+    RaftHarness,
+    SNAPSHOT_INTERVAL,
+)
+
+
+def _snapcommon(
+    disconnect: bool, reliable: bool, crash: bool, seed: int, iters: int = 12
+) -> None:
+    """(reference: raft/test_test.go:1110-1195)"""
+    cfg = RaftHarness(3, unreliable=not reliable, snapshot=True, seed=seed)
+    rng = cfg.rng
+    cfg.one(rng.randrange(1 << 30), 3, retry=True)
+    leader1 = cfg.check_one_leader()
+
+    for i in range(iters):
+        victim = (leader1 + 1) % 3
+        sender = leader1
+        if i % 3 == 1:
+            sender = (leader1 + 1) % 3
+            victim = leader1
+
+        if disconnect:
+            cfg.disconnect(victim)
+            cfg.one(rng.randrange(1 << 30), 2, retry=True)
+        if crash:
+            cfg.crash1(victim)
+            cfg.one(rng.randrange(1 << 30), 2, retry=True)
+
+        # Perhaps send enough to get a snapshot.
+        nn = SNAPSHOT_INTERVAL // 2 + rng.randrange(SNAPSHOT_INTERVAL)
+        for _ in range(nn):
+            rf = cfg.rafts[sender]
+            if rf is not None:
+                rf.start(rng.randrange(1 << 30))
+
+        # Let applier threads catch up with the Start()'s.
+        if not disconnect and not crash:
+            # Make sure all followers have caught up.
+            cfg.one(rng.randrange(1 << 30), 3, retry=True)
+        else:
+            cfg.one(rng.randrange(1 << 30), 2, retry=True)
+
+        if cfg.log_size() >= MAX_LOG_SIZE:
+            raise AssertionError(
+                f"log size too large: {cfg.log_size()} >= {MAX_LOG_SIZE}"
+            )
+        if disconnect:
+            cfg.connect(victim)
+            cfg.one(rng.randrange(1 << 30), 3, retry=True)
+            leader1 = cfg.check_one_leader()
+        if crash:
+            cfg.start1(victim)
+            cfg.connect(victim)
+            cfg.one(rng.randrange(1 << 30), 3, retry=True)
+            leader1 = cfg.check_one_leader()
+    cfg.cleanup()
+
+
+def test_snapshot_basic():
+    """(reference: TestSnapshotBasic2D)"""
+    _snapcommon(disconnect=False, reliable=True, crash=False, seed=30)
+
+
+def test_snapshot_install():
+    """Disconnected follower falls behind the leader's snapshot and
+    must be caught up via InstallSnapshot
+    (reference: TestSnapshotInstall2D)."""
+    _snapcommon(disconnect=True, reliable=True, crash=False, seed=31)
+
+
+def test_snapshot_install_unreliable():
+    """(reference: TestSnapshotInstallUnreliable2D)"""
+    _snapcommon(disconnect=True, reliable=False, crash=False, seed=32)
+
+
+def test_snapshot_install_crash():
+    """(reference: TestSnapshotInstallCrash2D)"""
+    _snapcommon(disconnect=False, reliable=True, crash=True, seed=33)
+
+
+def test_snapshot_install_unreliable_crash():
+    """(reference: TestSnapshotInstallUnCrash2D)"""
+    _snapcommon(disconnect=False, reliable=False, crash=True, seed=34)
+
+
+def test_snapshot_all_crash():
+    """All servers crash and restart from snapshot
+    (reference: TestSnapshotAllCrash2D, raft/test_test.go:1202-1244)."""
+    cfg = RaftHarness(3, snapshot=True, seed=35)
+    rng = cfg.rng
+    cfg.one(rng.randrange(1 << 30), 3, retry=True)
+
+    for _ in range(5):
+        # Enough ops to definitely trigger snapshots.
+        nn = SNAPSHOT_INTERVAL // 2 + rng.randrange(SNAPSHOT_INTERVAL)
+        for _ in range(nn):
+            cfg.one(rng.randrange(1 << 30), 3, retry=True)
+        index1 = cfg.one(rng.randrange(1 << 30), 3, retry=True)
+
+        # Crash all.
+        for i in range(3):
+            cfg.crash1(i)
+        # Revive all.
+        for i in range(3):
+            cfg.start1(i)
+            cfg.connect(i)
+
+        index2 = cfg.one(rng.randrange(1 << 30), 3, retry=True)
+        assert index2 >= index1 + 1, f"index decreased: {index2} < {index1 + 1}"
+    cfg.cleanup()
+
+
+def test_snapshot_state_survives_restart():
+    """A restarted node recovers commit state from the snapshot pair
+    without replaying from index 1."""
+    cfg = RaftHarness(3, snapshot=True, seed=36)
+    for i in range(25):
+        cfg.one(1000 + i, 3, retry=True)
+    # All nodes should have compacted: raft state stays small.
+    assert cfg.log_size() < MAX_LOG_SIZE
+    cfg.crash1(0)
+    cfg.start1(0)
+    cfg.connect(0)
+    cfg.one(9999, 3, retry=True)
+    # Restarted node's log must not extend back to index 1.
+    assert cfg.rafts[0].log.base > 0
+    cfg.cleanup()
