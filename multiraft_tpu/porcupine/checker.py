@@ -1,0 +1,167 @@
+"""Wing–Gong / Lowe linearizability checker
+(reference: porcupine/checker.go:140-353, porcupine/bitset.go).
+
+The algorithm: order call/return events by time into a doubly-linked
+list; DFS over "linearize next" choices among currently-pending calls,
+memoizing (linearized-set, automaton-state) pairs so revisited frontiers
+prune (reference: porcupine/checker.go:140-152 cache,
+:159-177 lift/unlift).  Per-partition histories are checked
+independently with a shared kill switch
+(reference: porcupine/checker.go:274-353 checkParallel).
+
+The linearized set is a Python int bitmask (arbitrary width — the
+bitset.go equivalent); a C++ fast path for the DFS lives in
+``multiraft_tpu/porcupine/native`` with this implementation as fallback
+and oracle.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, List, Optional, Tuple
+
+from .model import CheckResult, Model, Operation
+
+__all__ = ["check_operations", "check_history"]
+
+
+class _Entry:
+    __slots__ = ("op_id", "inp", "out", "is_return", "match", "prev", "next")
+
+    def __init__(self, op_id: int, inp: Any, out: Any, is_return: bool) -> None:
+        self.op_id = op_id
+        self.inp = inp
+        self.out = out
+        self.is_return = is_return
+        self.match: Optional[_Entry] = None  # return entry, on calls
+        self.prev: Optional[_Entry] = None
+        self.next: Optional[_Entry] = None
+
+
+def _make_entries(history: List[Operation]) -> _Entry:
+    """Build the time-ordered doubly-linked entry list; returns a dummy
+    head.  Ties order calls before returns, so operations touching at a
+    single instant count as concurrent (permissive, deterministic)."""
+    events: List[Tuple[float, int, int, Operation]] = []
+    for i, op in enumerate(history):
+        if op.ret < op.call:
+            raise ValueError(f"operation {i} returns before it calls")
+        events.append((op.call, 0, i, op))
+        events.append((op.ret, 1, i, op))
+    events.sort(key=lambda e: (e[0], e[1]))
+
+    head = _Entry(-1, None, None, False)
+    tail = head
+    calls: dict[int, _Entry] = {}
+    for t, kind, i, op in events:
+        if kind == 0:
+            e = _Entry(i, op.input, op.output, is_return=False)
+            calls[i] = e
+        else:
+            e = _Entry(i, op.input, op.output, is_return=True)
+            calls[i].match = e
+        tail.next = e
+        e.prev = tail
+        tail = e
+    return head
+
+
+def _lift(call: _Entry) -> None:
+    """Remove a call and its return from the list
+    (reference: porcupine/checker.go:159-168)."""
+    ret = call.match
+    call.prev.next = call.next
+    if call.next is not None:
+        call.next.prev = call.prev
+    ret.prev.next = ret.next
+    if ret.next is not None:
+        ret.next.prev = ret.prev
+
+
+def _unlift(call: _Entry) -> None:
+    """Reinsert a lifted call/return pair
+    (reference: porcupine/checker.go:170-177)."""
+    ret = call.match
+    ret.prev.next = ret
+    if ret.next is not None:
+        ret.next.prev = ret
+    call.prev.next = call
+    if call.next is not None:
+        call.next.prev = call
+
+
+def _check_single(
+    model: Model,
+    history: List[Operation],
+    deadline: Optional[float],
+) -> CheckResult:
+    """DFS over one partition (reference: porcupine/checker.go:179-253)."""
+    if not history:
+        return CheckResult.OK
+    head = _make_entries(history)
+    n = len(history)
+    linearized = 0
+    cache: set = set()
+    calls: List[Tuple[_Entry, Any]] = []
+    state = model.init()
+    entry = head.next
+    steps = 0
+    while head.next is not None:
+        steps += 1
+        if deadline is not None and steps % 4096 == 0:
+            if _time.monotonic() > deadline:
+                return CheckResult.UNKNOWN
+        if not entry.is_return:
+            ok, new_state = model.step(state, entry.inp, entry.out)
+            advanced = False
+            if ok:
+                new_linearized = linearized | (1 << entry.op_id)
+                key = (new_linearized, model.key_of(new_state))
+                if key not in cache:
+                    cache.add(key)
+                    calls.append((entry, state))
+                    state = new_state
+                    linearized = new_linearized
+                    _lift(entry)
+                    entry = head.next
+                    advanced = True
+            if not advanced:
+                entry = entry.next
+        else:
+            # A return with no linearizable choice above it: backtrack
+            # (reference: porcupine/checker.go:231-246).
+            if not calls:
+                return CheckResult.ILLEGAL
+            top, state = calls.pop()
+            linearized &= ~(1 << top.op_id)
+            _unlift(top)
+            entry = top.next
+    return CheckResult.OK
+
+
+def check_operations(
+    model: Model,
+    history: List[Operation],
+    timeout: Optional[float] = None,
+) -> CheckResult:
+    """Check a full history, partitioned per the model
+    (reference: porcupine/porcupine.go CheckOperationsTimeout).
+
+    ``timeout`` is wall-clock seconds across all partitions; on expiry
+    the result is UNKNOWN (the reference's convention, treated by the
+    test suite as "probably fine, too expensive to prove",
+    kvraft/test_test.go:379-381)."""
+    deadline = _time.monotonic() + timeout if timeout is not None else None
+    unknown = False
+    for part in model.partitions(history):
+        res = _check_single(model, part, deadline)
+        if res is CheckResult.ILLEGAL:
+            return CheckResult.ILLEGAL
+        if res is CheckResult.UNKNOWN:
+            unknown = True
+    return CheckResult.UNKNOWN if unknown else CheckResult.OK
+
+
+def check_history(model: Model, history: List[Operation]) -> bool:
+    """Convenience: True iff linearizable (UNKNOWN counts as True)."""
+    return check_operations(model, history) is not CheckResult.ILLEGAL

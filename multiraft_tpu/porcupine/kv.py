@@ -1,0 +1,74 @@
+"""KV specification model for porcupine (reference: models/kv.go:17-69).
+
+Partitioned by key (reference: models/kv.go:18-34); state per partition
+is just the key's current string value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Any, List
+
+from ..transport import codec
+from .model import Model, Operation
+
+__all__ = ["KvInput", "KvOutput", "kv_model", "OP_GET", "OP_PUT", "OP_APPEND"]
+
+OP_GET = 0
+OP_PUT = 1
+OP_APPEND = 2
+
+_OP_NAMES = {OP_GET: "get", OP_PUT: "put", OP_APPEND: "append"}
+
+
+@codec.registered
+@dataclasses.dataclass(frozen=True)
+class KvInput:
+    op: int = OP_GET
+    key: str = ""
+    value: str = ""
+
+
+@codec.registered
+@dataclasses.dataclass(frozen=True)
+class KvOutput:
+    value: str = ""
+
+
+def _partition(history: List[Operation]) -> List[List[Operation]]:
+    by_key: dict = defaultdict(list)
+    for op in history:
+        by_key[op.input.key].append(op)
+    return list(by_key.values())
+
+
+def _init() -> str:
+    return ""
+
+
+def _step(state: str, inp: KvInput, out: KvOutput):
+    """(reference: models/kv.go:40-54)"""
+    if inp.op == OP_GET:
+        return out.value == state, state
+    if inp.op == OP_PUT:
+        return True, inp.value
+    return True, state + inp.value  # append
+
+
+def _describe(inp: KvInput, out: KvOutput) -> str:
+    """(reference: models/kv.go:55-68)"""
+    name = _OP_NAMES.get(inp.op, "?")
+    if inp.op == OP_GET:
+        return f"get('{inp.key}') -> '{out.value}'"
+    if inp.op == OP_PUT:
+        return f"put('{inp.key}', '{inp.value}')"
+    return f"append('{inp.key}', '{inp.value}')"
+
+
+kv_model = Model(
+    init=_init,
+    step=_step,
+    partition=_partition,
+    describe_operation=_describe,
+)

@@ -1,0 +1,61 @@
+"""Porcupine model/operation types (reference: porcupine/model.go:5-49,
+porcupine/porcupine.go:5-39 — a vendored copy of anishathalye/porcupine).
+
+A :class:`Model` is a specification automaton; a history of
+:class:`Operation` s is linearizable iff some total order of the
+operations, consistent with real-time precedence, drives the automaton
+with every step legal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, List, Optional
+
+__all__ = ["Operation", "Model", "CheckResult"]
+
+
+class CheckResult(enum.Enum):
+    """(reference: porcupine/porcupine.go CheckResult)"""
+
+    OK = "ok"
+    ILLEGAL = "illegal"
+    UNKNOWN = "unknown"  # checker timed out; treated as pass-with-warning
+
+
+@dataclasses.dataclass
+class Operation:
+    """One client operation with its real-time interval
+    (reference: porcupine/model.go Operation)."""
+
+    client_id: int
+    input: Any
+    call: float  # invocation time
+    output: Any
+    ret: float  # response time
+
+
+@dataclasses.dataclass
+class Model:
+    """Specification automaton (reference: porcupine/model.go Model).
+
+    ``partition`` splits a history into independently-checkable
+    sub-histories (e.g. per key); ``init`` returns the initial state;
+    ``step(state, input, output) -> (ok, new_state)`` applies one
+    operation.  States must be hashable, or supply ``freeze`` to map a
+    state to a hashable key (used for memoization)."""
+
+    init: Callable[[], Any]
+    step: Callable[[Any, Any, Any], tuple]
+    partition: Optional[Callable[[List[Operation]], List[List[Operation]]]] = None
+    freeze: Optional[Callable[[Any], Any]] = None
+    describe_operation: Optional[Callable[[Any, Any], str]] = None
+
+    def partitions(self, history: List[Operation]) -> List[List[Operation]]:
+        if self.partition is None:
+            return [history]
+        return self.partition(history)
+
+    def key_of(self, state: Any) -> Any:
+        return self.freeze(state) if self.freeze is not None else state

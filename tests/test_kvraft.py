@@ -1,0 +1,364 @@
+"""kvraft service tests — the GenericTest matrix
+(reference: kvraft/test_test.go:208-718).
+
+Clients run as coroutines recording porcupine operations with
+virtual-time intervals; every generic run ends with a linearizability
+check of the full history (reference: kvraft/test_test.go:365-381).
+Client workloads are op-count-bounded (the reference bounds by
+wall-clock; virtual time makes op counts the meaningful budget).
+"""
+
+import pytest
+
+from multiraft_tpu.harness.kv_harness import KVHarness
+from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+from multiraft_tpu.porcupine.kv import (
+    OP_APPEND,
+    OP_GET,
+    OP_PUT,
+    KvInput,
+    KvOutput,
+    kv_model,
+)
+from multiraft_tpu.porcupine.model import Operation
+
+
+def _record(history, sched, ck, inp):
+    """Run one clerk op inside a client coroutine, recording its
+    porcupine operation (reference: kvraft/test_test.go:43-91)."""
+    t0 = sched.now
+    if inp.op == OP_GET:
+        v = yield from ck.get(inp.key)
+    elif inp.op == OP_PUT:
+        v = yield from ck.put(inp.key, inp.value)
+        v = ""
+    else:
+        v = yield from ck.append(inp.key, inp.value)
+        v = ""
+    history.append(
+        Operation(
+            client_id=ck.client_id,
+            input=inp,
+            call=t0,
+            output=KvOutput(value=v or ""),
+            ret=sched.now,
+        )
+    )
+    return v
+
+
+def check_clnt_appends(cli: int, v: str, count: int, rnd: int = -1) -> None:
+    """Client cli's appends must appear in order
+    (reference: kvraft/test_test.go:134-151).  ``rnd`` tags values so
+    rounds can't satisfy each other's checks."""
+    last = -1
+    for j in range(count):
+        wanted = f"x {cli} {j} y" if rnd < 0 else f"x {cli} {rnd}.{j} y"
+        off = v.find(wanted)
+        assert off >= 0, f"{wanted} missing in Get result (client {cli})"
+        assert off > last, f"{wanted} out of order (client {cli})"
+        last = off
+
+
+def generic_test(
+    nclients: int,
+    nservers: int,
+    unreliable: bool = False,
+    crash: bool = False,
+    partitions: bool = False,
+    maxraftstate: int = -1,
+    randomkeys: bool = False,
+    seed: int = 0,
+    nops: int = 25,
+    rounds: int = 2,
+):
+    """(reference: kvraft/test_test.go:208-384)"""
+    cfg = KVHarness(
+        nservers, unreliable=unreliable, maxraftstate=maxraftstate, seed=seed
+    )
+    sched = cfg.sched
+    history: list = []
+
+    for rnd in range(rounds):
+        clerks = [cfg.make_client() for _ in range(nclients)]
+        done_partitioner = [False]
+
+        def client(cli, ck, rnd=rnd):
+            j = 0
+            while j < nops:
+                if randomkeys:
+                    key = str(cfg.rng.randrange(nclients))
+                else:
+                    key = str(cli)
+                r = cfg.rng.random()
+                if r < 0.5:
+                    inp = KvInput(
+                        op=OP_APPEND, key=key, value=f"x {cli} {rnd}.{j} y"
+                    )
+                    j += 1
+                elif randomkeys and r < 0.6:
+                    inp = KvInput(
+                        op=OP_PUT, key=key, value=f"x {cli} {rnd}.{j} y"
+                    )
+                    j += 1
+                else:
+                    inp = KvInput(op=OP_GET, key=key)
+                yield from _record(history, sched, ck, inp)
+                yield cfg.rng.uniform(0.001, 0.02)
+            return j
+
+        def partitioner():
+            while not done_partitioner[0]:
+                cfg.random_partition()
+                yield cfg.rng.uniform(0.2, 0.5)
+            cfg.connect_all()
+
+        futs = [sched.spawn(client(i, clerks[i])) for i in range(nclients)]
+        if partitions:
+            sched.spawn(partitioner())
+        for f in futs:
+            sched.run_until(f, max_events=5_000_000)
+        done_partitioner[0] = True
+        cfg.connect_all()
+        sched.run_for(0.3)
+
+        if crash:
+            for i in range(nservers):
+                cfg.shutdown_server(i)
+            sched.run_for(0.2)
+            for i in range(nservers):
+                cfg.start_server(i)
+            cfg.connect_all()
+            sched.run_for(0.7)
+
+        if not randomkeys:
+            # Per-client append-sequence integrity for this round.
+            ck = cfg.make_client()
+            for cli in range(nclients):
+                inp = KvInput(op=OP_GET, key=str(cli))
+                v = sched.run_until(
+                    sched.spawn(_record(history, sched, ck, inp))
+                )
+                check_clnt_appends(cli, v, nops, rnd=rnd)
+
+    if maxraftstate > 0:
+        assert cfg.log_size() <= 8 * maxraftstate, (
+            f"logs were not trimmed: {cfg.log_size()} > 8x{maxraftstate}"
+        )
+
+    res = check_operations(kv_model, history, timeout=2.0)
+    assert res is not CheckResult.ILLEGAL, "history is not linearizable"
+    cfg.cleanup()
+
+
+# -- 3A instantiations (reference: kvraft/test_test.go:421-619) ----------
+
+
+def test_basic():
+    generic_test(nclients=1, nservers=5, seed=40)
+
+
+def test_speed():
+    """Sequential append latency gate: < 33.3 ms/op
+    (reference: kvraft/test_test.go:387-419 GenericTestSpeed)."""
+    cfg = KVHarness(3, seed=41)
+    ck = cfg.make_client()
+    # Let a leader emerge.
+    cfg.sched.run_for(1.0)
+    t0 = cfg.sched.now
+    n = 200
+    for i in range(n):
+        cfg.run(ck.append("x", f"{i} "))
+    per_op = (cfg.sched.now - t0) / n
+    assert per_op < 0.0333, f"Operations completed too slowly {per_op*1000:.1f}ms/op"
+    v = cfg.run(ck.get("x"))
+    assert v == "".join(f"{i} " for i in range(n))
+    cfg.cleanup()
+
+
+def test_concurrent():
+    generic_test(nclients=5, nservers=5, seed=42)
+
+
+def test_unreliable():
+    generic_test(nclients=5, nservers=5, unreliable=True, seed=43, nops=15)
+
+
+def test_unreliable_one_key():
+    """Concurrent appends to one key over an unreliable net: all must
+    land exactly once (reference: TestUnreliableOneKey3A)."""
+    cfg = KVHarness(3, unreliable=True, seed=44)
+    ck = cfg.make_client()
+    cfg.run(ck.put("k", ""))
+    nclient, upto = 5, 10
+    clerks = [cfg.make_client() for _ in range(nclient)]
+
+    def client(cli, c):
+        for n in range(upto):
+            yield from c.append("k", f"x {cli} {n} y")
+
+    futs = [cfg.sched.spawn(client(i, c)) for i, c in enumerate(clerks)]
+    for f in futs:
+        cfg.sched.run_until(f)
+    counts = [upto] * nclient
+    v = cfg.run(ck.get("k"))
+    for i in range(nclient):
+        check_clnt_appends(i, v, upto)
+    cfg.cleanup()
+
+
+def test_one_partition():
+    """Progress in the majority side only; minority put completes after
+    heal (reference: TestOnePartition3A)."""
+    cfg = KVHarness(5, seed=45)
+    ck = cfg.make_client()
+    cfg.run(ck.put("1", "13"))
+
+    leader = cfg.current_leader()
+    assert leader >= 0
+    minority = [leader, (leader + 1) % 5]
+    majority = [i for i in range(5) if i not in minority]
+    cfg.partition(majority, minority)
+
+    ckp1 = cfg.make_client()
+    cfg.connect_client(ckp1, majority)
+    ckp2 = cfg.make_client()
+    cfg.connect_client(ckp2, minority)
+
+    cfg.run(ckp1.put("1", "14"))
+    assert cfg.run(ckp1.get("1")) == "14"
+
+    stuck = cfg.sched.spawn(ckp2.put("1", "15"))
+    cfg.sched.run_for(2.0)
+    assert not stuck.done, "Put succeeded in minority partition"
+
+    cfg.connect_all()
+    cfg.connect_client(ckp2, list(range(5)))
+    cfg.sched.run_until(stuck)
+    assert cfg.run(ck.get("1")) == "15"
+    cfg.cleanup()
+
+
+def test_many_partitions_one_client():
+    generic_test(nclients=1, nservers=5, partitions=True, seed=46)
+
+
+def test_many_partitions_many_clients():
+    generic_test(nclients=5, nservers=5, partitions=True, seed=47, nops=15)
+
+
+def test_persist_one_client():
+    generic_test(nclients=1, nservers=5, crash=True, seed=48)
+
+
+def test_persist_concurrent():
+    generic_test(nclients=5, nservers=5, crash=True, seed=49, nops=15)
+
+
+def test_persist_concurrent_unreliable():
+    generic_test(
+        nclients=5, nservers=5, crash=True, unreliable=True, seed=50, nops=10
+    )
+
+
+def test_persist_partition():
+    generic_test(
+        nclients=5, nservers=5, crash=True, partitions=True, seed=51, nops=10
+    )
+
+
+def test_persist_partition_unreliable_linearizable():
+    """The everything-at-once 3A finale
+    (reference: TestPersistPartitionUnreliableLinearizable3A — 15
+    clients, randomkeys; scaled)."""
+    generic_test(
+        nclients=7,
+        nservers=7,
+        crash=True,
+        partitions=True,
+        unreliable=True,
+        randomkeys=True,
+        seed=52,
+        nops=8,
+    )
+
+
+# -- 3B snapshot instantiations (reference: kvraft/test_test.go:621-718) --
+
+
+def test_snapshot_rpc():
+    """A follower that missed many ops catches up via InstallSnapshot
+    (reference: TestSnapShotRPC3B)."""
+    maxraftstate = 1000
+    cfg = KVHarness(3, maxraftstate=maxraftstate, seed=53)
+    ck = cfg.make_client()
+    cfg.run(ck.put("a", "A"))
+    assert cfg.run(ck.get("a")) == "A"
+
+    # Partition one follower away.
+    leader = cfg.current_leader()
+    victim = (leader + 1) % 3
+    others = [i for i in range(3) if i != victim]
+    cfg.partition(others, [victim])
+
+    # Enough ops to force snapshots past the victim's log position.
+    for i in range(60):
+        cfg.run(ck.put(str(i % 7), "v" * 50))
+    assert cfg.log_size() <= 8 * maxraftstate, "logs were not trimmed"
+
+    cfg.connect_all()
+    cfg.sched.run_for(1.0)
+    cfg.run(ck.put("b", "B"))
+    # The victim must have a consistent, snapshot-restored state: crash
+    # everyone else and let it serve with one peer.
+    cfg.partition([victim, leader], [(leader + 2) % 3])
+    cfg.sched.run_for(1.0)
+    assert cfg.run(ck.get("a")) == "A"
+    assert cfg.run(ck.get("b")) == "B"
+    cfg.cleanup()
+
+
+def test_snapshot_size():
+    """Snapshot stays small for a small state machine
+    (reference: TestSnapshotSize3B — gate 500 B)."""
+    maxsnapshotstate = 500
+    cfg = KVHarness(3, maxraftstate=1000, seed=54)
+    ck = cfg.make_client()
+    for i in range(100):
+        cfg.run(ck.put("x", "0"))
+        assert cfg.run(ck.get("x")) == "0"
+        cfg.run(ck.put("x", "1"))
+        assert cfg.run(ck.get("x")) == "1"
+    assert cfg.log_size() <= 8 * 1000, "logs were not trimmed"
+    assert cfg.snapshot_size() <= maxsnapshotstate, (
+        f"snapshot too large: {cfg.snapshot_size()}"
+    )
+    cfg.cleanup()
+
+
+def test_snapshot_recover():
+    generic_test(
+        nclients=1, nservers=5, crash=True, maxraftstate=1000, seed=55
+    )
+
+
+def test_snapshot_recover_concurrent():
+    generic_test(
+        nclients=5, nservers=5, crash=True, maxraftstate=1000, seed=56, nops=15
+    )
+
+
+def test_snapshot_unreliable_recover_concurrent_partition():
+    """The 3B finale (reference:
+    TestSnapshotUnreliableRecoverConcurrentPartitionLinearizable3B)."""
+    generic_test(
+        nclients=7,
+        nservers=7,
+        unreliable=True,
+        crash=True,
+        partitions=True,
+        maxraftstate=1000,
+        randomkeys=True,
+        seed=57,
+        nops=8,
+    )

@@ -1,0 +1,137 @@
+"""Linearizability checker tests (reference: porcupine checker behavior
+via kvraft/shardkv test usage; classic histories from the literature)."""
+
+from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+from multiraft_tpu.porcupine.kv import (
+    OP_APPEND,
+    OP_GET,
+    OP_PUT,
+    KvInput,
+    KvOutput,
+    kv_model,
+)
+from multiraft_tpu.porcupine.model import Model, Operation
+
+
+def op(cid, inp, call, out, ret):
+    return Operation(client_id=cid, input=inp, call=call, output=out, ret=ret)
+
+
+def get(k, v, call, ret, cid=0):
+    return op(cid, KvInput(op=OP_GET, key=k), call, KvOutput(value=v), ret)
+
+
+def put(k, v, call, ret, cid=0):
+    return op(cid, KvInput(op=OP_PUT, key=k, value=v), call, KvOutput(), ret)
+
+
+def app(k, v, call, ret, cid=0):
+    return op(cid, KvInput(op=OP_APPEND, key=k, value=v), call, KvOutput(), ret)
+
+
+def test_sequential_ok():
+    h = [put("a", "1", 0, 1, cid=0), get("a", "1", 2, 3, cid=1)]
+    assert check_operations(kv_model, h) is CheckResult.OK
+
+
+def test_stale_read_illegal():
+    # put completes before get starts, but get sees the old value.
+    h = [put("a", "1", 0, 1, cid=0), get("a", "", 2, 3, cid=1)]
+    assert check_operations(kv_model, h) is CheckResult.ILLEGAL
+
+
+def test_concurrent_read_either_value_ok():
+    # get overlaps the put: may see old or new.
+    h1 = [put("a", "1", 0, 10, cid=0), get("a", "", 1, 2, cid=1)]
+    h2 = [put("a", "1", 0, 10, cid=0), get("a", "1", 1, 2, cid=1)]
+    assert check_operations(kv_model, h1) is CheckResult.OK
+    assert check_operations(kv_model, h2) is CheckResult.OK
+
+
+def test_append_order_visible():
+    h = [
+        app("k", "x", 0, 1, cid=0),
+        app("k", "y", 2, 3, cid=1),
+        get("k", "xy", 4, 5, cid=2),
+    ]
+    assert check_operations(kv_model, h) is CheckResult.OK
+    h_bad = [
+        app("k", "x", 0, 1, cid=0),
+        app("k", "y", 2, 3, cid=1),
+        get("k", "yx", 4, 5, cid=2),
+    ]
+    assert check_operations(kv_model, h_bad) is CheckResult.ILLEGAL
+
+
+def test_lost_append_illegal():
+    h = [
+        app("k", "x", 0, 1, cid=0),
+        app("k", "y", 2, 3, cid=1),
+        get("k", "y", 4, 5, cid=2),  # lost "x"
+    ]
+    assert check_operations(kv_model, h) is CheckResult.ILLEGAL
+
+
+def test_partitioned_keys_independent():
+    # Interleaved ops on different keys; each key's history is fine.
+    h = [
+        put("a", "1", 0, 5, cid=0),
+        put("b", "2", 1, 4, cid=1),
+        get("a", "1", 6, 7, cid=2),
+        get("b", "2", 6, 7, cid=3),
+    ]
+    assert check_operations(kv_model, h) is CheckResult.OK
+
+
+def test_concurrent_appends_both_orders():
+    # Two concurrent appends; a later read may see either order but not
+    # a dropped write.
+    base = [app("k", "x", 0, 10, cid=0), app("k", "y", 0, 10, cid=1)]
+    for v in ("xy", "yx"):
+        assert (
+            check_operations(kv_model, base + [get("k", v, 11, 12, cid=2)])
+            is CheckResult.OK
+        )
+    for v in ("x", "y", ""):
+        assert (
+            check_operations(kv_model, base + [get("k", v, 11, 12, cid=2)])
+            is CheckResult.ILLEGAL
+        )
+
+
+def test_register_model_classic():
+    """Classic single-register histories (Herlihy & Wing figures)."""
+
+    reg = Model(
+        init=lambda: 0,
+        step=lambda st, inp, out: (
+            (True, inp[1]) if inp[0] == "w" else (out == st, st)
+        ),
+    )
+    # w(1) concurrent with r()->1 then r()->0 after: illegal.
+    h = [
+        op(0, ("w", 1), 0, None, 10),
+        op(1, ("r", None), 1, 1, 3),
+        op(2, ("r", None), 4, 0, 6),
+    ]
+    assert check_operations(reg, h) is CheckResult.ILLEGAL
+    # But r()->0 then r()->1 is fine (write lands between them).
+    h2 = [
+        op(0, ("w", 1), 0, None, 10),
+        op(1, ("r", None), 1, 0, 3),
+        op(2, ("r", None), 4, 1, 6),
+    ]
+    assert check_operations(reg, h2) is CheckResult.OK
+
+
+def test_timeout_returns_unknown():
+    # An ambiguity-heavy history (many fully-concurrent appends) with a
+    # zero timeout must yield UNKNOWN, not hang or fail.
+    h = [app("k", str(i), 0, 100, cid=i) for i in range(12)]
+    h.append(get("k", "".join(str(i) for i in range(12)), 101, 102, cid=99))
+    res = check_operations(kv_model, h, timeout=0.0)
+    assert res in (CheckResult.UNKNOWN, CheckResult.OK)
+
+
+def test_empty_history_ok():
+    assert check_operations(kv_model, []) is CheckResult.OK
