@@ -1,23 +1,21 @@
 """kvraft test fixture (reference: kvraft/config.go).
 
-Same incarnation-fresh endpoint discipline as the Raft harness, plus:
-clerk factories with per-clerk endpoints and shuffled server order
-(reference: kvraft/config.go:194-212,37-45), a 2-way server partitioner
-(reference: kvraft/config.go:177-189; clerks stay connected to all
-servers — their RPCs into a minority side simply fail to commit), and
-crash/restart that preserves persisted state
-(reference: kvraft/config.go:258-326).
+A thin wrapper over :class:`~multiraft_tpu.harness.cluster.Cluster`
+adding kvraft clerk construction (reference: kvraft/config.go:194-212)
+and the same partition/crash surface the reference exposes
+(reference: kvraft/config.go:177-189,258-326).
 """
 
 from __future__ import annotations
 
 import random
-from typing import List, Optional
+from typing import List
 
 from ..raft.persister import Persister
 from ..services.kvraft import Clerk, KVServer
 from ..sim.scheduler import Scheduler
-from ..transport.network import Network, Server, Service
+from ..transport.network import Network
+from .cluster import Cluster
 
 __all__ = ["KVHarness"]
 
@@ -34,150 +32,75 @@ class KVHarness:
         self.net = Network(self.sched, seed=seed)
         self.net.set_reliable(not unreliable)
         self.n = n
-        self.seed = seed
         self.rng = random.Random(seed ^ 0xBEEF)
         self.maxraftstate = maxraftstate
-        self.servers: List[Optional[KVServer]] = [None] * n
-        self.saved: List[Persister] = [Persister() for _ in range(n)]
-        self.endnames: List[List[object]] = [[None] * n for _ in range(n)]
-        self.groups = [0] * n  # current partition side per server
-        self._incarnation = 0
-        self._next_clerk = 0
-        self.clerks: dict = {}  # clerk -> list of its endnames
-        for i in range(n):
-            self.start_server(i)
-        self.connect_all()
 
-    # -- server lifecycle ------------------------------------------------
+        def factory(ends, i, persister: Persister, srv_seed: int):
+            srv = KVServer(
+                self.sched,
+                ends,
+                i,
+                persister,
+                maxraftstate=self.maxraftstate,
+                seed=srv_seed,
+            )
+            return srv, {"KVServer": srv, "Raft": srv.rf}
+
+        self.cluster = Cluster(
+            self.sched, self.net, "kv", n, factory, self.rng, seed=seed
+        )
+        self.cluster.start_all()
+        self._clerk_ids: dict = {}
+
+    # -- delegation to the cluster ---------------------------------------
+
+    @property
+    def servers(self):
+        return self.cluster.handles
 
     def start_server(self, i: int) -> None:
-        """(reference: kvraft/config.go StartServer:283-326)"""
-        if self.servers[i] is not None:
-            self.shutdown_server(i)
-        self._incarnation += 1
-        inc = self._incarnation
-        ends = []
-        for j in range(self.n):
-            name = ("kv", i, j, inc)
-            self.endnames[i][j] = name
-            end = self.net.make_end(name)
-            self.net.connect(name, j)
-            ends.append(end)
-        persister = self.saved[i].copy()
-        self.saved[i] = persister
-        srv_obj = KVServer(
-            self.sched,
-            ends,
-            i,
-            persister,
-            maxraftstate=self.maxraftstate,
-            seed=self.seed * 977 + inc,
-        )
-        self.servers[i] = srv_obj
-        server = Server()
-        server.add_service(Service(srv_obj, name="KVServer"))
-        server.add_service(Service(srv_obj.rf, name="Raft"))
-        self.net.add_server(i, server)
-        self._apply_edges()
+        self.cluster.start_server(i)
 
     def shutdown_server(self, i: int) -> None:
-        """(reference: kvraft/config.go ShutdownServer:258-281)"""
-        self.net.delete_server(i)
-        self.saved[i] = self.saved[i].copy()
-        if self.servers[i] is not None:
-            self.servers[i].kill()
-            self.servers[i] = None
-
-    # -- connectivity ----------------------------------------------------
-
-    def _apply_edges(self) -> None:
-        """Server-server edges on iff same partition side."""
-        for i in range(self.n):
-            for j in range(self.n):
-                if self.endnames[i][j] is not None:
-                    on = self.groups[i] == self.groups[j]
-                    self.net.enable(self.endnames[i][j], on)
+        self.cluster.shutdown_server(i)
 
     def connect_all(self) -> None:
-        self.groups = [0] * self.n
-        self._apply_edges()
+        self.cluster.connect_all()
 
     def partition(self, p1: List[int], p2: List[int]) -> None:
-        """2-way partition (reference: kvraft/config.go:177-189)."""
-        for i in p1:
-            self.groups[i] = 0
-        for i in p2:
-            self.groups[i] = 1
-        self._apply_edges()
+        self.cluster.partition(p1, p2)
 
     def random_partition(self) -> None:
-        """The GenericTest partitioner's random 2-way split
-        (reference: kvraft/test_test.go:178-197)."""
-        p1, p2 = [], []
-        for i in range(self.n):
-            (p1 if self.rng.random() < 0.5 else p2).append(i)
-        self.partition(p1, p2)
+        self.cluster.random_partition()
+
+    def current_leader(self) -> int:
+        return self.cluster.current_leader()
+
+    def log_size(self) -> int:
+        return self.cluster.log_size()
+
+    def snapshot_size(self) -> int:
+        return self.cluster.snapshot_size()
 
     # -- clerks ----------------------------------------------------------
 
     def make_client(self) -> Clerk:
-        """Clerk with its own endpoints and shuffled server order
-        (reference: kvraft/config.go:194-212)."""
-        self._next_clerk += 1
-        cid = self._next_clerk
-        order = list(range(self.n))
-        self.rng.shuffle(order)
-        ends = []
-        names = []
-        for j in order:
-            name = ("ck", cid, j)
-            end = self.net.make_end(name)
-            self.net.connect(name, j)
-            self.net.enable(name, True)
-            ends.append(end)
-            names.append(name)
+        ends = self.cluster.make_client_ends()
         ck = Clerk(self.sched, ends)
-        self.clerks[ck] = names
+        self._clerk_ids[ck] = self.cluster._last_clerk_id
         return ck
 
     def connect_client(self, ck: Clerk, to: List[int]) -> None:
-        """Restrict a clerk to a subset of servers
-        (reference: kvraft/config.go ConnectClient)."""
-        allowed = set(to)
-        for name in self.clerks[ck]:
-            _, _, j = name
-            self.net.enable(name, j in allowed)
+        self.cluster.restrict_client(self._clerk_ids[ck], to)
 
-    def current_leader(self) -> int:
-        """Index of the live server claiming leadership at the highest
-        term; -1 if none."""
-        best, best_term = -1, -1
-        for i, s in enumerate(self.servers):
-            if s is not None:
-                term, is_leader = s.rf.get_state()
-                if is_leader and term > best_term:
-                    best, best_term = i, term
-        return best
-
-    # -- stats -----------------------------------------------------------
-
-    def log_size(self) -> int:
-        return max(p.raft_state_size() for p in self.saved)
-
-    def snapshot_size(self) -> int:
-        return max(p.snapshot_size() for p in self.saved)
+    # -- misc -------------------------------------------------------------
 
     def op_total(self) -> int:
         return self.net.get_total_count()
 
     def cleanup(self) -> None:
-        for s in self.servers:
-            if s is not None:
-                s.kill()
+        self.cluster.kill_all()
         self.net.cleanup()
 
-    # -- sync helpers ----------------------------------------------------
-
     def run(self, gen):
-        """Run a clerk coroutine to completion on the scheduler."""
         return self.sched.run_until(self.sched.spawn(gen))
