@@ -1,0 +1,368 @@
+"""shardkv tests (reference: shardkv/test_test.go — the suite that
+defines the server behavior the reference left unimplemented,
+SURVEY §2.7/§4.4), including Challenge 1 (shard deletion, bounded
+storage) and Challenge 2 (partial availability during migration)."""
+
+import pytest
+
+from multiraft_tpu.harness.shardkv_harness import ShardKVHarness
+from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+from multiraft_tpu.porcupine.kv import KvInput, KvOutput, OP_APPEND, OP_GET, OP_PUT, kv_model
+from multiraft_tpu.porcupine.model import Operation
+from multiraft_tpu.services.shardkv import key2shard
+from multiraft_tpu.services.shardctrler import NSHARDS
+
+
+def keys_for_all_shards():
+    """One key per shard (keys '0'..'9' hit shards 0..9 via first-byte
+    routing, reference: shardkv/client.go:22-29)."""
+    ks = []
+    for i in range(NSHARDS):
+        k = str(i)
+        assert key2shard(k) == (ord(k[0]) % NSHARDS)
+        ks.append(k)
+    return ks
+
+
+def test_static_shards():
+    """With one group down, its shards stall but the other group's keys
+    keep serving (reference: shardkv/test_test.go:26-95)."""
+    cfg = ShardKVHarness(n=3, ngroups=2, seed=70)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.join(101)
+    cfg.sched.run_for(2.0)
+
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "v" + k))
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "v" + k
+
+    # Which shards does each group own?
+    conf = cfg.run(cfg.ctl_ck.query(-1))
+    cfg.shutdown_group(101)
+
+    done = []
+    for k in keys:
+        ck2 = cfg.make_client()
+        ck2.config = conf
+        fut = cfg.sched.spawn(ck2.get(k))
+        done.append((k, fut))
+    cfg.sched.run_for(3.0)
+    n_ok = 0
+    for k, fut in done:
+        owner = conf.shards[key2shard(k)]
+        if owner == 100:
+            assert fut.done, f"key {k} (live group 100) did not serve"
+            assert fut.value == "v" + k
+            n_ok += 1
+        else:
+            assert not fut.done, f"key {k} (dead group 101) served!"
+    assert n_ok == sum(1 for s in conf.shards if s == 100)
+    cfg.cleanup()
+
+
+def test_join_leave_migration():
+    """Data follows shards across join/leave; old owner can be shut down
+    after handoff (reference: shardkv/test_test.go:97-148)."""
+    cfg = ShardKVHarness(n=3, ngroups=2, seed=71)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "A" + k))
+
+    cfg.join(101)
+    cfg.sched.run_for(2.0)  # migration completes
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "A" + k
+        cfg.run(ck.append(k, "B"))
+
+    cfg.leave(100)
+    cfg.sched.run_for(2.0)
+    # Everything now lives on group 101; group 100 can disappear.
+    cfg.shutdown_group(100)
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "A" + k + "B"
+        cfg.run(ck.append(k, "C"))
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "A" + k + "BC"
+    cfg.cleanup()
+
+
+def test_snapshot_restart_recovery():
+    """Groups restart from snapshots and keep serving
+    (reference: shardkv/test_test.go:150-216)."""
+    cfg = ShardKVHarness(n=3, ngroups=3, maxraftstate=1000, seed=72)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.join(101)
+    cfg.join(102)
+    cfg.sched.run_for(2.0)
+
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "s" + k))
+    for rnd in range(3):
+        for k in keys:
+            cfg.run(ck.append(k, f".{rnd}"))
+
+    # Log-size gate (reference: shardkv/config.go:91-105 checklogs).
+    for gid in cfg.gids:
+        assert cfg.groups[gid].log_size() <= 8 * 1000, "logs were not trimmed"
+
+    for gid in cfg.gids:
+        cfg.shutdown_group(gid)
+    cfg.sched.run_for(0.3)
+    for gid in cfg.gids:
+        cfg.start_group(gid)
+    cfg.sched.run_for(2.0)
+
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "s" + k + ".0.1.2"
+    cfg.cleanup()
+
+
+def test_missed_config_changes():
+    """A group that was down through several config changes catches up
+    one config at a time (reference: shardkv/test_test.go:218-302)."""
+    cfg = ShardKVHarness(n=3, ngroups=3, seed=73)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "m" + k))
+
+    cfg.shutdown_group(102)
+    # Config churn while 102 is down.
+    cfg.join(101)
+    cfg.sched.run_for(1.5)
+    cfg.join(102)
+    cfg.leave(101)
+    cfg.sched.run_for(1.0)
+
+    cfg.start_group(102)
+    cfg.sched.run_for(3.0)
+
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "m" + k
+        cfg.run(ck.append(k, "!"))
+    for k in keys:
+        assert cfg.run(ck.get(k)) == "m" + k + "!"
+    cfg.cleanup()
+
+
+def _concurrent(unreliable: bool, seed: int, with_porcupine: bool = False):
+    """Concurrent clients through config churn
+    (reference: shardkv/test_test.go:304-736)."""
+    cfg = ShardKVHarness(
+        n=3, ngroups=3, unreliable=unreliable, maxraftstate=1000, seed=seed
+    )
+    sched = cfg.sched
+    history = []
+    cfg.join(100)
+    sched.run_for(1.0)
+
+    nclients = 4
+    clerks = [cfg.make_client() for _ in range(nclients)]
+
+    def client(cli, c):
+        for j in range(10):
+            key = str((cli * 3 + j) % NSHARDS)
+            t0 = sched.now
+            v = f"({cli}.{j})"
+            yield from c.append(key, v)
+            history.append(
+                Operation(
+                    c.client_id,
+                    KvInput(op=OP_APPEND, key=key, value=v),
+                    t0,
+                    KvOutput(""),
+                    sched.now,
+                )
+            )
+            yield cfg.rng.uniform(0.005, 0.05)
+        return 10
+
+    futs = [sched.spawn(client(i, c)) for i, c in enumerate(clerks)]
+
+    def churner():
+        yield 0.2
+        cfg.join(101)
+        yield 0.4
+        cfg.join(102)
+        yield 0.4
+        cfg.leave(100)
+        yield 0.4
+        cfg.join(100)
+        cfg.leave(101)
+        yield 0.4
+        cfg.join(101)
+
+    churn = sched.spawn(churner())
+    for f in futs:
+        sched.run_until(f, max_events=10_000_000)
+    sched.run_until(churn)
+    sched.run_for(1.0)
+
+    # Verify all appends present, in per-client order.
+    ck = cfg.make_client()
+    for key in set(str(s) for s in range(NSHARDS)):
+        t0 = sched.now
+        v = cfg.run(ck.get(key))
+        history.append(
+            Operation(
+                ck.client_id,
+                KvInput(op=OP_GET, key=key),
+                t0,
+                KvOutput(v),
+                sched.now,
+            )
+        )
+        for cli in range(nclients):
+            last = -1
+            for j in range(10):
+                if str((cli * 3 + j) % NSHARDS) == key:
+                    tag = f"({cli}.{j})"
+                    off = v.find(tag)
+                    assert off >= 0, f"append {tag} missing from key {key}: {v!r}"
+                    assert off > last, f"append {tag} out of order in {v!r}"
+                    last = off
+    if with_porcupine:
+        res = check_operations(kv_model, history, timeout=2.0)
+        assert res is not CheckResult.ILLEGAL, "history not linearizable"
+    cfg.cleanup()
+
+
+def test_concurrent_reliable():
+    _concurrent(unreliable=False, seed=74)
+
+
+def test_concurrent_unreliable_porcupine():
+    _concurrent(unreliable=True, seed=75, with_porcupine=True)
+
+
+def test_challenge1_shard_deletion_bounds_storage():
+    """Old owners delete migrated shards; total persisted state stays
+    bounded (reference: shardkv/test_test.go:738-817)."""
+    maxraftstate = 1000
+    cfg = ShardKVHarness(n=3, ngroups=3, maxraftstate=maxraftstate, seed=76)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+
+    # 30 keys of ~1000 B.
+    payload = "x" * 1000
+    keys = [chr(ord("0") + (i % 10)) + f"k{i}" for i in range(30)]
+    for k in keys:
+        cfg.run(ck.put(k, payload))
+
+    # Churn shards through all groups repeatedly.
+    for rnd in range(3):
+        cfg.join(101)
+        cfg.sched.run_for(1.5)
+        cfg.join(102)
+        cfg.sched.run_for(1.5)
+        cfg.leave(101)
+        cfg.sched.run_for(1.5)
+        cfg.leave(102)
+        cfg.sched.run_for(1.5)
+
+    for k in keys:
+        assert cfg.run(ck.get(k)) == payload
+
+    total = cfg.total_group_storage()
+    # Data is ~30 KB; without deletion each churn round would leave full
+    # copies on 3 groups x 3 replicas (state+snapshot), compounding per
+    # round.  The reference's exact gate is
+    # 3*((n-3)*1000 + 2*3*1000 + 6000) per 30x1KB keys
+    # (shardkv/test_test.go:807-810); our codec overhead differs, so the
+    # gate scales the same ideal by the same factor.
+    ideal = 30 * 1000 * 3 * 2  # all keys on all 3 replicas, state+snapshot
+    assert total <= ideal * 3, (
+        f"persisted storage not bounded: {total} > {ideal * 3} "
+        "(old owners are keeping migrated shards?)"
+    )
+    cfg.cleanup()
+
+
+def test_challenge2_unaffected_shards_serve():
+    """Shards untouched by a stuck migration keep serving
+    (reference: shardkv/test_test.go:824-887)."""
+    cfg = ShardKVHarness(n=3, ngroups=2, seed=77)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "u" + k))
+
+    cfg.join(101)
+    cfg.sched.run_for(2.5)  # migration 100->101 completes
+    conf = cfg.run(cfg.ctl_ck.query(-1))
+    for k in keys:
+        cfg.run(ck.append(k, "+"))
+
+    # Kill group 100 and hand everything to 101: the 5 shards still on
+    # 100 can never migrate, but 101's own shards must keep serving.
+    cfg.shutdown_group(100)
+    cfg.leave(100)
+    cfg.sched.run_for(2.0)
+
+    for k in keys:
+        owner = conf.shards[key2shard(k)]
+        ck2 = cfg.make_client()
+        fut = cfg.sched.spawn(ck2.get(k))
+        cfg.sched.run_for(1.5)
+        if owner == 101:
+            assert fut.done, f"unaffected key {k} stopped serving"
+            assert fut.value == "u" + k + "+"
+        else:
+            assert not fut.done, f"key {k} served from a dead source group"
+    cfg.cleanup()
+
+
+def test_challenge2_partial_migration_serves_early():
+    """Migrated-in shards serve as soon as their data lands, even while
+    sibling shards' sources are dead — one config change moves shards
+    from both a live-but-leaving group (pullable) and a dead group
+    (stuck) (reference: shardkv/test_test.go:894-948)."""
+    cfg = ShardKVHarness(n=3, ngroups=3, seed=78)
+    ck = cfg.make_client()
+    cfg.joinm([100, 101, 102])
+    cfg.sched.run_for(2.0)
+    keys = keys_for_all_shards()
+    for k in keys:
+        cfg.run(ck.put(k, "p" + k))
+    conf = cfg.run(cfg.ctl_ck.query(-1))
+
+    # 100 dies; 100 and 102 leave in ONE config change.  101 can pull
+    # the shards 102 held (102 is alive, just leaving) but never the
+    # shards 100 held.
+    cfg.shutdown_group(100)
+    cfg.leavem([100, 102])
+    cfg.sched.run_for(2.5)
+
+    for k in keys:
+        src = conf.shards[key2shard(k)]
+        if src == 101:
+            continue  # 101's own shards: covered by the unaffected test
+        ck2 = cfg.make_client()
+        fut = cfg.sched.spawn(ck2.get(k))
+        cfg.sched.run_for(1.5)
+        if src == 102:
+            assert fut.done, (
+                f"key {k} (pullable from live group 102) is not served "
+                "during partial migration"
+            )
+            assert fut.value == "p" + k
+            # Writes must work too (reference re-Puts partial keys).
+            assert cfg.run(ck2.put(k, "q" + k)) == ""
+            assert cfg.run(ck2.get(k)) == "q" + k
+        else:
+            assert not fut.done, f"key {k} served without its data"
+    cfg.cleanup()
