@@ -1,0 +1,114 @@
+"""Headline benchmark: log commits/sec across 10k Raft groups.
+
+North star (BASELINE.md): >= 1,000,000 log commits/sec across 10k Raft
+groups on a single TPU v5e chip, p99 commit latency tracked.
+
+Method: the batched engine at G=10,000 x P=3 with a saturating Start()
+firehose, run as device-resident lax.scan chunks (zero host round trips
+between ticks).  Committed entries are counted exactly from the commit
+frontier delta; p99 commit latency is the measured per-tick wall time
+times the commit pipeline depth in ticks (append is sent the tick it is
+ingested, acked next tick, committed the tick after: depth 2, +1 tick
+of ingestion queueing at saturation).
+
+Prints ONE JSON line on stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from multiraft_tpu.engine.core import (
+        EngineConfig,
+        empty_mailbox,
+        init_state,
+        run_ticks,
+    )
+
+    platform = jax.devices()[0].platform
+    log(f"bench: devices={jax.devices()} platform={platform}")
+
+    G = int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
+    P = int(os.environ.get("MULTIRAFT_BENCH_P", "3"))
+    cfg = EngineConfig(G=G, P=P, L=64, E=16, INGEST=16, HB_TICKS=9)
+    key = jax.random.PRNGKey(7)
+    state = init_state(cfg, key)
+    inbox = empty_mailbox(cfg)
+
+    CHUNK = int(os.environ.get("MULTIRAFT_BENCH_CHUNK", "200"))
+    N_CHUNKS = int(os.environ.get("MULTIRAFT_BENCH_CHUNKS", "5"))
+
+    # Warm-up: elect leaders everywhere; same static (n_ticks, ingest)
+    # signature as the timed loop so the timed chunks hit the jit cache.
+    t0 = time.perf_counter()
+    state, inbox = run_ticks(cfg, state, inbox, CHUNK, 0, jax.random.fold_in(key, 1))
+    jax.block_until_ready(state.term)
+    leaders = int(jnp.sum((state.role == 2) & state.alive))
+    log(
+        f"bench: warmup done in {time.perf_counter()-t0:.1f}s "
+        f"(compile incl.), leaders={leaders}/{G}"
+    )
+
+    # Fill the pipeline with load before timing (compiles the loaded
+    # variant).
+    state, inbox = run_ticks(
+        cfg, state, inbox, CHUNK, cfg.INGEST, jax.random.fold_in(key, 2)
+    )
+    jax.block_until_ready(state.term)
+    commit_start = np.asarray(jnp.max(state.commit, axis=1)).astype(np.int64)
+    tick_times = []
+    t_begin = time.perf_counter()
+    for c in range(N_CHUNKS):
+        t0 = time.perf_counter()
+        state, inbox = run_ticks(
+            cfg, state, inbox, CHUNK, cfg.INGEST, jax.random.fold_in(key, 10 + c)
+        )
+        jax.block_until_ready(state.term)
+        dt = time.perf_counter() - t0
+        tick_times.append(dt / CHUNK)
+        log(f"bench: chunk {c+1}/{N_CHUNKS}: {dt:.3f}s ({dt/CHUNK*1e3:.3f} ms/tick)")
+    elapsed = time.perf_counter() - t_begin
+    commit_end = np.asarray(jnp.max(state.commit, axis=1)).astype(np.int64)
+
+    total_commits = int((commit_end - commit_start).sum())
+    commits_per_sec = total_commits / elapsed
+    # Commit latency: ingest->send (same tick), follower append (+1),
+    # reply+quorum commit (+1) = 2 ticks pipeline + ~1 tick queue wait.
+    per_tick_p99 = float(np.percentile(np.array(tick_times), 99))
+    p99_latency_ms = 3 * per_tick_p99 * 1e3
+    leaders = int(jnp.sum((state.role == 2) & state.alive))
+    log(
+        f"bench: {total_commits} commits in {elapsed:.2f}s over {G} groups "
+        f"(leaders={leaders}), p99 commit latency ~{p99_latency_ms:.2f} ms"
+    )
+
+    baseline = 1_000_000.0  # BASELINE.md north star
+    print(
+        json.dumps(
+            {
+                "metric": f"log_commits_per_sec_{G}_groups_{platform}",
+                "value": round(commits_per_sec, 1),
+                "unit": "commits/s",
+                "vs_baseline": round(commits_per_sec / baseline, 3),
+                "p99_commit_latency_ms": round(p99_latency_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,577 @@
+"""The batched TPU consensus engine — multi-Raft as one jitted tick.
+
+This is the TPU-native inversion of the reference's runtime: instead of
+3+2(n−1) goroutines per Raft instance (reference: raft/raft.go:51-87),
+*every replica of every group* lives in struct-of-arrays state tensors
+with a leading ``(G, P)`` = (groups, peers) axis, and one pure
+``tick(state, inbox, ...) → (state, outbox, metrics)`` function advances
+them all synchronously.  RPCs are dense per-edge mailboxes
+``[G, src, dst]``; the labrpc fault model becomes masks (drop,
+partition) applied between outbox and inbox (SURVEY §2.2, §5.8).
+
+Per-phase mapping to the reference:
+
+* vote request/reply handling  — raft/raft_election.go:4-77
+* append request handling incl. conflict backoff
+                               — raft/raft_append_entry.go:108-162
+* reply processing + quorum commit advance (the north-star kernel)
+                               — raft/raft_append_entry.go:66-105
+* snapshot fast-forward        — raft/raft_snapshot.go:15-54 (the
+  ``snap`` flag compresses InstallSnapshot into the append channel;
+  snapshot *data* lives host-side keyed by (group, index))
+
+Deliberate divergences (documented):
+
+* Conflict backoff jumps straight to ``min(prev, commit+1)`` — the
+  follower's committed prefix provably matches the leader, so
+  repositioning takes O(1) round trips instead of the reference's
+  term-scan (raft/raft_append_entry.go:136-143); data catch-up then
+  streams at ``E`` entries per message.
+* Election timeouts are integer ticks with per-replica jitter drawn
+  from a counter-based PRNG (replaces the reference's wall-clock reseed
+  quirk, raft/raft.go:46-50).
+* Logs are fixed-capacity rings with ``base`` rebase; compaction
+  advances ``base`` over the applied prefix automatically (the
+  service-driven Snapshot() of the reference becomes a frontier the
+  host reads).
+
+Sharding: every tensor is independent along G, so the whole engine
+shards over a ``Mesh`` 'groups' axis with zero collectives — consensus
+*within* a group never crosses a shard boundary.  (Cross-host traffic
+only appears when a logical group spans hosts, which the transport
+layer handles, not the kernel.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["EngineConfig", "EngineState", "Mailbox", "init_state", "empty_mailbox", "tick"]
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape/timing parameters (hashable: passed as a jit static).
+
+    Timing is in ticks; with the reference's wall-clock mapping of
+    10 ms/tick these defaults reproduce its 90 ms heartbeat and
+    300–600 ms election window (reference: raft/raft.go:42-50).  The
+    bench shrinks the tick period to whatever the chip sustains.
+    """
+
+    G: int = 8  # groups
+    P: int = 3  # peers per group
+    L: int = 64  # log ring capacity per replica
+    E: int = 8  # max entries per append message
+    INGEST: int = 8  # max Start() commands accepted per group per tick
+    HB_TICKS: int = 9
+    ELECT_MIN: int = 30
+    ELECT_MAX: int = 60
+
+    @property
+    def quorum(self) -> int:
+        return self.P // 2 + 1
+
+
+class EngineState(NamedTuple):
+    """Struct-of-arrays Raft state, leading axes (G, P)."""
+
+    tick_no: jnp.ndarray  # i32 scalar
+    term: jnp.ndarray  # i32[G,P]
+    voted_for: jnp.ndarray  # i32[G,P] (-1 = none)
+    role: jnp.ndarray  # i32[G,P]
+    commit: jnp.ndarray  # i32[G,P]
+    applied: jnp.ndarray  # i32[G,P]
+    base: jnp.ndarray  # i32[G,P] snapshot index (log ring floor)
+    base_term: jnp.ndarray  # i32[G,P]
+    log_len: jnp.ndarray  # i32[G,P] entries above base
+    log_term: jnp.ndarray  # i32[G,P,L] ring: abs index i at slot i % L
+    next_idx: jnp.ndarray  # i32[G,P,P] leader p's next for peer q
+    match_idx: jnp.ndarray  # i32[G,P,P]
+    votes: jnp.ndarray  # bool[G,P,P] candidate p's votes from q
+    elect_dl: jnp.ndarray  # i32[G,P] election deadline tick
+    hb_due: jnp.ndarray  # i32[G,P] next heartbeat tick
+    alive: jnp.ndarray  # bool[G,P] fault-injection: replica up
+
+
+class Mailbox(NamedTuple):
+    """Dense per-edge messages, all ``[G, src, dst]`` (+ trailing dims)."""
+
+    # RequestVote (reference: raft/raft_rpc.go RequestVote args/reply)
+    vr_active: jnp.ndarray  # bool[G,P,P]
+    vr_term: jnp.ndarray  # i32[G,P,P]
+    vr_last_idx: jnp.ndarray  # i32[G,P,P]
+    vr_last_term: jnp.ndarray  # i32[G,P,P]
+    vp_active: jnp.ndarray  # bool[G,P,P]  src=voter, dst=candidate
+    vp_term: jnp.ndarray  # i32[G,P,P]
+    vp_granted: jnp.ndarray  # bool[G,P,P]
+    # AppendEntries / InstallSnapshot (snap flag)
+    ar_active: jnp.ndarray  # bool[G,P,P]
+    ar_term: jnp.ndarray  # i32[G,P,P]
+    ar_prev_idx: jnp.ndarray  # i32[G,P,P]
+    ar_prev_term: jnp.ndarray  # i32[G,P,P]
+    ar_n: jnp.ndarray  # i32[G,P,P] entries carried (<= E)
+    ar_terms: jnp.ndarray  # i32[G,P,P,E]
+    ar_commit: jnp.ndarray  # i32[G,P,P] leader commit
+    ar_snap: jnp.ndarray  # bool[G,P,P] InstallSnapshot fast-forward
+    ap_active: jnp.ndarray  # bool[G,P,P]  src=follower, dst=leader
+    ap_term: jnp.ndarray  # i32[G,P,P]
+    ap_success: jnp.ndarray  # bool[G,P,P]
+    ap_match: jnp.ndarray  # i32[G,P,P]
+    ap_conflict: jnp.ndarray  # i32[G,P,P]
+
+
+def init_state(cfg: EngineConfig, key: jax.Array) -> EngineState:
+    G, P, L = cfg.G, cfg.P, cfg.L
+    z = lambda *s: jnp.zeros(s, jnp.int32)
+    deadlines = jax.random.randint(
+        key, (G, P), cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32
+    )
+    return EngineState(
+        tick_no=jnp.int32(0),
+        term=z(G, P),
+        voted_for=jnp.full((G, P), -1, jnp.int32),
+        role=z(G, P),
+        commit=z(G, P),
+        applied=z(G, P),
+        base=z(G, P),
+        base_term=z(G, P),
+        log_len=z(G, P),
+        log_term=z(G, P, L),
+        next_idx=jnp.ones((G, P, P), jnp.int32),
+        match_idx=z(G, P, P),
+        votes=jnp.zeros((G, P, P), bool),
+        elect_dl=deadlines,
+        hb_due=z(G, P),
+        alive=jnp.ones((G, P), bool),
+    )
+
+
+def empty_mailbox(cfg: EngineConfig) -> Mailbox:
+    G, P, E = cfg.G, cfg.P, cfg.E
+    b = lambda *s: jnp.zeros(s, bool)
+    z = lambda *s: jnp.zeros(s, jnp.int32)
+    return Mailbox(
+        vr_active=b(G, P, P), vr_term=z(G, P, P),
+        vr_last_idx=z(G, P, P), vr_last_term=z(G, P, P),
+        vp_active=b(G, P, P), vp_term=z(G, P, P), vp_granted=b(G, P, P),
+        ar_active=b(G, P, P), ar_term=z(G, P, P),
+        ar_prev_idx=z(G, P, P), ar_prev_term=z(G, P, P),
+        ar_n=z(G, P, P), ar_terms=z(G, P, P, E), ar_commit=z(G, P, P),
+        ar_snap=b(G, P, P),
+        ap_active=b(G, P, P), ap_term=z(G, P, P), ap_success=b(G, P, P),
+        ap_match=z(G, P, P), ap_conflict=z(G, P, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring-log helpers (the device mirror of raft/raft_log.go's index algebra)
+# ---------------------------------------------------------------------------
+
+
+def _term_at(cfg: EngineConfig, state: EngineState, idx: jnp.ndarray) -> jnp.ndarray:
+    """Term of absolute index ``idx`` per replica; idx shape [G,P].
+    idx == base → base_term; out-of-window reads return 0 (callers mask)."""
+    slot = jnp.mod(idx, cfg.L)
+    gathered = jnp.take_along_axis(state.log_term, slot[..., None], axis=-1)[..., 0]
+    return jnp.where(idx == state.base, state.base_term, gathered)
+
+
+def _last_index(state: EngineState) -> jnp.ndarray:
+    return state.base + state.log_len
+
+
+# ---------------------------------------------------------------------------
+# The tick
+# ---------------------------------------------------------------------------
+
+
+def tick_impl(
+    cfg: EngineConfig,
+    state: EngineState,
+    inbox: Mailbox,
+    new_cmds: jnp.ndarray,  # i32[G]: Start() firehose, appended at leaders
+    key: jax.Array,
+) -> Tuple[EngineState, Mailbox, Dict[str, jnp.ndarray]]:
+    G, P, L, E = cfg.G, cfg.P, cfg.L, cfg.E
+    out = empty_mailbox(cfg)
+    now = state.tick_no + 1
+    commit_before = state.commit
+
+    gi = jnp.arange(G)[:, None]  # [G,1] group index grid
+    pi = jnp.arange(P)[None, :]  # [1,P] replica index grid
+
+    # ---- 1. vote requests (reference: raft/raft_election.go:54-77) ----
+    # Sequential over src so simultaneous candidacies serialize per dst.
+    for s in range(P):
+        active = inbox.vr_active[:, s, :] & state.alive  # [G,P] at dst
+        m_term = inbox.vr_term[:, s, :]
+        # Step down on higher term.
+        higher = active & (m_term > state.term)
+        state = state._replace(
+            term=jnp.where(higher, m_term, state.term),
+            voted_for=jnp.where(higher, -1, state.voted_for),
+            role=jnp.where(higher, FOLLOWER, state.role),
+        )
+        last_idx = _last_index(state)
+        last_term = _term_at(cfg, state, last_idx)
+        up_to_date = (inbox.vr_last_term[:, s, :] > last_term) | (
+            (inbox.vr_last_term[:, s, :] == last_term)
+            & (inbox.vr_last_idx[:, s, :] >= last_idx)
+        )
+        grant = (
+            active
+            & (m_term == state.term)
+            & ((state.voted_for == -1) | (state.voted_for == s))
+            & up_to_date
+        )
+        jitter = jax.random.randint(
+            jax.random.fold_in(key, 101 + s), (G, P),
+            cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
+        )
+        state = state._replace(
+            voted_for=jnp.where(grant, s, state.voted_for),
+            elect_dl=jnp.where(grant, now + jitter, state.elect_dl),
+        )
+        # Reply: out.vp[g, dst(voter)=·, dst_slot=s(candidate)]
+        out = out._replace(
+            vp_active=out.vp_active.at[:, :, s].set(active),
+            vp_term=out.vp_term.at[:, :, s].set(state.term),
+            vp_granted=out.vp_granted.at[:, :, s].set(grant),
+        )
+
+    # ---- 2. vote replies → tally → leadership
+    # (reference: raft/raft_election.go:27-49) ----
+    for s in range(P):
+        active = inbox.vp_active[:, s, :] & state.alive  # at candidate dst
+        m_term = inbox.vp_term[:, s, :]
+        higher = active & (m_term > state.term)
+        state = state._replace(
+            term=jnp.where(higher, m_term, state.term),
+            voted_for=jnp.where(higher, -1, state.voted_for),
+            role=jnp.where(higher, FOLLOWER, state.role),
+        )
+        good = (
+            active
+            & (state.role == CANDIDATE)
+            & (m_term == state.term)
+            & inbox.vp_granted[:, s, :]
+        )
+        state = state._replace(
+            votes=state.votes.at[:, :, s].set(state.votes[:, :, s] | good)
+        )
+    n_votes = jnp.sum(state.votes, axis=-1)  # [G,P]
+    become_leader = (
+        (state.role == CANDIDATE) & state.alive & (n_votes >= cfg.quorum)
+    )
+    last_idx = _last_index(state)
+    state = state._replace(
+        role=jnp.where(become_leader, LEADER, state.role),
+        next_idx=jnp.where(
+            become_leader[..., None], (last_idx + 1)[..., None], state.next_idx
+        ),
+        match_idx=jnp.where(
+            become_leader[..., None],
+            jnp.where(pi[None] == pi[..., None], last_idx[..., None], 0),
+            state.match_idx,
+        ),
+        hb_due=jnp.where(become_leader, now, state.hb_due),  # immediate HB
+    )
+
+    # ---- 3. append requests (reference: raft/raft_append_entry.go:108-162) ----
+    for s in range(P):
+        active = inbox.ar_active[:, s, :] & state.alive  # [G,P] at dst
+        m_term = inbox.ar_term[:, s, :]
+        stale = active & (m_term < state.term)
+        ok = active & ~stale
+        # Accept leadership: step down, reset election timer.
+        higher = ok & (m_term > state.term)
+        state = state._replace(
+            term=jnp.where(higher, m_term, state.term),
+            voted_for=jnp.where(higher, -1, state.voted_for),
+            role=jnp.where(ok, FOLLOWER, state.role),
+        )
+        jitter = jax.random.randint(
+            jax.random.fold_in(key, 201 + s), (G, P),
+            cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
+        )
+        state = state._replace(
+            elect_dl=jnp.where(ok, now + jitter, state.elect_dl)
+        )
+
+        prev = inbox.ar_prev_idx[:, s, :]
+        prev_t = inbox.ar_prev_term[:, s, :]
+        n_ent = inbox.ar_n[:, s, :]
+        snap = inbox.ar_snap[:, s, :]
+
+        # InstallSnapshot fast-forward (reference: raft/raft_snapshot.go:15-54).
+        do_snap = ok & snap & (prev > state.commit)
+        state = state._replace(
+            base=jnp.where(do_snap, prev, state.base),
+            base_term=jnp.where(do_snap, prev_t, state.base_term),
+            log_len=jnp.where(do_snap, 0, state.log_len),
+            commit=jnp.where(do_snap, prev, state.commit),
+            applied=jnp.where(do_snap, prev, state.applied),
+        )
+        snap_handled = ok & snap
+
+        # last AFTER any snapshot rebase so non-append rows keep a
+        # consistent (base, len) pair.
+        last = _last_index(state)
+        apn = ok & ~snap
+        in_window = (prev >= state.base) & (prev <= last)
+        match = apn & in_window & (_term_at(cfg, state, prev) == prev_t)
+
+        # Write entries prev+1..prev+n, truncating only at a genuine
+        # conflict (reference: raft/raft_append_entry.go:146-155).
+        conflict_any = jnp.zeros((G, P), bool)
+        log = state.log_term
+        for e in range(E):
+            idx = prev + 1 + e
+            in_msg = match & (e < n_ent)
+            slot = jnp.mod(idx, L)
+            old = jnp.take_along_axis(log, slot[..., None], axis=-1)[..., 0]
+            incoming = inbox.ar_terms[:, s, :, e]
+            exists = idx <= last
+            conflict_any = conflict_any | (in_msg & exists & (old != incoming))
+            write = in_msg
+            newval = jnp.where(write, incoming, old)
+            log = log.at[gi, pi, slot].set(newval)
+        state = state._replace(log_term=log)
+        msg_last = prev + n_ent
+        new_last = jnp.where(
+            match,
+            jnp.where(conflict_any, msg_last, jnp.maximum(last, msg_last)),
+            last,
+        )
+        state = state._replace(log_len=new_last - state.base)
+        # Follower commit (reference: raft/raft_append_entry.go:157-160).
+        new_commit = jnp.minimum(inbox.ar_commit[:, s, :], msg_last)
+        state = state._replace(
+            commit=jnp.where(
+                match & (new_commit > state.commit), new_commit, state.commit
+            )
+        )
+
+        # Conflict backoff: the committed prefix always matches, so
+        # reposition to min(prev, commit+1) in one round (divergence
+        # from the reference's term scan — see module docstring).
+        conflict_idx = jnp.minimum(prev, state.commit + 1)
+        reply_active = active
+        success = match | snap_handled
+        reply_match = jnp.where(snap_handled, prev, msg_last)
+        out = out._replace(
+            ap_active=out.ap_active.at[:, :, s].set(reply_active),
+            ap_term=out.ap_term.at[:, :, s].set(state.term),
+            ap_success=out.ap_success.at[:, :, s].set(success),
+            ap_match=out.ap_match.at[:, :, s].set(reply_match),
+            ap_conflict=out.ap_conflict.at[:, :, s].set(conflict_idx),
+        )
+
+    # ---- 4. append replies + quorum commit advance
+    # (reference: raft/raft_append_entry.go:66-105 — the north-star) ----
+    for s in range(P):
+        active = inbox.ap_active[:, s, :] & state.alive  # at leader dst
+        m_term = inbox.ap_term[:, s, :]
+        higher = active & (m_term > state.term)
+        state = state._replace(
+            term=jnp.where(higher, m_term, state.term),
+            voted_for=jnp.where(higher, -1, state.voted_for),
+            role=jnp.where(higher, FOLLOWER, state.role),
+        )
+        good = active & (state.role == LEADER) & (m_term == state.term)
+        succ = good & inbox.ap_success[:, s, :]
+        fail = good & ~inbox.ap_success[:, s, :]
+        new_match = jnp.maximum(state.match_idx[:, :, s], inbox.ap_match[:, s, :])
+        state = state._replace(
+            match_idx=state.match_idx.at[:, :, s].set(
+                jnp.where(succ, new_match, state.match_idx[:, :, s])
+            ),
+        )
+        state = state._replace(
+            next_idx=state.next_idx.at[:, :, s].set(
+                jnp.where(
+                    succ,
+                    new_match + 1,
+                    jnp.where(
+                        fail,
+                        jnp.clip(inbox.ap_conflict[:, s, :], 1, None),
+                        state.next_idx[:, :, s],
+                    ),
+                )
+            )
+        )
+
+    last_idx = _last_index(state)
+    is_leader = (state.role == LEADER) & state.alive
+    # Self always matches its own last entry.
+    own = pi[None] == pi[..., None]  # [1,P,P] diag mask
+    eff_match = jnp.where(own, last_idx[..., None], state.match_idx)
+    sorted_match = jnp.sort(eff_match, axis=-1)  # ascending
+    quorum_idx = sorted_match[:, :, P - cfg.quorum]  # the median-ish index
+    # Current-term guard (reference: raft/raft_append_entry.go:98).
+    guard = _term_at(cfg, state, quorum_idx) == state.term
+    new_commit = jnp.where(
+        is_leader & guard, jnp.maximum(state.commit, quorum_idx), state.commit
+    )
+    state = state._replace(commit=new_commit)
+
+    # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----
+    timeout = state.alive & (now >= state.elect_dl) & (state.role != LEADER)
+    jitter = jax.random.randint(
+        jax.random.fold_in(key, 7), (G, P),
+        cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
+    )
+    state = state._replace(
+        term=jnp.where(timeout, state.term + 1, state.term),
+        role=jnp.where(timeout, CANDIDATE, state.role),
+        voted_for=jnp.where(timeout, pi, state.voted_for),
+        votes=jnp.where(timeout[..., None], own[0][None], state.votes),
+        elect_dl=jnp.where(timeout, now + jitter, state.elect_dl),
+    )
+    last_idx = _last_index(state)
+    last_term = _term_at(cfg, state, last_idx)
+    # Vote requests to every peer (dst masked to alive senders; self slot
+    # excluded).
+    vr_act = timeout[:, :, None] & ~own & state.alive[:, :, None]
+    out = out._replace(
+        vr_active=vr_act,
+        vr_term=jnp.broadcast_to(state.term[:, :, None], (G, P, P)),
+        vr_last_idx=jnp.broadcast_to(last_idx[:, :, None], (G, P, P)),
+        vr_last_term=jnp.broadcast_to(last_term[:, :, None], (G, P, P)),
+    )
+
+    # ---- 5b. Start() ingestion: leaders append the firehose ----
+    is_leader = (state.role == LEADER) & state.alive  # [G,P]
+    capacity = jnp.maximum(L - 2 - cfg.E - state.log_len, 0)
+    want = jnp.minimum(new_cmds[:, None], cfg.INGEST)  # [G,P]
+    accept = jnp.where(is_leader, jnp.minimum(want, capacity), 0)
+    log = state.log_term
+    last_idx = _last_index(state)
+    for e in range(cfg.INGEST):
+        idx = last_idx + 1 + e
+        write = e < accept
+        slot = jnp.mod(idx, L)
+        old = jnp.take_along_axis(log, slot[..., None], axis=-1)[..., 0]
+        log = log.at[gi, pi, slot].set(jnp.where(write, state.term, old))
+    state = state._replace(log_term=log, log_len=state.log_len + accept)
+    # Group accepted count (for host payload binding): at most one
+    # leader per group is alive; sum collapses the P axis.
+    accepted_per_group = jnp.sum(accept, axis=1)  # i32[G]
+    start_index = jnp.sum(jnp.where(accept > 0, last_idx, 0), axis=1)
+
+    # ---- 5c. append sends: heartbeat + lag repair
+    # (reference: raft/raft_append_entry.go:4-65; heartbeats are full
+    # appends carrying missing suffix) ----
+    last_idx = _last_index(state)
+    is_leader = (state.role == LEADER) & state.alive
+    hb_fire = is_leader & (now >= state.hb_due)
+    lag = state.next_idx <= last_idx[:, :, None]  # [G,P,P] dst lags
+    send = (hb_fire[:, :, None] | (is_leader[:, :, None] & lag)) & ~own
+    send = send & state.alive[:, :, None]
+    prev = state.next_idx - 1  # [G,P,P] per (leader, dst)
+    need_snap = prev < state.base[:, :, None]
+    prev = jnp.where(need_snap, state.base[:, :, None], prev)
+    # prev term per (g, p, dst): gather from sender's ring.
+    slot = jnp.mod(prev, L)
+    prev_term = jnp.take_along_axis(state.log_term, slot, axis=-1)
+    prev_term = jnp.where(
+        prev == state.base[:, :, None], state.base_term[:, :, None], prev_term
+    )
+    n_send = jnp.where(
+        need_snap, 0, jnp.clip(last_idx[:, :, None] - prev, 0, E)
+    )
+    terms = []
+    for e in range(E):
+        idx = prev + 1 + e
+        t = jnp.take_along_axis(state.log_term, jnp.mod(idx, L), axis=-1)
+        terms.append(jnp.where(e < n_send, t, 0))
+    ar_terms = jnp.stack(terms, axis=-1)  # [G,P,P,E]
+    out = out._replace(
+        ar_active=send,
+        ar_term=jnp.broadcast_to(state.term[:, :, None], (G, P, P)),
+        ar_prev_idx=prev,
+        ar_prev_term=prev_term,
+        ar_n=n_send,
+        ar_terms=ar_terms,
+        ar_commit=jnp.broadcast_to(state.commit[:, :, None], (G, P, P)),
+        ar_snap=need_snap & send,
+    )
+    state = state._replace(
+        hb_due=jnp.where(hb_fire, now + cfg.HB_TICKS, state.hb_due)
+    )
+
+    # ---- 6. apply frontier + ring compaction ----
+    state = state._replace(applied=jnp.maximum(state.applied, state.commit))
+    # Compact when headroom shrinks: advance base over the applied
+    # prefix (device analog of service-driven Snapshot(),
+    # reference: raft/raft_snapshot.go:3-13).
+    headroom = L - state.log_len
+    need = headroom < (cfg.E + cfg.INGEST + 2)
+    target = jnp.minimum(state.applied, _last_index(state))
+    new_base = jnp.where(need, jnp.maximum(state.base, target), state.base)
+    new_base_term = _term_at(cfg, state, new_base)
+    state = state._replace(
+        log_len=_last_index(state) - new_base,
+        base=new_base,
+        base_term=new_base_term,
+    )
+
+    state = state._replace(tick_no=now)
+
+    leader_commit_delta = jnp.where(
+        (state.role == LEADER) & state.alive,
+        state.commit - commit_before,
+        0,
+    )
+    metrics = {
+        "commits": jnp.sum(jnp.maximum(leader_commit_delta, 0)),
+        "leaders": jnp.sum((state.role == LEADER) & state.alive),
+        "max_term": jnp.max(state.term),
+        "accepted": accepted_per_group,
+        "start_index": start_index,
+        "commit_index": jnp.max(state.commit, axis=1),  # i32[G]
+    }
+    return state, out, metrics
+
+
+tick = functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))(
+    tick_impl
+)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(1, 2))
+def run_ticks(
+    cfg: EngineConfig,
+    state: EngineState,
+    inbox: Mailbox,
+    n_ticks: int,
+    ingest_per_tick: int,
+    key: jax.Array,
+) -> Tuple[EngineState, Mailbox]:
+    """Device-resident multi-tick loop for the bench path: ``n_ticks``
+    consensus rounds under one ``lax.scan`` with a constant Start()
+    firehose — zero host round-trips between ticks (the whole point of
+    the batched design: SURVEY §7.1's global synchronous tick loop).
+
+    Committed-entry totals are exact from state alone:
+    ``sum_g max_p commit[g,p]`` before vs after."""
+    new_cmds = jnp.full((cfg.G,), ingest_per_tick, jnp.int32)
+
+    def body(carry, i):
+        st, mb = carry
+        k = jax.random.fold_in(key, i)
+        st, mb, _ = tick_impl(cfg, st, mb, new_cmds, k)
+        return (st, mb), None
+
+    (state, inbox), _ = jax.lax.scan(
+        body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
+    )
+    return state, inbox

@@ -1,0 +1,230 @@
+"""Host-side driver for the batched engine.
+
+Owns the tick loop: feeds the outbox back as the next inbox through the
+tensorized fault model (drop masks + liveness — the labrpc semantics of
+SURVEY §2.2 in dense form), maintains the Start() backlog and the
+host-side command payload store keyed ``(group, index)`` (the device
+only consensus-orders terms/indices), and accumulates metrics.
+
+This is also where crash/restart surgery happens: a "crashed" replica is
+marked dead (mask) and, on restart, its volatile state is reset while
+its persistent columns (term, vote, log, base) survive — the tensor
+analog of the reference's Persister carryover
+(reference: raft/config.go:113-142).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    EngineConfig,
+    EngineState,
+    Mailbox,
+    empty_mailbox,
+    init_state,
+    tick,
+)
+
+__all__ = ["EngineDriver", "apply_faults"]
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def apply_faults(
+    mailbox: Mailbox, key: jax.Array, drop_prob: jnp.ndarray, cfg: EngineConfig
+) -> Mailbox:
+    """Drop each in-flight message independently with ``drop_prob`` —
+    the dense-tensor form of labrpc's unreliable mode
+    (reference: labrpc/labrpc.go:228-239,279-284; request and reply
+    drops both land here because each direction is its own edge-slot)."""
+    shape = (cfg.G, cfg.P, cfg.P)
+    k1, k2, k3 = jax.random.split(key, 3)
+    keep_vr = jax.random.uniform(k1, shape) >= drop_prob
+    keep_vp = jax.random.uniform(k2, shape) >= drop_prob
+    keep_ap = jax.random.uniform(k3, shape) >= drop_prob
+    k4 = jax.random.fold_in(k1, 9)
+    keep_ar = jax.random.uniform(k4, shape) >= drop_prob
+    return mailbox._replace(
+        vr_active=mailbox.vr_active & keep_vr,
+        vp_active=mailbox.vp_active & keep_vp,
+        ar_active=mailbox.ar_active & keep_ar,
+        ap_active=mailbox.ap_active & keep_ap,
+    )
+
+
+class EngineDriver:
+    def __init__(self, cfg: EngineConfig, seed: int = 0) -> None:
+        self.cfg = cfg
+        self.key = jax.random.PRNGKey(seed)
+        self.state: EngineState = init_state(cfg, jax.random.fold_in(self.key, 0))
+        self.inbox: Mailbox = empty_mailbox(cfg)
+        self.drop_prob = 0.0
+        self.total_commits = 0
+        self.backlog = np.zeros(cfg.G, np.int64)  # pending Start()s
+        # Host-side payloads: (group, index) -> command.  The device
+        # orders (term, index); data stays here (SURVEY §7.1).
+        self.payloads: Dict[tuple, Any] = {}
+        self._pending_payloads: Dict[int, list] = defaultdict(list)
+        self.applied_frontier = np.zeros(cfg.G, np.int64)
+        self.last_metrics: Dict[str, Any] = {}
+
+    # -- fault injection --------------------------------------------------
+
+    def set_alive(self, g: int, p: int, alive: bool) -> None:
+        """Partition/crash a replica (mask form of per-edge disable,
+        reference: labrpc enable/disable)."""
+        self.state = self.state._replace(
+            alive=self.state.alive.at[g, p].set(alive)
+        )
+
+    def restart_replica(self, g: int, p: int) -> None:
+        """Crash-restart: persistent columns (term/vote/log/base/commit
+        floor) survive; volatile leadership state resets
+        (reference: raft/raft.go:69 readPersist on Make)."""
+        st = self.state
+        self.state = st._replace(
+            role=st.role.at[g, p].set(FOLLOWER),
+            votes=st.votes.at[g, p].set(False),
+            # Applied rewinds to the snapshot floor: the service replays
+            # the log above base (commit knowledge is volatile in Raft).
+            commit=st.commit.at[g, p].set(st.base[g, p]),
+            applied=st.applied.at[g, p].set(st.base[g, p]),
+            alive=st.alive.at[g, p].set(True),
+        )
+        # In-flight messages to/from the old incarnation die.
+        self.inbox = self._mask_edges(self.inbox, g, p)
+
+    def _mask_edges(self, mb: Mailbox, g: int, p: int) -> Mailbox:
+        def mask(a):
+            return a.at[g, p, :].set(False).at[g, :, p].set(False)
+
+        return mb._replace(
+            vr_active=mask(mb.vr_active),
+            vp_active=mask(mb.vp_active),
+            ar_active=mask(mb.ar_active),
+            ap_active=mask(mb.ap_active),
+        )
+
+    # -- Start() ----------------------------------------------------------
+
+    def start(self, g: int, command: Any = None) -> None:
+        """Queue a command for group g (the synthetic firehose feeds
+        this in bulk)."""
+        self.backlog[g] += 1
+        self._pending_payloads[g].append(command)
+
+    def start_bulk(self, counts: np.ndarray) -> None:
+        self.backlog += counts
+
+    # -- tick loop --------------------------------------------------------
+
+    def step(self, n: int = 1) -> Dict[str, Any]:
+        cfg = self.cfg
+        for _ in range(n):
+            self._tick_host = getattr(self, "_tick_host", 0) + 1
+            tick_key = jax.random.fold_in(self.key, self._tick_host)
+            have_backlog = bool(self.backlog.any())
+            new_cmds = jnp.asarray(
+                np.minimum(self.backlog, cfg.INGEST), jnp.int32
+            ) if have_backlog else jnp.zeros(cfg.G, jnp.int32)
+            state, outbox, metrics = tick(
+                cfg, self.state, self.inbox, new_cmds, tick_key
+            )
+            if self.drop_prob > 0.0:
+                outbox = apply_faults(
+                    outbox,
+                    jax.random.fold_in(tick_key, 0xFA),
+                    jnp.float32(self.drop_prob),
+                    cfg,
+                )
+            self.state, self.inbox = state, outbox
+            if have_backlog:
+                # Host sync only while commands are in flight.
+                accepted = np.asarray(metrics["accepted"])
+                starts = np.asarray(metrics["start_index"])
+                for g in np.nonzero(accepted)[0]:
+                    k = int(accepted[g])
+                    self.backlog[g] -= k
+                    pend = self._pending_payloads.get(int(g))
+                    if pend:
+                        s0 = int(starts[g])
+                        for off in range(min(k, len(pend))):
+                            self.payloads[(int(g), s0 + 1 + off)] = pend.pop(0)
+            # Accumulate on device; converted lazily by readers.
+            self._commits_dev = (
+                getattr(self, "_commits_dev", jnp.int32(0)) + metrics["commits"]
+            )
+            self.last_metrics = metrics
+        return self.last_metrics
+
+    @property
+    def commits_total(self) -> int:
+        return int(getattr(self, "_commits_dev", 0)) + self.total_commits
+
+    def run_until_quiet_leaders(self, max_ticks: int = 500) -> bool:
+        """Advance until every group has exactly one live leader."""
+        stride = 5  # check every few ticks: readbacks are host syncs
+        for _ in range(0, max_ticks, stride):
+            self.step(stride)
+            if self.leaders_per_group().min() >= 1:
+                if self.leaders_at_max_term_per_group().max() <= 1:
+                    return True
+        return False
+
+    # -- inspection (host readbacks; test/debug path) ---------------------
+
+    def np_state(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.state._asdict().items()}
+
+    def leaders_per_group(self) -> np.ndarray:
+        st = self.np_state()
+        return (
+            ((st["role"] == LEADER) & st["alive"]).sum(axis=1)
+        )
+
+    def leaders_at_max_term_per_group(self) -> np.ndarray:
+        st = self.np_state()
+        lead = (st["role"] == LEADER) & st["alive"]
+        # Leaders are unique per *term*; count leaders in the max term.
+        max_term = np.where(lead, st["term"], -1).max(axis=1, keepdims=True)
+        return (lead & (st["term"] == max_term)).sum(axis=1)
+
+    def leader_of(self, g: int) -> Optional[int]:
+        st = self.np_state()
+        lead = np.nonzero((st["role"][g] == LEADER) & st["alive"][g])[0]
+        if len(lead) == 0:
+            return None
+        terms = st["term"][g][lead]
+        return int(lead[np.argmax(terms)])
+
+    def log_terms_of(self, g: int, p: int) -> Dict[int, int]:
+        """Absolute index -> term for replica (g, p)'s ring window."""
+        st = self.np_state()
+        base, ln = int(st["base"][g, p]), int(st["log_len"][g, p])
+        ring = st["log_term"][g, p]
+        return {
+            i: int(ring[i % self.cfg.L]) for i in range(base + 1, base + ln + 1)
+        }
+
+    def check_log_matching(self, g: int) -> None:
+        """Safety: all replicas agree on terms up to their common window
+        below min(commit) (Log Matching + State Machine Safety)."""
+        st = self.np_state()
+        commits = st["commit"][g]
+        floor = int(min(commits))
+        views = [self.log_terms_of(g, p) for p in range(self.cfg.P)]
+        bases = st["base"][g]
+        for i in range(int(max(bases)) + 1, floor + 1):
+            terms = {v[i] for v in views if i in v}
+            assert len(terms) <= 1, (
+                f"group {g}: index {i} has conflicting committed terms {terms}"
+            )

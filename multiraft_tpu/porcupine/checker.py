@@ -154,7 +154,14 @@ def check_operations(
     deadline = _time.monotonic() + timeout if timeout is not None else None
     unknown = False
     for part in model.partitions(history):
-        res = _check_single(model, part, deadline)
+        if deadline is not None and _time.monotonic() > deadline:
+            unknown = True
+            break
+        res = None
+        if model.native_check is not None:
+            res = model.native_check(part, deadline)
+        if res is None:
+            res = _check_single(model, part, deadline)
         if res is CheckResult.ILLEGAL:
             return CheckResult.ILLEGAL
         if res is CheckResult.UNKNOWN:

@@ -13,7 +13,15 @@ from typing import Any, List
 from ..transport import codec
 from .model import Model, Operation
 
-__all__ = ["KvInput", "KvOutput", "kv_model", "OP_GET", "OP_PUT", "OP_APPEND"]
+__all__ = [
+    "KvInput",
+    "KvOutput",
+    "kv_model",
+    "kv_model_py",
+    "OP_GET",
+    "OP_PUT",
+    "OP_APPEND",
+]
 
 OP_GET = 0
 OP_PUT = 1
@@ -66,9 +74,55 @@ def _describe(inp: KvInput, out: KvOutput) -> str:
     return f"append('{inp.key}', '{inp.value}')"
 
 
+# Measured conservatively: the C++ DFS sustains well over this many
+# steps/sec, so the budget under-runs a wall-clock deadline.
+_NATIVE_STEPS_PER_SEC = 20_000_000
+
+
+def _native_check(part: List[Operation], deadline=None):
+    """C++ DFS fast path for one per-key partition (falls back to the
+    Python DFS on None).  The step budget is derived from the remaining
+    wall-clock deadline — unlimited when no timeout was requested, so an
+    ILLEGAL verdict can never be masked as UNKNOWN by an arbitrary
+    budget."""
+    import time as _time
+
+    from .checker import CheckResult  # local import to avoid a cycle
+    from .native import check_kv_partition_native
+
+    n = len(part)
+    if n == 0 or n > 62:
+        return None
+    if deadline is None:
+        max_steps = 0  # unlimited: exhaustive, like the Python DFS
+    else:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            return CheckResult.UNKNOWN
+        max_steps = int(remaining * _NATIVE_STEPS_PER_SEC)
+    events = []
+    for i, op in enumerate(part):
+        events.append((op.call, 0, i))
+        events.append((op.ret, 1, i))
+    events.sort(key=lambda e: (e[0], e[1]))
+    ev = [(i, bool(kind)) for _, kind, i in events]
+    kinds = [op.input.op for op in part]
+    values = [op.input.value for op in part]
+    outputs = [op.output.value for op in part]
+    rc = check_kv_partition_native(ev, kinds, values, outputs, max_steps=max_steps)
+    if rc is None or rc == 3:
+        return None
+    return {0: CheckResult.ILLEGAL, 1: CheckResult.OK, 2: CheckResult.UNKNOWN}[rc]
+
+
 kv_model = Model(
     init=_init,
     step=_step,
     partition=_partition,
     describe_operation=_describe,
+    native_check=_native_check,
 )
+
+# Pure-Python variant (oracle for differential tests of the native DFS);
+# derived from kv_model so the two can never drift apart.
+kv_model_py = dataclasses.replace(kv_model, native_check=None)

@@ -51,6 +51,11 @@ class Model:
     partition: Optional[Callable[[List[Operation]], List[List[Operation]]]] = None
     freeze: Optional[Callable[[Any], Any]] = None
     describe_operation: Optional[Callable[[Any, Any], str]] = None
+    # Optional compiled fast path: fn(partition, deadline) -> CheckResult
+    # | None (None = punt to the generic Python DFS).  ``deadline`` is a
+    # time.monotonic() instant or None for unbounded.  Used by the KV
+    # model's C++ checker (porcupine/native).
+    native_check: Optional[Callable[[List[Operation], Optional[float]], Any]] = None
 
     def partitions(self, history: List[Operation]) -> List[List[Operation]]:
         if self.partition is None:

@@ -1,0 +1,92 @@
+"""ctypes loader for the native KV linearizability checker.
+
+Builds ``libporcupine.so`` from ``checker.cpp`` on first use (g++ -O2;
+no pybind11 in this image — plain C ABI + ctypes) and exposes
+:func:`check_kv_partition_native`.  Falls back to the Python DFS when
+the toolchain is unavailable or the partition exceeds the native
+bitset width (>62 ops).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "checker.cpp")
+_SO = os.path.join(_HERE, "libporcupine.so")
+
+_lib = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.check_kv_partition.restype = ctypes.c_int
+        lib.check_kv_partition.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        _lib = lib
+        return lib
+    except Exception:
+        _build_failed = True
+        return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps=0):
+    """Run the C++ DFS on one pre-sorted partition.
+
+    events: list of (op_id, is_return) in time order.
+    Returns 1 linearizable / 0 illegal / 2 budget exhausted / None if
+    native path unavailable (caller falls back to Python).
+    """
+    lib = _load()
+    n = len(op_kinds)
+    if lib is None or n > 62:
+        return None
+    ev_op = (ctypes.c_int32 * len(events))(*[e[0] for e in events])
+    ev_ret = (ctypes.c_uint8 * len(events))(*[1 if e[1] else 0 for e in events])
+    kinds = (ctypes.c_int32 * n)(*op_kinds)
+    vals = [v.encode() for v in op_values]
+    outs = [o.encode() for o in op_outputs]
+    val_ptrs = (ctypes.c_char_p * n)(*vals)
+    out_ptrs = (ctypes.c_char_p * n)(*outs)
+    val_lens = (ctypes.c_int32 * n)(*[len(v) for v in vals])
+    out_lens = (ctypes.c_int32 * n)(*[len(o) for o in outs])
+    return lib.check_kv_partition(
+        n,
+        ev_op,
+        ev_ret,
+        kinds,
+        ctypes.cast(val_ptrs, ctypes.POINTER(ctypes.c_char_p)),
+        val_lens,
+        ctypes.cast(out_ptrs, ctypes.POINTER(ctypes.c_char_p)),
+        out_lens,
+        max_steps,
+    )

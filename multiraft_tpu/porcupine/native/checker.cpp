@@ -1,0 +1,190 @@
+// Native linearizability DFS — the single-thread hot loop the survey
+// flags as "keep compiled" (SURVEY §2 #22).
+//
+// Same Wing–Gong/Lowe algorithm as the Python fallback
+// (multiraft_tpu/porcupine/checker.py; reference: porcupine/checker.go:
+// 140-253): doubly-linked entry list, lift/unlift, (linearized-bitset,
+// state) memo cache.  Specialised to the KV per-key partition model
+// (reference: models/kv.go:40-54) where a partition's automaton state is
+// just the key's current string value; the memo cache keys on
+// (bitset, value bytes).
+//
+// Exposed via a tiny C ABI for ctypes (no pybind11 in this image):
+//   check_kv_partition(n, op_kinds, call_order, ret_order, outputs, ...)
+// Returns 1 = linearizable, 0 = not, 2 = step budget exhausted (UNKNOWN).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  int op;          // operation id, -1 for head
+  bool is_return;
+  Entry* match;    // call -> its return
+  Entry* prev;
+  Entry* next;
+};
+
+// Operation kinds (must match porcupine/kv.py).
+constexpr int kGet = 0;
+constexpr int kPut = 1;
+constexpr int kAppend = 2;
+
+struct Frame {
+  Entry* call;
+  // Saved value-state: an index into the `states` vector (append-only).
+  int saved_state;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ops laid out as parallel arrays of length n, events pre-sorted by the
+// caller (Python) into a single interleaved sequence of 2n event slots:
+//   ev_op[i]     — operation id of event i
+//   ev_is_ret[i] — 0 call, 1 return
+// op_kind[j], op_value/op_value_len [j] — the put/append argument utf-8
+// op_output/op_output_len [j]           — get's observed value
+// max_steps — DFS step budget (0 = unlimited)
+int check_kv_partition(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    const int32_t* op_kind,
+    const uint8_t* const* op_value,
+    const int32_t* op_value_len,
+    const uint8_t* const* op_output,
+    const int32_t* op_output_len,
+    int64_t max_steps) {
+  if (n == 0) return 1;
+  if (n > 62) {
+    // Bitset is a uint64 here; larger partitions fall back to Python.
+    return 3;
+  }
+  const int64_t n_events = 2 * static_cast<int64_t>(n);
+
+  // Build the linked list.
+  std::vector<Entry> pool(n_events + 1);
+  std::vector<Entry*> call_of(n, nullptr);
+  Entry* head = &pool[0];
+  head->op = -1;
+  head->is_return = false;
+  head->prev = nullptr;
+  Entry* tail = head;
+  for (int64_t i = 0; i < n_events; i++) {
+    Entry* e = &pool[i + 1];
+    e->op = ev_op[i];
+    e->is_return = ev_is_ret[i] != 0;
+    e->match = nullptr;
+    if (!e->is_return) {
+      call_of[e->op] = e;
+    } else {
+      call_of[e->op]->match = e;
+    }
+    tail->next = e;
+    e->prev = tail;
+    tail = e;
+  }
+  tail->next = nullptr;
+
+  auto lift = [](Entry* call) {
+    Entry* ret = call->match;
+    call->prev->next = call->next;
+    if (call->next) call->next->prev = call->prev;
+    ret->prev->next = ret->next;
+    if (ret->next) ret->next->prev = ret->prev;
+  };
+  auto unlift = [](Entry* call) {
+    Entry* ret = call->match;
+    ret->prev->next = ret;
+    if (ret->next) ret->next->prev = ret;
+    call->prev->next = call;
+    if (call->next) call->next->prev = call;
+  };
+
+  auto value_of = [&](int op) {
+    return std::string(reinterpret_cast<const char*>(op_value[op]),
+                       op_value_len[op]);
+  };
+  auto output_of = [&](int op) {
+    return std::string(reinterpret_cast<const char*>(op_output[op]),
+                       op_output_len[op]);
+  };
+
+  // step: returns {ok, new_state} given current value (by index).
+  std::vector<std::string> states;
+  states.emplace_back("");  // initial value
+  int cur_state = 0;
+
+  uint64_t linearized = 0;
+  std::unordered_set<std::string> cache;
+  std::vector<Frame> stack;
+  stack.reserve(n);
+
+  auto cache_key = [&](uint64_t mask, const std::string& val) {
+    std::string k;
+    k.reserve(8 + val.size());
+    k.append(reinterpret_cast<const char*>(&mask), 8);
+    k.append(val);
+    return k;
+  };
+
+  Entry* entry = head->next;
+  int64_t steps = 0;
+  while (head->next != nullptr) {
+    if (max_steps > 0 && ++steps > max_steps) return 2;
+    if (!entry->is_return) {
+      const int op = entry->op;
+      bool ok = false;
+      std::string new_val;
+      const std::string& cur = states[cur_state];
+      switch (op_kind[op]) {
+        case kGet:
+          ok = output_of(op) == cur;
+          if (ok) new_val = cur;
+          break;
+        case kPut:
+          ok = true;
+          new_val = value_of(op);
+          break;
+        case kAppend:
+          ok = true;
+          new_val = cur + value_of(op);
+          break;
+        default:
+          return 0;
+      }
+      bool advanced = false;
+      if (ok) {
+        const uint64_t new_mask = linearized | (1ull << op);
+        std::string key = cache_key(new_mask, new_val);
+        if (cache.insert(std::move(key)).second) {
+          stack.push_back({entry, cur_state});
+          states.push_back(std::move(new_val));
+          cur_state = static_cast<int>(states.size()) - 1;
+          linearized = new_mask;
+          lift(entry);
+          entry = head->next;
+          advanced = true;
+        }
+      }
+      if (!advanced) entry = entry->next;
+    } else {
+      if (stack.empty()) return 0;
+      Frame f = stack.back();
+      stack.pop_back();
+      cur_state = f.saved_state;
+      linearized &= ~(1ull << f.call->op);
+      unlift(f.call);
+      entry = f.call->next;
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"

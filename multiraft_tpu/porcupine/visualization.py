@@ -1,0 +1,148 @@
+"""Interactive HTML timeline for operation histories — the visualizer
+component (reference: porcupine/visualization.go:89-109, which emits a
+self-contained HTML/JS page; this is a clean-room equivalent, not a
+port of its template).
+
+``visualize(model, history, path)`` writes one self-contained HTML file:
+each client is a row, each operation a bar spanning [call, ret] on the
+virtual-time axis, grouped per partition, with the operation description
+(from ``model.describe_operation``) on hover and a pass/fail banner from
+the checker verdict.  Used by the kvraft/shardkv harnesses to dump
+failing histories (reference: kvraft/test_test.go:365-381 dumps
+visualization on porcupine failure).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from typing import List, Optional
+
+from .checker import CheckResult, check_operations
+from .model import Model, Operation
+
+__all__ = ["visualize"]
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>history: {title}</title>
+<style>
+ body {{ font: 13px system-ui, sans-serif; margin: 20px; background: #fafafa; }}
+ .banner {{ padding: 8px 12px; border-radius: 6px; display: inline-block;
+           margin-bottom: 14px; color: #fff; font-weight: 600; }}
+ .ok {{ background: #2e7d32; }} .illegal {{ background: #c62828; }}
+ .unknown {{ background: #ef6c00; }}
+ .partition {{ margin: 18px 0 6px; font-weight: 600; color: #333; }}
+ svg {{ background: #fff; border: 1px solid #ddd; border-radius: 4px; }}
+ .op {{ cursor: pointer; }}
+ .op rect {{ fill: #90caf9; stroke: #1565c0; }}
+ .op:hover rect {{ fill: #ffe082; }}
+ .op text {{ font-size: 10px; pointer-events: none; }}
+ #tip {{ position: fixed; background: #212121; color: #fff; padding: 4px 8px;
+        border-radius: 4px; font-size: 12px; display: none; z-index: 10; }}
+</style></head><body>
+<h2>Operation history</h2>
+<div class="banner {verdict_class}">{verdict}</div>
+<div id="tip"></div>
+<div id="content"></div>
+<script>
+const DATA = {data};
+const tip = document.getElementById('tip');
+const content = document.getElementById('content');
+for (const part of DATA.partitions) {{
+  const div = document.createElement('div');
+  div.className = 'partition';
+  div.textContent = 'partition: ' + part.name + ' (' + part.ops.length + ' ops)';
+  content.appendChild(div);
+  const clients = [...new Set(part.ops.map(o => o.client))].sort((a,b)=>a-b);
+  const rowH = 26, pad = 44, width = 1100;
+  const t0 = Math.min(...part.ops.map(o => o.call));
+  const t1 = Math.max(...part.ops.map(o => o.ret));
+  const scale = (width - pad - 10) / Math.max(t1 - t0, 1e-9);
+  const svgNS = 'http://www.w3.org/2000/svg';
+  const svg = document.createElementNS(svgNS, 'svg');
+  svg.setAttribute('width', width);
+  svg.setAttribute('height', clients.length * rowH + 24);
+  clients.forEach((c, row) => {{
+    const label = document.createElementNS(svgNS, 'text');
+    label.textContent = 'client ' + c;
+    label.setAttribute('x', 2); label.setAttribute('y', row * rowH + 17);
+    label.setAttribute('font-size', '11'); svg.appendChild(label);
+  }});
+  for (const op of part.ops) {{
+    const row = clients.indexOf(op.client);
+    const g = document.createElementNS(svgNS, 'g');
+    g.setAttribute('class', 'op');
+    const r = document.createElementNS(svgNS, 'rect');
+    const x = pad + (op.call - t0) * scale;
+    const w = Math.max((op.ret - op.call) * scale, 3);
+    r.setAttribute('x', x); r.setAttribute('y', row * rowH + 4);
+    r.setAttribute('width', w); r.setAttribute('height', rowH - 10);
+    r.setAttribute('rx', 3);
+    g.appendChild(r);
+    const t = document.createElementNS(svgNS, 'text');
+    t.textContent = op.desc.slice(0, Math.max(w / 6, 4));
+    t.setAttribute('x', x + 3); t.setAttribute('y', row * rowH + 16);
+    g.appendChild(t);
+    g.addEventListener('mousemove', ev => {{
+      tip.style.display = 'block';
+      tip.style.left = (ev.clientX + 12) + 'px';
+      tip.style.top = (ev.clientY + 12) + 'px';
+      tip.textContent = op.desc + '  [' + op.call.toFixed(6) + ', '
+                        + op.ret.toFixed(6) + ']';
+    }});
+    g.addEventListener('mouseleave', () => tip.style.display = 'none');
+    svg.appendChild(g);
+  }}
+  content.appendChild(svg);
+}}
+</script></body></html>
+"""
+
+
+def _describe(model: Model, op: Operation) -> str:
+    if model.describe_operation is not None:
+        return model.describe_operation(op.input, op.output)
+    return f"{op.input!r} -> {op.output!r}"
+
+
+def visualize(
+    model: Model,
+    history: List[Operation],
+    path: str,
+    verdict: Optional[CheckResult] = None,
+    title: str = "history",
+) -> str:
+    """Write a self-contained HTML timeline; returns the path."""
+    if verdict is None:
+        verdict = check_operations(model, history, timeout=1.0)
+    partitions = []
+    for i, part in enumerate(model.partitions(history)):
+        name = getattr(part[0].input, "key", str(i)) if part else str(i)
+        partitions.append(
+            {
+                "name": str(name),
+                "ops": [
+                    {
+                        "client": op.client_id,
+                        "call": op.call,
+                        "ret": op.ret,
+                        "desc": _describe(model, op),
+                    }
+                    for op in part
+                ],
+            }
+        )
+    verdict_class = {
+        CheckResult.OK: "ok",
+        CheckResult.ILLEGAL: "illegal",
+        CheckResult.UNKNOWN: "unknown",
+    }[verdict]
+    page = _PAGE.format(
+        title=html.escape(title),
+        verdict=f"linearizability: {verdict.value}",
+        verdict_class=verdict_class,
+        data=json.dumps({"partitions": partitions}),
+    )
+    with open(path, "w") as f:
+        f.write(page)
+    return path

@@ -206,6 +206,11 @@ class ShardKVServer:
         self.prev = Config()  # previous config (migration source map)
         self.shards: Dict[int, Shard] = {s: Shard() for s in range(NSHARDS)}
         self._waiters: Dict[tuple, Future] = {}
+        # In-flight guards: one outstanding pull/GC exchange per shard,
+        # so ticker overlap can't propose duplicate full-payload
+        # InsertShard entries into the log (storage-bound hygiene).
+        self._pulling_now: set = set()
+        self._gcing_now: set = set()
         self._killed = False
         self.rf = RaftNode(sched, ends, me, persister, self._on_apply, seed=seed)
         self._install_snapshot(persister.read_snapshot())
@@ -323,35 +328,39 @@ class ShardKVServer:
             if self._killed or not self._is_leader():
                 continue
             for s in range(NSHARDS):
-                if self.shards[s].state == PULLING:
+                if self.shards[s].state == PULLING and s not in self._pulling_now:
+                    self._pulling_now.add(s)
                     self.sched.spawn(self._pull_one(s, self.cur.num))
 
     def _pull_one(self, shard: int, config_num: int):
-        src_gid = self.prev.shards[shard]
-        servers = self.prev.groups.get(src_gid, [])
-        args = PullArgs(config_num=config_num, shard=shard)
-        for name in servers:
-            if self._killed or self.cur.num != config_num:
-                return
-            if self.shards[shard].state != PULLING:
-                return
-            end = self._end_to(name)
-            reply = yield self.sched.with_timeout(
-                end.call("ShardKV.pull_shard", args), 0.1
-            )
-            if reply is TIMEOUT or reply is None or reply.err != OK:
-                continue
-            if self.shards[shard].state != PULLING or self.cur.num != config_num:
-                return
-            self.rf.start(
-                InsertShardOp(
-                    config_num=config_num,
-                    shard=shard,
-                    data=reply.data,
-                    latest=reply.latest,
+        try:
+            src_gid = self.prev.shards[shard]
+            servers = self.prev.groups.get(src_gid, [])
+            args = PullArgs(config_num=config_num, shard=shard)
+            for name in servers:
+                if self._killed or self.cur.num != config_num:
+                    return
+                if self.shards[shard].state != PULLING:
+                    return
+                end = self._end_to(name)
+                reply = yield self.sched.with_timeout(
+                    end.call("ShardKV.pull_shard", args), 0.1
                 )
-            )
-            return
+                if reply is TIMEOUT or reply is None or reply.err != OK:
+                    continue
+                if self.shards[shard].state != PULLING or self.cur.num != config_num:
+                    return
+                self.rf.start(
+                    InsertShardOp(
+                        config_num=config_num,
+                        shard=shard,
+                        data=reply.data,
+                        latest=reply.latest,
+                    )
+                )
+                return
+        finally:
+            self._pulling_now.discard(shard)
 
     def _gc_ticker(self):
         while not self._killed:
@@ -359,29 +368,33 @@ class ShardKVServer:
             if self._killed or not self._is_leader():
                 continue
             for s in range(NSHARDS):
-                if self.shards[s].state == GCING:
+                if self.shards[s].state == GCING and s not in self._gcing_now:
+                    self._gcing_now.add(s)
                     self.sched.spawn(self._gc_one(s, self.cur.num))
 
     def _gc_one(self, shard: int, config_num: int):
-        src_gid = self.prev.shards[shard]
-        servers = self.prev.groups.get(src_gid, [])
-        args = DeleteArgs(config_num=config_num, shard=shard)
-        for name in servers:
-            if self._killed or self.cur.num != config_num:
-                return
-            if self.shards[shard].state != GCING:
-                return
-            end = self._end_to(name)
-            reply = yield self.sched.with_timeout(
-                end.call("ShardKV.delete_shard", args), 0.1
-            )
-            if reply is TIMEOUT or reply is None or reply.err != OK:
-                continue
-            if self.shards[shard].state == GCING and self.cur.num == config_num:
-                self.rf.start(
-                    ConfirmGCOp(config_num=config_num, shard=shard)
+        try:
+            src_gid = self.prev.shards[shard]
+            servers = self.prev.groups.get(src_gid, [])
+            args = DeleteArgs(config_num=config_num, shard=shard)
+            for name in servers:
+                if self._killed or self.cur.num != config_num:
+                    return
+                if self.shards[shard].state != GCING:
+                    return
+                end = self._end_to(name)
+                reply = yield self.sched.with_timeout(
+                    end.call("ShardKV.delete_shard", args), 0.1
                 )
-            return
+                if reply is TIMEOUT or reply is None or reply.err != OK:
+                    continue
+                if self.shards[shard].state == GCING and self.cur.num == config_num:
+                    self.rf.start(
+                        ConfirmGCOp(config_num=config_num, shard=shard)
+                    )
+                return
+        finally:
+            self._gcing_now.discard(shard)
 
     def _end_to(self, servername: Any) -> ClientEnd:
         if servername not in self._peer_ends:

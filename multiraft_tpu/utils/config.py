@@ -1,0 +1,68 @@
+"""Central configuration — the "real config system" the reference lacks
+(SURVEY §5.6: its knobs are constants scattered through the code:
+heartbeat 90 ms raft/raft.go:42-44, election 300–600 ms raft/raft.go:
+46-50, NShards=10 shardctrler/common.go:23, 99/100 ms service timeouts
+kvraft/server.go:80 + kvraft/client.go:57, SnapShotInterval=10
+raft/config.go:215, with ``maxraftstate`` the only runtime knob).
+
+Everything is a frozen dataclass; ``Settings.default()`` reproduces the
+reference's timing exactly, and the engine's tick-domain equivalents
+live in :class:`multiraft_tpu.engine.core.EngineConfig`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+__all__ = ["RaftTiming", "ServiceTiming", "FaultModel", "Settings"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftTiming:
+    heartbeat: float = 0.09  # (reference: raft/raft.go:42-44)
+    election: Tuple[float, float] = (0.3, 0.6)  # (raft/raft.go:46-50)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceTiming:
+    server_wait: float = 0.099  # (reference: kvraft/server.go:80)
+    clerk_retry: float = 0.1  # (reference: kvraft/client.go:57)
+    config_poll: float = 0.08  # shardkv controller poll cadence
+    snapshot_threshold: float = 0.8  # fraction of maxraftstate (fixed
+    # from the reference's integer-division quirk, SURVEY §7.5 #1)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultModel:
+    """The labrpc fault constants (reference: labrpc/labrpc.go:221-312)."""
+
+    drop_request: float = 0.1
+    drop_reply: float = 0.1
+    unreliable_delay: float = 0.026
+    reorder_fraction: float = 2.0 / 3.0
+    reorder_delay: Tuple[float, float] = (0.2, 2.6)
+    dead_timeout: float = 0.1
+    long_dead_timeout: float = 7.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Settings:
+    raft: RaftTiming = RaftTiming()
+    service: ServiceTiming = ServiceTiming()
+    faults: FaultModel = FaultModel()
+    nshards: int = 10  # (reference: shardctrler/common.go:23)
+
+    @staticmethod
+    def default() -> "Settings":
+        return Settings()
+
+    @staticmethod
+    def from_env(prefix: str = "MULTIRAFT_") -> "Settings":
+        """Override timing via environment, e.g. MULTIRAFT_HEARTBEAT=0.05."""
+        s = Settings()
+        hb = os.environ.get(prefix + "HEARTBEAT")
+        if hb:
+            s = dataclasses.replace(s, raft=dataclasses.replace(s.raft, heartbeat=float(hb)))
+        return s

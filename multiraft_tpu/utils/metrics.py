@@ -1,0 +1,84 @@
+"""Lightweight metrics registry + gated tracing.
+
+The reference's observability is wall-clock begin/end printers plus
+RPC/byte counters and two debug-printf gates (SURVEY §5.1/§5.5:
+raft/config.go:624-651, labrpc/labrpc.go:375-383, raft/utility.go:55-72).
+This module gives the framework a real registry: named counters,
+gauges, and histogram-ish timers that the harnesses, services, and the
+engine driver all share, plus a ``trace`` printf gated by
+``MULTIRAFT_DEBUG``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["Metrics", "global_metrics", "trace", "DEBUG"]
+
+DEBUG = os.environ.get("MULTIRAFT_DEBUG", "") not in ("", "0")
+
+
+def trace(fmt: str, *args) -> None:
+    """Gated debug printf (reference: DPrintf, raft/utility.go:55-72)."""
+    if DEBUG:
+        print(fmt % args if args else fmt, file=sys.stderr)
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.gauges: Dict[str, float] = {}
+        self.samples: Dict[str, List[float]] = defaultdict(list)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def set(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        self.samples[name].append(value)
+
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        xs = sorted(self.samples.get(name, []))
+        if not xs:
+            return None
+        i = min(int(q * len(xs)), len(xs) - 1)
+        return xs[i]
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self.counters)
+        out.update(self.gauges)
+        for name in self.samples:
+            p50 = self.percentile(name, 0.50)
+            p99 = self.percentile(name, 0.99)
+            if p50 is not None:
+                out[name + "_p50"] = p50
+                out[name + "_p99"] = p99
+        return out
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.samples.clear()
+
+    class _Timer:
+        def __init__(self, m: "Metrics", name: str) -> None:
+            self.m, self.name = m, name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.m.observe(self.name, time.perf_counter() - self.t0)
+
+    def timer(self, name: str) -> "_Timer":
+        return Metrics._Timer(self, name)
+
+
+global_metrics = Metrics()

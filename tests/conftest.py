@@ -3,17 +3,27 @@
 Correctness tests run on a virtual 8-device CPU mesh so multi-chip
 shardings are exercised without TPU hardware; the real chip is reserved
 for ``bench.py``.
+
+The axon TPU plugin (registered at interpreter startup via
+sitecustomize) sets ``jax_platforms`` *programmatically*, so the
+``JAX_PLATFORMS`` env var alone cannot steer tests back to CPU — and
+letting backend init touch the axon tunnel inside pytest hangs.  The
+authoritative override is ``jax.config.update('jax_platforms', 'cpu')``
+before any backend initialization, with XLA_FLAGS set first so the CPU
+client fans out into 8 virtual devices.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,64 @@
+"""Aux subsystem tests: visualizer, config system, metrics registry."""
+
+import os
+
+from multiraft_tpu.porcupine.checker import CheckResult
+from multiraft_tpu.porcupine.kv import KvInput, KvOutput, OP_APPEND, OP_GET, OP_PUT, kv_model
+from multiraft_tpu.porcupine.model import Operation
+from multiraft_tpu.porcupine.visualization import visualize
+from multiraft_tpu.utils.config import Settings
+from multiraft_tpu.utils.metrics import Metrics
+
+
+def test_visualizer_writes_selfcontained_html(tmp_path):
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="a", value="1"), 0.0, KvOutput(), 1.0),
+        Operation(1, KvInput(op=OP_GET, key="a"), 2.0, KvOutput(value="1"), 3.0),
+        Operation(2, KvInput(op=OP_APPEND, key="b", value="x"), 0.5, KvOutput(), 1.5),
+    ]
+    path = str(tmp_path / "hist.html")
+    out = visualize(kv_model, h, path, title="demo")
+    assert os.path.exists(out)
+    page = open(out).read()
+    assert "<svg" not in page  # svg is built client-side
+    assert "linearizability: ok" in page
+    assert "get('a')" in page and "append('b'" in page  # descriptions embedded
+    assert "partitions" in page and "client" in page
+    assert len(page) > 2000  # self-contained page, not a stub
+
+
+def test_visualizer_illegal_banner(tmp_path):
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="a", value="1"), 0.0, KvOutput(), 1.0),
+        Operation(1, KvInput(op=OP_GET, key="a"), 2.0, KvOutput(value=""), 3.0),
+    ]
+    path = str(tmp_path / "bad.html")
+    visualize(kv_model, h, path)
+    assert "linearizability: illegal" in open(path).read()
+
+
+def test_settings_defaults_match_reference():
+    s = Settings.default()
+    assert s.raft.heartbeat == 0.09
+    assert s.raft.election == (0.3, 0.6)
+    assert s.service.server_wait == 0.099
+    assert s.service.clerk_retry == 0.1
+    assert s.nshards == 10
+    assert s.faults.drop_request == 0.1
+
+
+def test_metrics_registry():
+    m = Metrics()
+    m.inc("rpcs")
+    m.inc("rpcs", 4)
+    m.set("groups", 10_000)
+    for v in range(100):
+        m.observe("latency", v / 100.0)
+    snap = m.snapshot()
+    assert snap["rpcs"] == 5
+    assert snap["groups"] == 10_000
+    assert 0.45 <= snap["latency_p50"] <= 0.55
+    assert snap["latency_p99"] >= 0.95
+    with m.timer("t"):
+        pass
+    assert m.percentile("t", 0.5) is not None

@@ -1,0 +1,260 @@
+"""Batched engine tests: the 2A/2B/2D scenario suite driven through the
+tensor tick (SURVEY §7.2 step 5), plus cross-backend invariants shared
+with the event-driven sim (election safety, log matching, progress)."""
+
+import numpy as np
+import pytest
+
+from multiraft_tpu.engine.core import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    EngineConfig,
+)
+from multiraft_tpu.engine.host import EngineDriver
+
+
+def make(G=4, P=3, seed=0, **kw) -> EngineDriver:
+    cfg = EngineConfig(G=G, P=P, **kw)
+    return EngineDriver(cfg, seed=seed)
+
+
+def test_initial_election_all_groups():
+    """Every group elects exactly one leader (2A analog)."""
+    d = make(G=8, P=3, seed=1)
+    assert d.run_until_quiet_leaders(300)
+    assert (d.leaders_per_group() >= 1).all()
+    assert (d.leaders_at_max_term_per_group() == 1).all()
+
+
+def test_election_safety_never_two_leaders_same_term():
+    d = make(G=4, P=5, seed=2)
+    seen = {}
+    for _ in range(400):
+        d.step()
+        st = d.np_state()
+        lead = (st["role"] == LEADER) & st["alive"]
+        for g in range(d.cfg.G):
+            for p in np.nonzero(lead[g])[0]:
+                t = int(st["term"][g, p])
+                prev = seen.setdefault((g, t), int(p))
+                assert prev == int(p), (
+                    f"group {g} term {t}: two leaders {prev} and {p}"
+                )
+
+
+def test_basic_agreement():
+    """Start commands commit on all groups (2B basic agree analog)."""
+    d = make(G=4, P=3, seed=3)
+    assert d.run_until_quiet_leaders(300)
+    for g in range(4):
+        for i in range(3):
+            d.start(g, f"cmd-{g}-{i}")
+    for _ in range(60):
+        d.step()
+    st = d.np_state()
+    commit = st["commit"].max(axis=1)
+    assert (commit >= 3).all(), f"commits: {commit}"
+    for g in range(4):
+        d.check_log_matching(g)
+    assert d.commits_total >= 12
+
+
+def test_leader_crash_failover_and_log_repair():
+    """Kill each group's leader; a new one takes over and commits keep
+    advancing (2B fail-agree analog)."""
+    d = make(G=3, P=3, seed=4)
+    assert d.run_until_quiet_leaders(300)
+    for g in range(3):
+        for i in range(2):
+            d.start(g, i)
+    for _ in range(40):
+        d.step()
+    old = {}
+    for g in range(3):
+        old[g] = d.leader_of(g)
+        d.set_alive(g, old[g], False)
+    assert d.run_until_quiet_leaders(400), "no failover leader"
+    for g in range(3):
+        new_leader = d.leader_of(g)
+        assert new_leader != old[g]
+        for i in range(2):
+            d.start(g, 10 + i)
+    before = d.np_state()["commit"].max(axis=1)
+    for _ in range(80):
+        d.step()
+    after = d.np_state()["commit"].max(axis=1)
+    assert (after >= before + 2).all(), f"{before} -> {after}"
+    for g in range(3):
+        d.check_log_matching(g)
+
+
+def test_minority_partition_no_commit():
+    """A leader cut off with a minority cannot commit (2B no-agree)."""
+    d = make(G=1, P=5, seed=5)
+    assert d.run_until_quiet_leaders(300)
+    leader = d.leader_of(0)
+    keep = [leader, (leader + 1) % 5]
+    for p in range(5):
+        if p not in keep:
+            d.set_alive(0, p, False)
+    base_commit = int(d.np_state()["commit"][0].max())
+    for i in range(3):
+        d.start(0, i)
+    for _ in range(120):
+        d.step()
+    st = d.np_state()
+    # Old leader may have appended but must NOT have committed.
+    assert int(st["commit"][0, leader]) == base_commit
+    # Heal: majority back; entries eventually resolve consistently.
+    for p in range(5):
+        d.set_alive(0, p, True)
+    assert d.run_until_quiet_leaders(400)
+    for i in range(2):
+        d.start(0, 100 + i)
+    for _ in range(100):
+        d.step()
+    d.check_log_matching(0)
+    assert int(d.np_state()["commit"][0].max()) > base_commit
+
+
+def test_divergent_log_truncation():
+    """A partitioned leader's uncommitted tail is overwritten after heal
+    (2B rejoin / figure-8 analog)."""
+    d = make(G=1, P=3, seed=6)
+    assert d.run_until_quiet_leaders(300)
+    leader = d.leader_of(0)
+    others = [p for p in range(3) if p != leader]
+    # Isolate the leader WITH pending appends.
+    for p in others:
+        d.set_alive(0, p, False)
+    for i in range(4):
+        d.start(0, f"orphan-{i}")
+    for _ in range(30):
+        d.step()
+    orphan_last = int(d.np_state()["base"][0, leader] + d.np_state()["log_len"][0, leader])
+    # Bring up the other two; they elect among themselves and commit.
+    d.set_alive(0, leader, False)
+    for p in others:
+        d.set_alive(0, p, True)
+    assert d.run_until_quiet_leaders(400)
+    for i in range(3):
+        d.start(0, f"real-{i}")
+    for _ in range(60):
+        d.step()
+    # Old leader rejoins: its orphan tail must be truncated away.
+    d.set_alive(0, leader, True)
+    for _ in range(200):
+        d.step()
+    d.check_log_matching(0)
+    st = d.np_state()
+    new_leader = d.leader_of(0)
+    assert int(st["commit"][0, leader]) >= 3
+    # The orphan entries' terms are gone from the rejoined replica.
+    view = d.log_terms_of(0, leader)
+    leader_view = d.log_terms_of(0, new_leader)
+    common = set(view) & set(leader_view)
+    for i in common:
+        assert view[i] == leader_view[i]
+
+
+def test_unreliable_network_progress():
+    """20% message drop: slower, but still safe and live."""
+    d = make(G=4, P=3, seed=7)
+    d.drop_prob = 0.2
+    assert d.run_until_quiet_leaders(800)
+    for g in range(4):
+        for i in range(5):
+            d.start(g, i)
+    for _ in range(300):
+        d.step()
+    st = d.np_state()
+    assert (st["commit"].max(axis=1) >= 5).all()
+    for g in range(4):
+        d.check_log_matching(g)
+
+
+def test_ring_compaction_and_snapshot_catchup():
+    """Sustained firehose overflows the ring: base advances (compaction)
+    and a long-dead replica is repaired via the snapshot fast-forward
+    (2D analog)."""
+    d = make(G=1, P=3, seed=8, L=32, E=4, INGEST=4)
+    assert d.run_until_quiet_leaders(300)
+    victim = (d.leader_of(0) + 1) % 3
+    d.set_alive(0, victim, False)
+    # Push far more than the ring holds.
+    for i in range(100):
+        d.start(0, i)
+    for _ in range(400):
+        d.step()
+    st = d.np_state()
+    leader = d.leader_of(0)
+    assert int(st["commit"][0, leader]) >= 100, st["commit"]
+    assert int(st["base"][0, leader]) > 0, "ring never compacted"
+    # Revive the victim: it must fast-forward via snapshot.
+    d.set_alive(0, victim, True)
+    for _ in range(300):
+        d.step()
+    st = d.np_state()
+    assert int(st["commit"][0, victim]) >= 100, st["commit"]
+    assert int(st["base"][0, victim]) > 0
+    d.check_log_matching(0)
+
+
+def test_restart_preserves_persistent_state():
+    """Crash-restart keeps term/vote/log; volatile state resets."""
+    d = make(G=1, P=3, seed=9)
+    assert d.run_until_quiet_leaders(300)
+    for i in range(4):
+        d.start(0, i)
+    for _ in range(60):
+        d.step()
+    leader = d.leader_of(0)
+    follower = (leader + 1) % 3
+    before = d.log_terms_of(0, follower)
+    term_before = int(d.np_state()["term"][0, follower])
+    d.set_alive(0, follower, False)
+    for _ in range(30):
+        d.step()
+    d.restart_replica(0, follower)
+    st = d.np_state()
+    assert st["role"][0, follower] == FOLLOWER
+    assert int(st["term"][0, follower]) >= term_before
+    after = d.log_terms_of(0, follower)
+    assert before == after, "log lost across restart"
+    for _ in range(200):
+        d.step()
+    d.check_log_matching(0)
+
+
+def test_payload_binding():
+    """Host payload store tracks (group, index) for accepted commands."""
+    d = make(G=2, P=3, seed=10)
+    assert d.run_until_quiet_leaders(300)
+    for g in range(2):
+        for i in range(5):
+            d.start(g, f"payload-{g}-{i}")
+    for _ in range(80):
+        d.step()
+    st = d.np_state()
+    for g in range(2):
+        commit = int(st["commit"][g].max())
+        assert commit >= 5
+        got = [
+            d.payloads.get((g, i))
+            for i in range(1, 6)
+        ]
+        assert got == [f"payload-{g}-{i}" for i in range(5)], got
+
+
+def test_five_peer_groups():
+    d = make(G=3, P=5, seed=11)
+    assert d.run_until_quiet_leaders(400)
+    for g in range(3):
+        for i in range(4):
+            d.start(g, i)
+    for _ in range(80):
+        d.step()
+    assert (d.np_state()["commit"].max(axis=1) >= 4).all()
+    for g in range(3):
+        d.check_log_matching(g)
