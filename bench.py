@@ -44,7 +44,10 @@ def main() -> None:
 
     G = int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
     P = int(os.environ.get("MULTIRAFT_BENCH_P", "3"))
-    cfg = EngineConfig(G=G, P=P, L=64, E=16, INGEST=16, HB_TICKS=9)
+    use_pallas = os.environ.get("MULTIRAFT_BENCH_PALLAS", "0") == "1"
+    cfg = EngineConfig(
+        G=G, P=P, L=64, E=16, INGEST=16, HB_TICKS=9, use_pallas=use_pallas
+    )
     key = jax.random.PRNGKey(7)
     state = init_state(cfg, key)
     inbox = empty_mailbox(cfg)

@@ -74,6 +74,11 @@ class EngineConfig:
     HB_TICKS: int = 9
     ELECT_MIN: int = 30
     ELECT_MAX: int = 60
+    # Pallas kernels for vote tally + quorum commit (the north-star
+    # ops); interpret=True runs them under the Pallas interpreter on
+    # non-TPU backends (parity/testing path).
+    use_pallas: bool = False
+    pallas_interpret: bool = False
 
     @property
     def quorum(self) -> int:
@@ -267,10 +272,21 @@ def tick_impl(
         state = state._replace(
             votes=state.votes.at[:, :, s].set(state.votes[:, :, s] | good)
         )
-    n_votes = jnp.sum(state.votes, axis=-1)  # [G,P]
-    become_leader = (
-        (state.role == CANDIDATE) & state.alive & (n_votes >= cfg.quorum)
-    )
+    if cfg.use_pallas:
+        from .pallas_ops import vote_tally_pallas
+
+        become_leader = vote_tally_pallas(
+            state.votes,
+            state.role,
+            state.alive,
+            cfg.quorum,
+            interpret=cfg.pallas_interpret,
+        )
+    else:
+        n_votes = jnp.sum(state.votes, axis=-1)  # [G,P]
+        become_leader = (
+            (state.role == CANDIDATE) & state.alive & (n_votes >= cfg.quorum)
+        )
     last_idx = _last_index(state)
     state = state._replace(
         role=jnp.where(become_leader, LEADER, state.role),
@@ -414,13 +430,30 @@ def tick_impl(
     # Self always matches its own last entry.
     own = pi[None] == pi[..., None]  # [1,P,P] diag mask
     eff_match = jnp.where(own, last_idx[..., None], state.match_idx)
-    sorted_match = jnp.sort(eff_match, axis=-1)  # ascending
-    quorum_idx = sorted_match[:, :, P - cfg.quorum]  # the median-ish index
-    # Current-term guard (reference: raft/raft_append_entry.go:98).
-    guard = _term_at(cfg, state, quorum_idx) == state.term
-    new_commit = jnp.where(
-        is_leader & guard, jnp.maximum(state.commit, quorum_idx), state.commit
-    )
+    if cfg.use_pallas:
+        from .pallas_ops import quorum_commit_pallas
+
+        new_commit = quorum_commit_pallas(
+            eff_match,
+            state.term,
+            state.commit,
+            state.base,
+            state.base_term,
+            state.log_term,
+            is_leader,
+            cfg.quorum,
+            interpret=cfg.pallas_interpret,
+        )
+    else:
+        sorted_match = jnp.sort(eff_match, axis=-1)  # ascending
+        quorum_idx = sorted_match[:, :, P - cfg.quorum]  # the median
+        # Current-term guard (reference: raft/raft_append_entry.go:98).
+        guard = _term_at(cfg, state, quorum_idx) == state.term
+        new_commit = jnp.where(
+            is_leader & guard,
+            jnp.maximum(state.commit, quorum_idx),
+            state.commit,
+        )
     state = state._replace(commit=new_commit)
 
     # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----

@@ -1,0 +1,177 @@
+"""Pallas TPU kernels for the consensus hot ops.
+
+The north-star kernel (BASELINE.json): the leader-side quorum commit
+advance — per (group, leader): the quorum-th largest ``match_index``
+with the current-term guard (reference: raft/raft_append_entry.go:
+89-105) — plus the RequestVote tally (reference: raft/raft_election.go:
+27-49).
+
+Layout choice: the *groups* axis rides the TPU lane dimension (last,
+128-wide); the peer axes (P = 3..7) are tiny and unroll into the
+sublane/register file.  So kernels take ``[..., G]``-transposed views
+and the grid tiles G.  With P this small a sort is wasted work — the
+quorum index is computed by the O(P²) counting identity
+
+    q = max_j ( match[j]  if  |{k : match[k] >= match[j]}| >= quorum )
+
+which is pure VPU element-wise + tiny reductions, and the term guard's
+ring gather becomes a one-hot multiply-sum over the L axis (no dynamic
+gather needed).
+
+On non-TPU backends the kernels run in Pallas interpret mode; parity
+tests pin them against the jnp reference implementation in
+``core.tick_impl``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["quorum_commit_pallas", "vote_tally_pallas"]
+
+
+def _commit_kernel(
+    match_ref,  # i32[P, P, bG]   eff_match (diag already = own last)
+    term_ref,  # i32[P, bG]      current term per replica
+    commit_ref,  # i32[P, bG]
+    base_ref,  # i32[P, bG]
+    base_term_ref,  # i32[P, bG]
+    log_ref,  # i32[P, L, bG]   log ring (terms)
+    lead_ref,  # i32[P, bG]      1 where (leader & alive)
+    out_ref,  # i32[P, bG]      new commit
+    *,
+    quorum: int,
+    L: int,
+):
+    match = match_ref[...]  # [P, P, bG]
+    # Counting-based k-th largest: for each candidate entry j, how many
+    # entries in the row are >= it?
+    ge = (match[:, :, None, :] >= match[:, None, :, :]).astype(jnp.int32)
+    # ge[p, k, j, g] = match[p,k] >= match[p,j]; count over k.
+    cnt = ge.sum(axis=1)  # [P, P(bj), bG]
+    eligible = cnt >= quorum
+    q = jnp.max(jnp.where(eligible, match, 0), axis=1)  # [P, bG]
+
+    # Term of absolute index q: one-hot over the ring slot (q % L), with
+    # the dummy head (q == base) supplied by base_term.
+    slot = jnp.remainder(q, L)  # [P, bG]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, L, 1), 1)  # [1, L, 1]
+    onehot = (slot[:, None, :] == lanes).astype(jnp.int32)  # [P, L, bG]
+    ring_term = (log_ref[...] * onehot).sum(axis=1)  # [P, bG]
+    q_term = jnp.where(q == base_ref[...], base_term_ref[...], ring_term)
+
+    commit = commit_ref[...]
+    ok = (
+        (lead_ref[...] == 1)
+        & (q_term == term_ref[...])
+        & (q > commit)
+    )
+    out_ref[...] = jnp.where(ok, q, commit)
+
+
+@functools.partial(jax.jit, static_argnames=("quorum", "interpret", "block_g"))
+def quorum_commit_pallas(
+    eff_match: jnp.ndarray,  # i32[G, P, P]
+    term: jnp.ndarray,  # i32[G, P]
+    commit: jnp.ndarray,  # i32[G, P]
+    base: jnp.ndarray,  # i32[G, P]
+    base_term: jnp.ndarray,  # i32[G, P]
+    log_term: jnp.ndarray,  # i32[G, P, L]
+    is_leader: jnp.ndarray,  # bool[G, P]
+    quorum: int,
+    interpret: bool = False,
+    block_g: int = 512,
+) -> jnp.ndarray:
+    """New commit index per replica — the batched north-star op."""
+    G, P, _ = eff_match.shape
+    L = log_term.shape[-1]
+    bG = min(block_g, G)
+    n_blocks = -(-G // bG)
+    padded = n_blocks * bG
+
+    def pad(x):
+        if padded == G:
+            return x
+        width = [(0, padded - G)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, width)
+
+    # Transpose to groups-last so G rides the lane dimension.
+    match_t = jnp.transpose(pad(eff_match), (1, 2, 0))  # [P, P, G']
+    term_t = jnp.transpose(pad(term), (1, 0))
+    commit_t = jnp.transpose(pad(commit), (1, 0))
+    base_t = jnp.transpose(pad(base), (1, 0))
+    bterm_t = jnp.transpose(pad(base_term), (1, 0))
+    log_t = jnp.transpose(pad(log_term), (1, 2, 0))  # [P, L, G']
+    lead_t = jnp.transpose(pad(is_leader.astype(jnp.int32)), (1, 0))
+
+    grid = (n_blocks,)
+    gspec2 = pl.BlockSpec((P, bG), lambda i: (0, i))
+    out = pl.pallas_call(
+        functools.partial(_commit_kernel, quorum=quorum, L=L),
+        out_shape=jax.ShapeDtypeStruct((P, padded), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((P, P, bG), lambda i: (0, 0, i)),
+            gspec2,
+            gspec2,
+            gspec2,
+            gspec2,
+            pl.BlockSpec((P, L, bG), lambda i: (0, 0, i)),
+            gspec2,
+        ],
+        out_specs=gspec2,
+        interpret=interpret,
+    )(match_t, term_t, commit_t, base_t, bterm_t, log_t, lead_t)
+    return jnp.transpose(out, (1, 0))[:G]  # back to [G, P]
+
+
+def _tally_kernel(votes_ref, role_ref, alive_ref, out_ref, *, quorum: int):
+    # votes[P, P, bG]: candidate p's votes from each peer.
+    n = votes_ref[...].astype(jnp.int32).sum(axis=1)  # [P, bG]
+    out_ref[...] = (
+        (role_ref[...] == 1) & (alive_ref[...] == 1) & (n >= quorum)
+    ).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("quorum", "interpret", "block_g"))
+def vote_tally_pallas(
+    votes: jnp.ndarray,  # bool[G, P, P]
+    role: jnp.ndarray,  # i32[G, P]
+    alive: jnp.ndarray,  # bool[G, P]
+    quorum: int,
+    interpret: bool = False,
+    block_g: int = 512,
+) -> jnp.ndarray:
+    """bool[G, P]: which candidates just won their election."""
+    G, P, _ = votes.shape
+    bG = min(block_g, G)
+    n_blocks = -(-G // bG)
+    padded = n_blocks * bG
+
+    def pad(x):
+        if padded == G:
+            return x
+        width = [(0, padded - G)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, width)
+
+    votes_t = jnp.transpose(pad(votes).astype(jnp.int32), (1, 2, 0))
+    role_t = jnp.transpose(pad(role), (1, 0))
+    alive_t = jnp.transpose(pad(alive).astype(jnp.int32), (1, 0))
+    gspec2 = pl.BlockSpec((P, bG), lambda i: (0, i))
+    out = pl.pallas_call(
+        functools.partial(_tally_kernel, quorum=quorum),
+        out_shape=jax.ShapeDtypeStruct((P, padded), jnp.int32),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((P, P, bG), lambda i: (0, 0, i)),
+            gspec2,
+            gspec2,
+        ],
+        out_specs=gspec2,
+        interpret=interpret,
+    )(votes_t, role_t, alive_t)
+    return jnp.transpose(out, (1, 0))[:G].astype(bool)

@@ -1,0 +1,119 @@
+"""Parity tests: Pallas kernels (interpret mode on CPU) vs the jnp
+reference path for the consensus hot ops, plus a full engine scenario
+through the Pallas path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.pallas_ops import quorum_commit_pallas, vote_tally_pallas
+
+
+def _jnp_commit(eff_match, term, commit, base, base_term, log_term, is_leader, quorum):
+    P = eff_match.shape[1]
+    L = log_term.shape[-1]
+    sorted_match = jnp.sort(eff_match, axis=-1)
+    q = sorted_match[:, :, P - quorum]
+    slot = jnp.mod(q, L)
+    ring = jnp.take_along_axis(log_term, slot[..., None], axis=-1)[..., 0]
+    q_term = jnp.where(q == base, base_term, ring)
+    guard = q_term == term
+    return jnp.where(is_leader & guard, jnp.maximum(commit, q), commit)
+
+
+@pytest.mark.parametrize("P,quorum", [(3, 2), (5, 3)])
+def test_quorum_commit_parity_random(P, quorum):
+    rng = np.random.default_rng(0)
+    G, L = 37, 16  # odd G exercises padding
+    for trial in range(5):
+        base = rng.integers(0, 5, (G, P)).astype(np.int32)
+        log_len = rng.integers(0, L - 6, (G, P)).astype(np.int32)
+        last = base + log_len
+        eff_match = np.minimum(
+            rng.integers(0, 20, (G, P, P)).astype(np.int32), last[..., None]
+        )
+        term = rng.integers(1, 6, (G, P)).astype(np.int32)
+        commit = np.minimum(
+            rng.integers(0, 10, (G, P)).astype(np.int32), last
+        )
+        base_term = rng.integers(0, 6, (G, P)).astype(np.int32)
+        log_term = rng.integers(1, 6, (G, P, L)).astype(np.int32)
+        is_leader = rng.random((G, P)) < 0.4
+
+        args = (
+            jnp.asarray(eff_match),
+            jnp.asarray(term),
+            jnp.asarray(commit),
+            jnp.asarray(base),
+            jnp.asarray(base_term),
+            jnp.asarray(log_term),
+            jnp.asarray(is_leader),
+        )
+        want = _jnp_commit(*args, quorum)
+        got = quorum_commit_pallas(*args, quorum, interpret=True, block_g=16)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_vote_tally_parity_random():
+    rng = np.random.default_rng(1)
+    G, P, quorum = 41, 5, 3
+    for trial in range(5):
+        votes = rng.random((G, P, P)) < 0.5
+        role = rng.integers(0, 3, (G, P)).astype(np.int32)
+        alive = rng.random((G, P)) < 0.8
+        want = (
+            (jnp.asarray(role) == 1)
+            & jnp.asarray(alive)
+            & (jnp.sum(jnp.asarray(votes), axis=-1) >= quorum)
+        )
+        got = vote_tally_pallas(
+            jnp.asarray(votes),
+            jnp.asarray(role),
+            jnp.asarray(alive),
+            quorum,
+            interpret=True,
+            block_g=16,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_engine_scenario_through_pallas_path():
+    """Full engine agreement scenario with the Pallas kernels active
+    (interpret mode): elections + commits behave identically."""
+    cfg = EngineConfig(G=4, P=3, use_pallas=True, pallas_interpret=True)
+    d = EngineDriver(cfg, seed=3)
+    assert d.run_until_quiet_leaders(300)
+    for g in range(4):
+        for i in range(3):
+            d.start(g, f"cmd-{g}-{i}")
+    for _ in range(60):
+        d.step()
+    st = d.np_state()
+    assert (st["commit"].max(axis=1) >= 3).all()
+    for g in range(4):
+        d.check_log_matching(g)
+
+
+def test_pallas_and_jnp_paths_agree_end_to_end():
+    """Same seed, same scenario, both paths: identical commit frontier."""
+    results = []
+    for use_pallas in (False, True):
+        cfg = EngineConfig(
+            G=3, P=3, use_pallas=use_pallas, pallas_interpret=use_pallas
+        )
+        d = EngineDriver(cfg, seed=9)
+        d.step(120)
+        for g in range(3):
+            d.start(g, 1)
+            d.start(g, 2)
+        d.step(60)
+        st = d.np_state()
+        results.append(
+            (st["commit"].copy(), st["term"].copy(), st["role"].copy())
+        )
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_array_equal(results[0][1], results[1][1])
+    np.testing.assert_array_equal(results[0][2], results[1][2])
