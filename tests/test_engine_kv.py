@@ -1,0 +1,98 @@
+"""KV-on-engine tests: the service layer's batched backend
+(BASELINE configs 4/5 at test scale — firehose + sampled-shard
+porcupine)."""
+
+import numpy as np
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.kv import BatchedKV, KVOp
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+from multiraft_tpu.services.backend import DeferredConsensus
+
+
+def make_kv(G=8, seed=0, record=None):
+    d = EngineDriver(EngineConfig(G=G, P=3), seed=seed)
+    assert d.run_until_quiet_leaders(300)
+    return d, BatchedKV(d, record_groups=record or list(range(min(G, 4))))
+
+
+def test_conforms_to_deferred_consensus_protocol():
+    d, kv = make_kv(G=2, seed=1)
+    assert isinstance(kv, DeferredConsensus)
+
+
+def test_put_get_append_across_groups():
+    d, kv = make_kv(G=8, seed=2)
+    tickets = {}
+    for g in range(8):
+        kv.submit(g, KVOp(op=OP_PUT, key="k", value=f"g{g}:"))
+        kv.submit(g, KVOp(op=OP_APPEND, key="k", value="a"))
+        kv.submit(g, KVOp(op=OP_APPEND, key="k", value="b"))
+        tickets[g] = kv.submit(g, KVOp(op=OP_GET, key="k"))
+    for _ in range(40):
+        kv.pump()
+        if all(t.done for t in tickets.values()):
+            break
+    for g, t in tickets.items():
+        assert t.done, f"group {g} get never applied"
+        assert t.value == f"g{g}:ab"
+    kv.check_sampled_linearizability()
+
+
+def test_firehose_many_ops_linearizable():
+    """A few hundred mixed ops per group; histories verify on sampled
+    groups."""
+    d, kv = make_kv(G=6, seed=3, record=[0, 3, 5])
+    rng = np.random.default_rng(5)
+    gets = []
+    for round_ in range(30):
+        for g in range(6):
+            r = rng.random()
+            if r < 0.4:
+                kv.submit(g, KVOp(op=OP_APPEND, key="x", value=f"[{round_}]"))
+            elif r < 0.6:
+                kv.submit(
+                    g, KVOp(op=OP_PUT, key=f"y{round_%3}", value=str(round_))
+                )
+            else:
+                gets.append(kv.submit(g, KVOp(op=OP_GET, key="x")))
+        kv.pump(2)
+    for _ in range(60):
+        kv.pump()
+        if all(t.done for t in gets):
+            break
+    assert all(t.done for t in gets)
+    kv.check_sampled_linearizability()
+
+
+def test_get_observes_prior_appends_in_order():
+    d, kv = make_kv(G=1, seed=4)
+    for i in range(10):
+        kv.submit(0, KVOp(op=OP_APPEND, key="seq", value=f"{i},"))
+    t = kv.submit(0, KVOp(op=OP_GET, key="seq"))
+    for _ in range(50):
+        kv.pump()
+        if t.done:
+            break
+    assert t.done
+    assert t.value == "".join(f"{i}," for i in range(10))
+    kv.check_sampled_linearizability()
+
+
+def test_commit_latency_ticks_bounded():
+    """At steady state, a submission applies within a few ticks — the
+    p99-latency story behind the bench's latency estimate."""
+    d, kv = make_kv(G=4, seed=6)
+    kv.pump(5)
+    lat = []
+    for i in range(20):
+        ts = [kv.submit(g, KVOp(op=OP_APPEND, key="l", value=".")) for g in range(4)]
+        for _ in range(20):
+            kv.pump()
+            if all(t.done for t in ts):
+                break
+        assert all(t.done for t in ts)
+        lat.extend(t.done_tick - t.submit_tick for t in ts)
+    p99 = sorted(lat)[int(0.99 * (len(lat) - 1))]
+    assert p99 <= 6, f"p99 commit latency {p99} ticks (expected <= 6)"
