@@ -80,6 +80,20 @@ class EngineConfig:
     use_pallas: bool = False
     pallas_interpret: bool = False
 
+    def __post_init__(self) -> None:
+        # The ring-log algebra requires headroom: vectorized scatters
+        # assume message slots are distinct mod L, and the capacity /
+        # compaction thresholds assume an E+INGEST+2 reserve.
+        if self.L <= self.E + self.INGEST + 2:
+            raise ValueError(
+                f"EngineConfig: L={self.L} must exceed "
+                f"E+INGEST+2={self.E + self.INGEST + 2}"
+            )
+        if self.P < 1 or self.G < 1 or self.E < 1:
+            raise ValueError("EngineConfig: G, P, E must be >= 1")
+        if self.ELECT_MIN >= self.ELECT_MAX or self.HB_TICKS < 1:
+            raise ValueError("EngineConfig: bad timing parameters")
+
     @property
     def quorum(self) -> int:
         return self.P // 2 + 1
@@ -347,19 +361,21 @@ def tick_impl(
 
         # Write entries prev+1..prev+n, truncating only at a genuine
         # conflict (reference: raft/raft_append_entry.go:146-155).
-        conflict_any = jnp.zeros((G, P), bool)
+        # Vectorized over the E axis: slots within one message are
+        # distinct mod L (E < L), so a single masked scatter is exact.
         log = state.log_term
-        for e in range(E):
-            idx = prev + 1 + e
-            in_msg = match & (e < n_ent)
-            slot = jnp.mod(idx, L)
-            old = jnp.take_along_axis(log, slot[..., None], axis=-1)[..., 0]
-            incoming = inbox.ar_terms[:, s, :, e]
-            exists = idx <= last
-            conflict_any = conflict_any | (in_msg & exists & (old != incoming))
-            write = in_msg
-            newval = jnp.where(write, incoming, old)
-            log = log.at[gi, pi, slot].set(newval)
+        ei = jnp.arange(E)  # [E]
+        idx = prev[..., None] + 1 + ei  # [G,P,E]
+        in_msg = match[..., None] & (ei < n_ent[..., None])
+        slot = jnp.mod(idx, L)
+        old = jnp.take_along_axis(log, slot, axis=-1)  # [G,P,E]
+        incoming = inbox.ar_terms[:, s, :, :]  # [G,P,E]
+        exists = idx <= last[..., None]
+        conflict_any = jnp.any(
+            in_msg & exists & (old != incoming), axis=-1
+        )  # [G,P]
+        newval = jnp.where(in_msg, incoming, old)
+        log = log.at[gi[..., None], pi[..., None], slot].set(newval)
         state = state._replace(log_term=log)
         msg_last = prev + n_ent
         new_last = jnp.where(
@@ -482,21 +498,34 @@ def tick_impl(
     )
 
     # ---- 5b. Start() ingestion: leaders append the firehose ----
+    # Only the leader at the group's max alive term ingests: a zombie
+    # leader (older term, still alive under message loss) can never
+    # commit what it accepts, and letting it accept would corrupt the
+    # per-group accepted/start_index payload-binding metrics (there is
+    # exactly one leader per term by election safety).
     is_leader = (state.role == LEADER) & state.alive  # [G,P]
+    group_max_term = jnp.max(
+        jnp.where(state.alive, state.term, -1), axis=1, keepdims=True
+    )
+    is_leader = is_leader & (state.term == group_max_term)
     capacity = jnp.maximum(L - 2 - cfg.E - state.log_len, 0)
     want = jnp.minimum(new_cmds[:, None], cfg.INGEST)  # [G,P]
     accept = jnp.where(is_leader, jnp.minimum(want, capacity), 0)
     log = state.log_term
     last_idx = _last_index(state)
-    for e in range(cfg.INGEST):
-        idx = last_idx + 1 + e
-        write = e < accept
-        slot = jnp.mod(idx, L)
-        old = jnp.take_along_axis(log, slot[..., None], axis=-1)[..., 0]
-        log = log.at[gi, pi, slot].set(jnp.where(write, state.term, old))
+    # Vectorized over the INGEST axis (slots distinct mod L, one scatter).
+    ii = jnp.arange(cfg.INGEST)  # [I]
+    idx = last_idx[..., None] + 1 + ii  # [G,P,I]
+    write = ii < accept[..., None]
+    slot = jnp.mod(idx, L)
+    old = jnp.take_along_axis(log, slot, axis=-1)
+    log = log.at[gi[..., None], pi[..., None], slot].set(
+        jnp.where(write, state.term[..., None], old)
+    )
     state = state._replace(log_term=log, log_len=state.log_len + accept)
-    # Group accepted count (for host payload binding): at most one
-    # leader per group is alive; sum collapses the P axis.
+    # Group accepted count (for host payload binding): the max-term
+    # gate above guarantees at most one accepting replica per group,
+    # so sum exactly collapses the P axis.
     accepted_per_group = jnp.sum(accept, axis=1)  # i32[G]
     start_index = jnp.sum(jnp.where(accept > 0, last_idx, 0), axis=1)
 
@@ -521,12 +550,14 @@ def tick_impl(
     n_send = jnp.where(
         need_snap, 0, jnp.clip(last_idx[:, :, None] - prev, 0, E)
     )
-    terms = []
-    for e in range(E):
-        idx = prev + 1 + e
-        t = jnp.take_along_axis(state.log_term, jnp.mod(idx, L), axis=-1)
-        terms.append(jnp.where(e < n_send, t, 0))
-    ar_terms = jnp.stack(terms, axis=-1)  # [G,P,P,E]
+    # Gather the outgoing suffix terms in one shot: [G,P,P,E] slots
+    # flattened onto the sender's L axis.
+    send_idx = prev[..., None] + 1 + jnp.arange(E)  # [G,P,P,E]
+    send_slot = jnp.mod(send_idx, L).reshape(G, P, P * E)
+    t = jnp.take_along_axis(state.log_term, send_slot, axis=-1).reshape(
+        G, P, P, E
+    )
+    ar_terms = jnp.where(jnp.arange(E) < n_send[..., None], t, 0)
     out = out._replace(
         ar_active=send,
         ar_term=jnp.broadcast_to(state.term[:, :, None], (G, P, P)),

@@ -74,8 +74,12 @@ class EngineDriver:
         # orders (term, index); data stays here (SURVEY §7.1).
         self.payloads: Dict[tuple, Any] = {}
         self._pending_payloads: Dict[int, list] = defaultdict(list)
-        self.applied_frontier = np.zeros(cfg.G, np.int64)
         self.last_metrics: Dict[str, Any] = {}
+        self.tick = 0  # host mirror of the device tick counter
+        # Called with the old payload when a (group, index) binding is
+        # overwritten — i.e. the old command lost its slot to a leader
+        # change and will never commit at that index.
+        self.on_payload_evicted: Optional[Any] = None
 
     # -- fault injection --------------------------------------------------
 
@@ -130,8 +134,8 @@ class EngineDriver:
     def step(self, n: int = 1) -> Dict[str, Any]:
         cfg = self.cfg
         for _ in range(n):
-            self._tick_host = getattr(self, "_tick_host", 0) + 1
-            tick_key = jax.random.fold_in(self.key, self._tick_host)
+            self.tick += 1
+            tick_key = jax.random.fold_in(self.key, self.tick)
             have_backlog = bool(self.backlog.any())
             new_cmds = jnp.asarray(
                 np.minimum(self.backlog, cfg.INGEST), jnp.int32
@@ -158,7 +162,11 @@ class EngineDriver:
                     if pend:
                         s0 = int(starts[g])
                         for off in range(min(k, len(pend))):
-                            self.payloads[(int(g), s0 + 1 + off)] = pend.pop(0)
+                            slot = (int(g), s0 + 1 + off)
+                            old = self.payloads.get(slot)
+                            if old is not None and self.on_payload_evicted:
+                                self.on_payload_evicted(old)
+                            self.payloads[slot] = pend.pop(0)
             # Accumulate on device; converted lazily by readers.
             self._commits_dev = (
                 getattr(self, "_commits_dev", jnp.int32(0)) + metrics["commits"]

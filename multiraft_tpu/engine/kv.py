@@ -17,7 +17,7 @@ its log position; Put/Append are exactly-once per (group, index).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT, KvInput, KvOutput
 from ..porcupine.model import Operation
@@ -37,6 +37,7 @@ class KVOp:
 class Ticket:
     group: int
     done: bool = False
+    failed: bool = False  # lost to a leader change; caller resubmits
     value: str = ""
     index: int = -1
     submit_tick: int = 0
@@ -55,7 +56,8 @@ class BatchedKV:
         G = driver.cfg.G
         self.data: List[Dict[str, str]] = [dict() for _ in range(G)]
         self.applied_upto = [0] * G
-        self._tickets: Dict[Tuple[int, int], Ticket] = {}  # (g, index) -> t
+        driver.on_payload_evicted = self._on_evicted
+        self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
         self._record = set(record_groups or [])
         self.histories: Dict[int, List[Operation]] = {
             g: [] for g in self._record
@@ -71,7 +73,18 @@ class BatchedKV:
 
     def _now(self) -> int:
         # Host-side tick mirror: avoids a device readback per submit.
-        return int(getattr(self.driver, "_tick_host", 0))
+        return self.driver.tick
+
+    def _on_evicted(self, payload: Any) -> None:
+        """A (group, index) binding was overwritten: the old command lost
+        its log slot to a leader change and will never commit there —
+        fail its ticket so the caller can resubmit (the batched analog of
+        kvraft's ErrWrongLeader wait-channel resolution,
+        reference: kvraft/server.go:98-128)."""
+        _, ticket = payload
+        if ticket is not None and not ticket.done:
+            ticket.done = True
+            ticket.failed = True
 
     # -- pumping ---------------------------------------------------------
 
@@ -87,9 +100,47 @@ class BatchedKV:
             upto = int(commit[g])
             while self.applied_upto[g] < upto:
                 idx = self.applied_upto[g] + 1
-                payload = self.driver.payloads.get((g, idx))
+                # pop: an applied payload is never needed again (host
+                # memory stays bounded under a sustained firehose).
+                payload = self.driver.payloads.pop((g, idx), None)
                 self._apply(g, idx, payload, now)
                 self.applied_upto[g] = idx
+        # Periodically fail bindings orphaned by log truncation (a
+        # leader change can strand tail bindings that no future accept
+        # will overwrite if the group goes quiet).
+        self._sweep_countdown -= n_ticks
+        if self._sweep_countdown <= 0:
+            self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
+            self.sweep_orphans()
+
+    ORPHAN_SWEEP_TICKS = 64
+
+    def sweep_orphans(self) -> int:
+        """Fail tickets whose bound (group, index) log entry no longer
+        exists in the current leader's log — it was truncated by a
+        leader change and can never commit as bound.  Returns the number
+        of tickets failed.  (The batched analog of kvraft waiters being
+        resolved ErrWrongLeader on term change,
+        reference: kvraft/server.go:98-128.)"""
+        if not self.driver.payloads:
+            return 0
+        st = self.driver.np_state()
+        failed = 0
+        last_cache: Dict[int, Optional[int]] = {}
+        for (g, idx) in list(self.driver.payloads.keys()):
+            if g not in last_cache:
+                p = self.driver.leader_of(g)
+                last_cache[g] = (
+                    None
+                    if p is None
+                    else int(st["base"][g, p] + st["log_len"][g, p])
+                )
+            last = last_cache[g]
+            if last is not None and idx > last:
+                payload = self.driver.payloads.pop((g, idx))
+                self._on_evicted(payload)
+                failed += 1
+        return failed
 
     def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
         if payload is None:

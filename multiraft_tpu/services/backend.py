@@ -26,9 +26,7 @@ snapshot installs that always respect the ordering guarantee
 
 from __future__ import annotations
 
-from typing import Any, Callable, Protocol, Tuple, runtime_checkable
-
-from ..raft.messages import ApplyMsg
+from typing import Any, Protocol, Tuple, runtime_checkable
 
 __all__ = ["SyncConsensus", "DeferredConsensus"]
 
