@@ -1,0 +1,29 @@
+"""Real-deployment runtime: wall-clock event loop + TCP transport.
+
+The simulated stack (``multiraft_tpu.sim`` + ``multiraft_tpu.transport``)
+is the test fabric, exactly like the reference where "serving" means
+constructing servers inside a simulated network (SURVEY §0).  This
+package is the part the reference *doesn't* have: the same RaftNode /
+KVServer / ShardCtrler objects deployed across real OS processes over
+real sockets, with durable on-disk persistence — the runtime you point
+actual clients at.
+
+Components:
+
+* :mod:`realtime`  — ``RealtimeScheduler``: the sim ``Scheduler`` API
+  (call_after / futures / coroutine spawn) on a wall-clock event-loop
+  thread, so every sim-tested component runs unmodified in real time.
+* :mod:`native`    — C++ epoll framed-TCP transport (plain C ABI +
+  ctypes; built on first use like the porcupine native checker).
+* :mod:`tcp`       — RPC endpoints over that transport exposing the
+  ``ClientEnd.call → Future`` contract.
+* :mod:`disk`      — ``DiskPersister``: crash-atomic file-backed
+  (state, snapshot) pair store.
+* :mod:`cluster`   — multi-process cluster launcher for Raft/KV server
+  groups, plus in-process client clerks.
+"""
+
+from .disk import DiskPersister
+from .realtime import RealtimeScheduler
+
+__all__ = ["DiskPersister", "RealtimeScheduler"]

@@ -1,0 +1,198 @@
+"""Multi-process cluster deployment.
+
+The reference never leaves the simulated network — "serving" means test
+harnesses (SURVEY §0).  This module is the real thing: each Raft/KV
+server runs in its own OS process on a ``RealtimeScheduler`` + TCP
+``RpcNode`` with a crash-atomic ``DiskPersister``; clients talk to the
+cluster through the unmodified :class:`~multiraft_tpu.services.kvraft.Clerk`
+over :class:`TcpClientEnd`\\ s.
+
+Crash/restart testing here is *literal*: ``kill -9`` the process, start
+a new one on the same data directory, and Raft recovers from disk — the
+deployment analog of the sim fixture's Persister-copy rebirth
+(reference: raft/config.go:113-142).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, List, Optional, Sequence
+
+from ..sim.scheduler import TIMEOUT
+from .disk import DiskPersister
+from .realtime import RealtimeScheduler
+from .tcp import RpcNode
+
+__all__ = [
+    "serve_kv",
+    "KVProcessCluster",
+    "BlockingClerk",
+]
+
+
+def serve_kv(
+    me: int,
+    ports: Sequence[int],
+    data_dir: str,
+    host: str = "127.0.0.1",
+    maxraftstate: int = -1,
+) -> RpcNode:
+    """Bring up one KV server process component: RealtimeScheduler +
+    listening RpcNode + KVServer/RaftNode on a DiskPersister.  Returns
+    the RpcNode (caller keeps the process alive)."""
+    from ..services.kvraft import KVServer
+
+    sched = RealtimeScheduler()
+    node = RpcNode(sched, listen=True, host=host, port=ports[me])
+    ends = [node.client_end(host, p) for p in ports]
+    persister = DiskPersister(os.path.join(data_dir, f"server-{me}"))
+
+    # KVServer mutates consensus state from RPC handlers; construct it on
+    # the loop thread so initialization obeys the single-mutator rule.
+    srv = sched.run_call(
+        lambda: KVServer(
+            sched, ends, me, persister, maxraftstate=maxraftstate, seed=me
+        )
+    )
+    node.add_service("KVServer", srv)
+    node.add_service("Raft", srv.rf)
+    return node
+
+
+def _server_main() -> None:  # pragma: no cover - subprocess entry
+    import json
+
+    spec = json.loads(sys.argv[2])
+    node = serve_kv(
+        me=spec["me"],
+        ports=spec["ports"],
+        data_dir=spec["data_dir"],
+        maxraftstate=spec.get("maxraftstate", -1),
+    )
+    print(f"ready {node.port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+class BlockingClerk:
+    """Synchronous client facade: drives the generator-coroutine Clerk on
+    a RealtimeScheduler and blocks the calling thread for the result."""
+
+    def __init__(
+        self, ports: Sequence[int], host: str = "127.0.0.1",
+        sched: Optional[RealtimeScheduler] = None,
+        node: Optional[RpcNode] = None,
+    ) -> None:
+        from ..services.kvraft import Clerk
+
+        self.sched = sched or RealtimeScheduler()
+        self.node = node or RpcNode(self.sched)
+        ends = [self.node.client_end(host, p) for p in ports]
+        self._clerk = Clerk(self.sched, ends)
+
+    def _run(self, gen, timeout: float) -> Any:
+        value = self.sched.wait(self.sched.spawn(gen), timeout)
+        if value is TIMEOUT:
+            raise TimeoutError("cluster did not answer in time")
+        return value
+
+    def get(self, key: str, timeout: float = 30.0) -> str:
+        return self._run(self._clerk.get(key), timeout)
+
+    def put(self, key: str, value: str, timeout: float = 30.0) -> None:
+        self._run(self._clerk.put(key, value), timeout)
+
+    def append(self, key: str, value: str, timeout: float = 30.0) -> None:
+        self._run(self._clerk.append(key, value), timeout)
+
+    def close(self) -> None:
+        self.node.close()
+
+
+class KVProcessCluster:
+    """Launch and manage ``n`` KV server OS processes (test/ops driver)."""
+
+    def __init__(
+        self,
+        n: int,
+        data_dir: str,
+        host: str = "127.0.0.1",
+        maxraftstate: int = -1,
+    ) -> None:
+        import socket
+
+        self.n = n
+        self.host = host
+        self.data_dir = data_dir
+        self.maxraftstate = maxraftstate
+        # Reserve n distinct ephemeral ports (bind/close; the race window
+        # is acceptable for tests and the cluster retries on failure).
+        self.ports: List[int] = []
+        socks = []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((host, 0))
+            socks.append(s)
+            self.ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        self.procs: List[Optional[subprocess.Popen]] = [None] * n
+
+    def start(self, i: int) -> None:
+        import json
+
+        assert self.procs[i] is None or self.procs[i].poll() is not None
+        spec = {
+            "me": i,
+            "ports": self.ports,
+            "data_dir": self.data_dir,
+            "maxraftstate": self.maxraftstate,
+        }
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")  # server procs never need a chip
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        self.procs[i] = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "multiraft_tpu.distributed.cluster",
+                json.dumps(spec),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            text=True,
+        )
+        line = self.procs[i].stdout.readline()
+        if not line.startswith("ready"):
+            raise RuntimeError(f"server {i} failed to start: {line!r}")
+
+    def start_all(self) -> None:
+        for i in range(self.n):
+            self.start(i)
+
+    def kill(self, i: int) -> None:
+        """SIGKILL — a real crash; durable state must carry the restart."""
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self.procs[i] = None
+
+    def clerk(self) -> BlockingClerk:
+        return BlockingClerk(self.ports, host=self.host)
+
+    def shutdown(self) -> None:
+        for i in range(self.n):
+            self.kill(i)
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    sys.argv = [sys.argv[0], "serve", sys.argv[1]]
+    _server_main()

@@ -1,0 +1,111 @@
+"""Crash-atomic on-disk Persister.
+
+The reference's Persister is in-memory byte slices with an atomic
+(state, snapshot) pair save (reference: raft/persister.go:57-64); crash
+realism comes from the test fixture copying it into the reborn server
+(reference: raft/config.go:113-142).  A real deployment needs the same
+contract from the filesystem: the pair must be visible atomically — the
+service snapshot must never run ahead of the raft state it belongs to.
+
+Implementation: both blobs are written to one temp file
+(length-prefixed, checksummed) in the target directory, fsync'd, then
+``rename``'d over ``current.bin`` — POSIX rename atomicity gives
+all-or-nothing pair replacement.  A torn write can only lose the *new*
+pair, never corrupt the old one; a checksum mismatch falls back to
+empty state (fresh server), which Raft's protocol tolerates by design.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Tuple
+
+__all__ = ["DiskPersister"]
+
+_MAGIC = b"MRFT"
+_HEADER = struct.Struct("<4sIQQ")  # magic, crc32(payload), len(state), len(snap)
+
+
+class DiskPersister:
+    """File-backed drop-in for :class:`multiraft_tpu.raft.persister.Persister`.
+
+    One instance owns one directory.  Reads are served from an in-memory
+    mirror; every save rewrites ``current.bin`` atomically.
+    """
+
+    def __init__(self, directory: str, fsync: bool = True) -> None:
+        self.dir = directory
+        self.path = os.path.join(directory, "current.bin")
+        self._fsync = fsync
+        os.makedirs(directory, exist_ok=True)
+        self._raft_state, self._snapshot = self._load()
+
+    # -- Persister API -----------------------------------------------------
+
+    def copy(self) -> "DiskPersister":
+        return DiskPersister(self.dir, fsync=self._fsync)
+
+    def save_raft_state(self, state: bytes) -> None:
+        self._write(state, self._snapshot)
+
+    def read_raft_state(self) -> bytes:
+        return self._raft_state
+
+    def raft_state_size(self) -> int:
+        return len(self._raft_state)
+
+    def save_state_and_snapshot(self, state: bytes, snapshot: bytes) -> None:
+        self._write(state, snapshot)
+
+    def read_snapshot(self) -> bytes:
+        return self._snapshot
+
+    def snapshot_size(self) -> int:
+        return len(self._snapshot)
+
+    # -- internals ---------------------------------------------------------
+
+    def _write(self, state: bytes, snapshot: bytes) -> None:
+        payload = state + snapshot
+        header = _HEADER.pack(
+            _MAGIC, zlib.crc32(payload), len(state), len(snapshot)
+        )
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.write(payload)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        if self._fsync:
+            # The rename itself is only durable once the directory entry
+            # is — without this, a power cut can resurrect the *previous*
+            # pair, un-persisting a vote/term and allowing two leaders in
+            # one term.
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        self._raft_state, self._snapshot = state, snapshot
+
+    def _load(self) -> Tuple[bytes, bytes]:
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return b"", b""
+        if len(raw) < _HEADER.size:
+            return b"", b""
+        magic, crc, n_state, n_snap = _HEADER.unpack_from(raw)
+        payload = raw[_HEADER.size:]
+        if (
+            magic != _MAGIC
+            or len(payload) != n_state + n_snap
+            or zlib.crc32(payload) != crc
+        ):
+            return b"", b""
+        return payload[:n_state], payload[n_state:]

@@ -1,0 +1,167 @@
+"""ctypes loader for the native TCP transport (``transport.cpp``).
+
+Built with g++ on first use (same pattern as the porcupine native
+checker — no pybind11 in this image, plain C ABI).  Exposes
+:class:`NativeTransport`, a thin Python veneer over the epoll loop:
+connection ids, framed send, blocking event poll.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["NativeTransport", "native_available", "EV_FRAME", "EV_ACCEPT", "EV_CLOSED"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "transport.cpp")
+_SO = os.path.join(_HERE, "libmrtransport.so")
+
+EV_FRAME, EV_ACCEPT, EV_CLOSED = 0, 1, 2
+
+_lib = None
+_build_failed = False
+_build_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _build_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                # Compile to a process-unique temp name and publish with an
+                # atomic rename: concurrent processes (cluster children,
+                # parallel pytest) must never dlopen a half-written .so.
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                        "-pthread", _SRC, "-o", tmp,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.mrt_create.restype = ctypes.c_void_p
+            lib.mrt_destroy.argtypes = [ctypes.c_void_p]
+            lib.mrt_listen.restype = ctypes.c_int
+            lib.mrt_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            lib.mrt_connect.restype = ctypes.c_int64
+            lib.mrt_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            lib.mrt_send.restype = ctypes.c_int
+            lib.mrt_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+            ]
+            lib.mrt_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.mrt_poll.restype = ctypes.c_int64
+            lib.mrt_poll.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint32,
+                ctypes.c_int,
+            ]
+            _lib = lib
+            return lib
+        except Exception:
+            _build_failed = True
+            return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeTransport:
+    """One epoll IO loop: listener + outbound connections + event queue.
+
+    Thread contract: ``send``/``connect``/``close_conn`` are safe from
+    any thread (serialized against ``close`` by a lock).  ``poll`` is
+    owned by one dispatcher thread, and the owner must stop polling
+    before calling ``close`` — ``RpcNode`` joins its poller first.
+    """
+
+    def __init__(self, buf_size: int = 1 << 20) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native transport unavailable (g++ build failed)")
+        self._lib = lib
+        self._h = lib.mrt_create()
+        self._lock = threading.Lock()
+        self._buf = (ctypes.c_uint8 * buf_size)()
+        self._cap = buf_size
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Bind+listen; returns the bound port (ephemeral for port 0)."""
+        with self._lock:
+            if self._h is None:
+                raise OSError("transport closed")
+            got = self._lib.mrt_listen(self._h, host.encode(), port)
+        if got < 0:
+            raise OSError(f"listen on {host}:{port} failed")
+        return got
+
+    def connect(self, host: str, port: int) -> int:
+        """Begin a non-blocking connect; returns the conn id immediately.
+        A failed handshake later surfaces as an EV_CLOSED event."""
+        with self._lock:
+            if self._h is None:
+                raise ConnectionError("transport closed")
+            cid = self._lib.mrt_connect(self._h, host.encode(), port)
+        if cid < 0:
+            raise ConnectionError(f"connect to {host}:{port} failed")
+        return cid
+
+    def send(self, conn: int, data: bytes) -> bool:
+        arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        with self._lock:
+            if self._h is None:
+                return False
+            return self._lib.mrt_send(self._h, conn, arr, len(data)) == 0
+
+    def close_conn(self, conn: int) -> None:
+        with self._lock:
+            if self._h is not None:
+                self._lib.mrt_close(self._h, conn)
+
+    def poll(self, timeout: float) -> Optional[Tuple[int, int, bytes]]:
+        """Next event as ``(conn_id, type, payload)`` or None on timeout."""
+        if self._h is None:
+            return None
+        conn = ctypes.c_int64()
+        typ = ctypes.c_int()
+        n = self._lib.mrt_poll(
+            self._h, ctypes.byref(conn), ctypes.byref(typ),
+            self._buf, self._cap, int(timeout * 1000),
+        )
+        if n < 0:
+            return None
+        if n > self._cap:  # grow and re-poll (frame stayed queued)
+            self._cap = int(n)
+            self._buf = (ctypes.c_uint8 * self._cap)()
+            return self.poll(timeout)
+        return conn.value, typ.value, bytes(self._buf[: int(n)])
+
+    def close(self) -> None:
+        with self._lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.mrt_destroy(h)
+
+    def __del__(self) -> None:  # pragma: no cover - GC path
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,399 @@
+// Native framed-TCP transport for the distributed runtime.
+//
+// The reference's transport is labrpc: an in-process channel fabric
+// (reference: labrpc/labrpc.go:128-165) — adequate because "serving"
+// there means tests.  This is the real-deployment counterpart: an
+// epoll event loop owning all sockets, speaking length-prefixed binary
+// frames, exposed through a plain C ABI consumed via ctypes (no
+// pybind11 in this image).
+//
+// Model:
+//   * one background IO thread per Transport (epoll_wait loop)
+//   * connections are integer ids; the listener auto-accepts and
+//     surfaces EV_ACCEPT
+//   * mrt_send enqueues a frame (u32 LE length + payload) on any thread
+//   * completed inbound frames surface as EV_FRAME events drained by
+//     mrt_poll (blocking with timeout, mutex+condvar queue)
+//   * EV_CLOSED reports peer disconnect/error; ids are never reused
+//
+// Python owns message semantics (codec, request/reply matching); this
+// layer owns bytes, liveness, and wakeups.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int EV_FRAME = 0;
+constexpr int EV_ACCEPT = 1;
+constexpr int EV_CLOSED = 2;
+
+struct Event {
+  int64_t conn;
+  int type;
+  std::vector<uint8_t> data;
+};
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> rbuf;          // accumulated inbound bytes
+  std::deque<std::vector<uint8_t>> wq;  // pending outbound frames
+  size_t woff = 0;                    // offset into wq.front()
+  bool closed = false;
+  bool connecting = false;  // non-blocking connect still in progress
+};
+
+class Transport {
+ public:
+  Transport() {
+    epfd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = -1;  // wakeup marker
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  ~Transport() {
+    running_ = false;
+    Wake();
+    thread_.join();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& [id, c] : conns_)
+        if (c.fd >= 0) close(c.fd);
+      conns_.clear();
+    }
+    if (listen_fd_ >= 0) close(listen_fd_);
+    close(wake_fd_);
+    close(epfd_);
+  }
+
+  // Returns bound port (listen on port 0 for ephemeral), or -1.
+  int Listen(const char* host, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      close(fd);
+      return -1;
+    }
+    if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        listen(fd, 128) < 0) {
+      close(fd);
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    SetNonblock(fd);
+    listen_fd_ = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = -2;  // listener marker
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    return ntohs(addr.sin_port);
+  }
+
+  // Non-blocking connect: returns a conn id immediately; frames sent
+  // before the handshake completes are queued and flushed when the
+  // socket turns writable.  A failed connect surfaces as EV_CLOSED so
+  // callers' pending RPCs resolve to "dropped" rather than stalling
+  // the caller's event loop on a SYN timeout.
+  int64_t Connect(const char* host, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -1;
+    SetNonblock(fd);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      close(fd);
+      return -1;
+    }
+    int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    bool in_progress = rc < 0 && errno == EINPROGRESS;
+    if (rc < 0 && !in_progress) {
+      close(fd);
+      return -1;
+    }
+    return Register(fd, /*connecting=*/in_progress);
+  }
+
+  bool Send(int64_t id, const uint8_t* data, uint32_t len) {
+    std::vector<uint8_t> frame(4 + len);
+    uint32_t n = htonl(len);
+    memcpy(frame.data(), &n, 4);
+    memcpy(frame.data() + 4, data, len);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = conns_.find(id);
+      if (it == conns_.end() || it->second.closed) return false;
+      it->second.wq.push_back(std::move(frame));
+    }
+    Wake();  // loop flushes; EPOLLOUT armed there if the write stalls
+    return true;
+  }
+
+  void Close(int64_t id) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = conns_.find(id);
+      if (it == conns_.end() || it->second.closed) return;
+      it->second.closed = true;  // loop tears it down
+    }
+    Wake();
+  }
+
+  // Blocks up to timeout_ms for an event.  Returns payload length and
+  // fills conn/type; -1 on timeout.  cap==0 peeks size only (frame
+  // stays queued).
+  int64_t Poll(int64_t* conn, int* type, uint8_t* buf, uint32_t cap,
+               int timeout_ms) {
+    std::unique_lock<std::mutex> g(qmu_);
+    if (!qcv_.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                       [this] { return !events_.empty(); }))
+      return -1;
+    Event& e = events_.front();
+    *conn = e.conn;
+    *type = e.type;
+    int64_t n = static_cast<int64_t>(e.data.size());
+    if (n > 0 && cap < e.data.size()) return n;  // caller re-polls bigger
+    if (n > 0) memcpy(buf, e.data.data(), e.data.size());
+    events_.pop_front();
+    return n;
+  }
+
+ private:
+  void SetNonblock(int fd) {
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  int64_t Register(int fd, bool connecting = false) {
+    SetNonblock(fd);
+    int64_t id = next_id_.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      Conn& c = conns_[id];
+      c.fd = fd;
+      c.connecting = connecting;
+    }
+    epoll_event ev{};
+    // EPOLLOUT completes the handshake for in-progress connects.
+    ev.events = EPOLLIN | (connecting ? EPOLLOUT : 0u);
+    ev.data.u64 = id;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    return id;
+  }
+
+  void Wake() {
+    uint64_t one = 1;
+    [[maybe_unused]] ssize_t r = write(wake_fd_, &one, sizeof(one));
+  }
+
+  void Emit(int64_t conn, int type, std::vector<uint8_t> data = {}) {
+    std::lock_guard<std::mutex> g(qmu_);
+    events_.push_back(Event{conn, type, std::move(data)});
+    qcv_.notify_one();
+  }
+
+  void TearDown(int64_t id, Conn& c, bool notify) {
+    if (c.fd >= 0) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, c.fd, nullptr);
+      close(c.fd);
+      c.fd = -1;
+    }
+    if (notify) Emit(id, EV_CLOSED);
+  }
+
+  void HandleReadable(int64_t id, Conn& c) {
+    uint8_t chunk[65536];
+    for (;;) {
+      ssize_t n = read(c.fd, chunk, sizeof(chunk));
+      if (n > 0) {
+        c.rbuf.insert(c.rbuf.end(), chunk, chunk + n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      c.closed = true;  // EOF or error
+      break;
+    }
+    size_t off = 0;
+    while (c.rbuf.size() - off >= 4) {
+      uint32_t len;
+      memcpy(&len, c.rbuf.data() + off, 4);
+      len = ntohl(len);
+      if (c.rbuf.size() - off - 4 < len) break;
+      Emit(id, EV_FRAME,
+           std::vector<uint8_t>(c.rbuf.begin() + off + 4,
+                                c.rbuf.begin() + off + 4 + len));
+      off += 4 + len;
+    }
+    if (off) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+  }
+
+  // Returns false if the connection died mid-write.
+  bool FlushWrites(int64_t id, Conn& c) {
+    while (!c.wq.empty()) {
+      auto& front = c.wq.front();
+      ssize_t n =
+          write(c.fd, front.data() + c.woff, front.size() - c.woff);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          epoll_event ev{};
+          ev.events = EPOLLIN | EPOLLOUT;
+          ev.data.u64 = id;
+          epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
+          return true;
+        }
+        return false;
+      }
+      c.woff += static_cast<size_t>(n);
+      if (c.woff == front.size()) {
+        c.wq.pop_front();
+        c.woff = 0;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = id;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
+    return true;
+  }
+
+  void Loop() {
+    epoll_event evs[64];
+    while (running_) {
+      int n = epoll_wait(epfd_, evs, 64, 100);
+      if (!running_) return;
+      // Drain the wakeup counter and flush all pending writes.
+      {
+        uint64_t junk;
+        while (read(wake_fd_, &junk, sizeof(junk)) > 0) {
+        }
+        std::lock_guard<std::mutex> g(mu_);
+        for (auto it = conns_.begin(); it != conns_.end();) {
+          Conn& c = it->second;
+          if (c.closed) {
+            TearDown(it->first, c, /*notify=*/false);
+            it = conns_.erase(it);
+            continue;
+          }
+          if (c.fd >= 0 && !c.connecting && !c.wq.empty() &&
+              !FlushWrites(it->first, c)) {
+            TearDown(it->first, c, /*notify=*/true);
+            it = conns_.erase(it);
+            continue;
+          }
+          ++it;
+        }
+      }
+      for (int i = 0; i < n; ++i) {
+        int64_t tag = static_cast<int64_t>(evs[i].data.u64);
+        if (tag == -1) continue;  // wakeup fd, drained above
+        if (tag == -2) {          // listener
+          for (;;) {
+            int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_CLOEXEC);
+            if (fd < 0) break;
+            int64_t id = Register(fd);
+            Emit(id, EV_ACCEPT);
+          }
+          continue;
+        }
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = conns_.find(tag);
+        if (it == conns_.end()) continue;
+        Conn& c = it->second;
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) c.closed = true;
+        if (!c.closed && c.connecting && (evs[i].events & EPOLLOUT)) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          if (err != 0) {
+            c.closed = true;
+          } else {
+            c.connecting = false;  // handshake done; flush below
+          }
+        }
+        if (!c.closed && (evs[i].events & EPOLLIN)) HandleReadable(tag, c);
+        if (!c.closed && !c.connecting && (evs[i].events & EPOLLOUT)) {
+          if (!FlushWrites(tag, c)) c.closed = true;
+        }
+        if (c.closed) {
+          // Deliver any frames parsed before EOF first, then the close.
+          TearDown(tag, c, /*notify=*/true);
+          conns_.erase(it);
+        }
+      }
+    }
+  }
+
+  int epfd_ = -1;
+  int wake_fd_ = -1;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{true};
+  std::atomic<int64_t> next_id_{1};
+  std::thread thread_;
+
+  std::mutex mu_;  // guards conns_
+  std::unordered_map<int64_t, Conn> conns_;
+
+  std::mutex qmu_;  // guards events_
+  std::condition_variable qcv_;
+  std::deque<Event> events_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mrt_create() { return new Transport(); }
+
+void mrt_destroy(void* t) { delete static_cast<Transport*>(t); }
+
+int mrt_listen(void* t, const char* host, int port) {
+  return static_cast<Transport*>(t)->Listen(host, port);
+}
+
+int64_t mrt_connect(void* t, const char* host, int port) {
+  return static_cast<Transport*>(t)->Connect(host, port);
+}
+
+int mrt_send(void* t, int64_t conn, const uint8_t* data, uint32_t len) {
+  return static_cast<Transport*>(t)->Send(conn, data, len) ? 0 : -1;
+}
+
+void mrt_close(void* t, int64_t conn) {
+  static_cast<Transport*>(t)->Close(conn);
+}
+
+int64_t mrt_poll(void* t, int64_t* conn, int* type, uint8_t* buf,
+                 uint32_t cap, int timeout_ms) {
+  return static_cast<Transport*>(t)->Poll(conn, type, buf, cap, timeout_ms);
+}
+
+}  // extern "C"

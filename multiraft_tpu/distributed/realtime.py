@@ -1,0 +1,208 @@
+"""Wall-clock event loop with the sim Scheduler's exact surface.
+
+Every framework component (RaftNode, KVServer, ShardCtrler, clerks) is
+written against the virtual-time ``Scheduler`` API: timers via
+``call_at/call_after/call_soon``, suspension via ``Future``, blocking
+control flow via generator coroutines (``spawn``).  This class provides
+the same contract on real time: one event-loop thread owns all callback
+execution (so the single-threaded mutation model the sim guarantees by
+construction still holds), a monotonic clock replaces virtual ``now``,
+and a thread-safe ``post`` lets IO threads (the TCP transport) marshal
+completions onto the loop.
+
+This is the deployment analog of the reference's goroutine runtime
+(reference: raft/raft.go:51-87) — except there is exactly one mutator
+thread, so the reference's mutex discipline (raft/raft.go:22) has no
+equivalent to get wrong.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+import types
+from typing import Any, Callable, Generator, Optional
+
+from ..sim.scheduler import TIMEOUT, Future, Timer
+
+__all__ = ["RealtimeScheduler"]
+
+
+class RealtimeScheduler:
+    """Drop-in wall-clock implementation of the sim ``Scheduler`` API.
+
+    ``now`` is seconds on a monotonic clock (an absolute epoch is never
+    exposed, matching the sim's relative-time semantics).  All callbacks
+    — timer fires, future resolutions, coroutine steps — execute on the
+    single loop thread.  External threads interact only through
+    :meth:`post` and :meth:`wait`.
+    """
+
+    def __init__(self) -> None:
+        self._origin = time.monotonic()
+        self._heap: list[tuple[float, int, Timer]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._wakeup = threading.Condition(self._lock)
+        self._stopped = False
+        self.fired_events = 0
+        self._thread = threading.Thread(
+            target=self._run, name="multiraft-loop", daemon=True
+        )
+        self._thread.start()
+
+    # -- clock ------------------------------------------------------------
+
+    @property
+    def now(self) -> float:
+        return time.monotonic() - self._origin
+
+    # -- scheduling (sim-compatible) --------------------------------------
+
+    def call_at(self, when: float, fn: Callable, *args: Any) -> Timer:
+        timer = Timer(when, fn, args)
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._heap, (when, self._seq, timer))
+            self._wakeup.notify()
+        return timer
+
+    def call_after(self, delay: float, fn: Callable, *args: Any) -> Timer:
+        return self.call_at(self.now + delay, fn, *args)
+
+    def call_soon(self, fn: Callable, *args: Any) -> Timer:
+        return self.call_at(self.now, fn, *args)
+
+    # ``post`` is the documented thread-safe entry point; internally
+    # call_at already locks, so they share one implementation.
+    post = call_soon
+
+    # -- futures / coroutines (same semantics as sim Scheduler) -----------
+
+    def sleep(self, delay: float) -> Future:
+        fut = Future()
+        self.call_after(delay, fut.resolve, None)
+        return fut
+
+    def with_timeout(self, fut: Future, timeout: float) -> Future:
+        out = Future()
+        timer = self.call_after(timeout, out.resolve, TIMEOUT)
+
+        def _done(f: Future) -> None:
+            timer.cancel()
+            out.resolve(f.value)
+
+        fut.add_done_callback(_done)
+        return out
+
+    def spawn(self, gen: Generator) -> Future:
+        result = Future()
+        if not isinstance(gen, types.GeneratorType):
+            result.resolve(gen)
+            return result
+
+        def step(send_value: Any) -> None:
+            try:
+                waited = gen.send(send_value)
+            except StopIteration as stop:
+                result.resolve(stop.value)
+                return
+            if isinstance(waited, Future):
+                waited.add_done_callback(lambda f: self.post(step, f.value))
+            elif isinstance(waited, (int, float)):
+                self.call_after(float(waited), step, None)
+            else:  # pragma: no cover - defensive
+                raise TypeError(f"coroutine yielded {waited!r}")
+
+        self.call_soon(step, None)
+        return result
+
+    # -- cross-thread waiting ---------------------------------------------
+
+    def wait(self, fut: Future, timeout: Optional[float] = None) -> Any:
+        """Block the *calling* (non-loop) thread until ``fut`` resolves.
+
+        Returns the future's value, or :data:`TIMEOUT` on timeout.  The
+        external-thread analog of the sim's ``run_until``.
+
+        ``Future`` is not thread-safe (it never needs to be on the loop),
+        so the callback is *attached on the loop thread* — the same
+        thread every resolve runs on — making the done-check/append
+        sequence race-free by construction.
+        """
+        done = threading.Event()
+        box: list[Any] = []
+
+        def _resolved(f: Future) -> None:
+            box.append(f.value)
+            done.set()
+
+        self.post(lambda: fut.add_done_callback(_resolved))
+        if not done.wait(timeout):
+            return TIMEOUT
+        return box[0]
+
+    def run_call(self, fn: Callable, *args: Any, timeout: float = 30.0) -> Any:
+        """Run ``fn(*args)`` on the loop thread and return its result to
+        the calling thread; exceptions propagate to the caller instead of
+        dying on the loop (construction-time errors must be loud)."""
+        fut = Future()
+
+        def _invoke() -> None:
+            try:
+                fut.resolve((True, fn(*args)))
+            except BaseException as e:  # noqa: BLE001 - transported
+                fut.resolve((False, e))
+
+        self.post(_invoke)
+        out = self.wait(fut, timeout)
+        if out is TIMEOUT:
+            raise TimeoutError(f"run_call timed out after {timeout}s")
+        ok, value = out
+        if not ok:
+            raise value
+        return value
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._wakeup.notify()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                while True:
+                    if not self._heap:
+                        self._wakeup.wait()
+                        if self._stopped:
+                            return
+                        continue
+                    when, _, timer = self._heap[0]
+                    if timer.cancelled:
+                        heapq.heappop(self._heap)
+                        continue
+                    delay = when - self.now
+                    if delay <= 0:
+                        heapq.heappop(self._heap)
+                        break
+                    self._wakeup.wait(delay)
+                    if self._stopped:
+                        return
+                fn, args = timer._fn, timer._args
+                timer._fn, timer._args = None, ()
+            if fn is None:  # cancelled between pop and dispatch
+                continue
+            self.fired_events += 1
+            try:
+                fn(*args)
+            except Exception:  # pragma: no cover - keep the loop alive
+                import traceback
+
+                traceback.print_exc()

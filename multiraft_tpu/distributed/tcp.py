@@ -1,0 +1,199 @@
+"""RPC endpoints over the native TCP transport.
+
+Exposes the same contract the simulated network gives the framework —
+``ClientEnd.call(svc_meth, args) → Future`` with ``None`` meaning "RPC
+failed" (labrpc's boolean ``ok``, reference: labrpc/labrpc.go:87-126) —
+but across real processes.  One :class:`RpcNode` per process owns one
+epoll transport, one dispatcher thread, and the process's
+``RealtimeScheduler``; every handler and future resolution runs on the
+scheduler loop, so RaftNode/KVServer/clerk code is byte-identical
+between sim and deployment.
+
+Frames are codec-encoded tuples:
+
+    ("req", req_id, svc_meth, args)   caller → callee
+    ("rep", req_id, value)            callee → caller
+
+Handlers returning generator coroutines (the wait-channel pattern,
+reference: kvraft/server.go:56-96) are spawned; the reply ships when
+their future resolves.  A dropped connection resolves all its pending
+calls with ``None`` and the next call reconnects — the client-side
+retry loops (reference: kvraft/client.go:47-71) handle the rest.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..sim.scheduler import Future
+from ..transport import codec
+from .native import EV_CLOSED, EV_FRAME, NativeTransport
+from .realtime import RealtimeScheduler
+
+__all__ = ["RpcNode", "TcpClientEnd"]
+
+
+class TcpClientEnd:
+    """ClientEnd bound to a ``(host, port)`` server address."""
+
+    def __init__(self, node: "RpcNode", host: str, port: int) -> None:
+        self._node = node
+        self.addr = (host, port)
+
+    def call(self, svc_meth: str, args: Any) -> Future:
+        return self._node._call(self.addr, svc_meth, args)
+
+
+class RpcNode:
+    """One process's RPC endpoint: optional listener + outbound calls."""
+
+    def __init__(
+        self,
+        sched: Optional[RealtimeScheduler] = None,
+        listen: bool = False,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.sched = sched or RealtimeScheduler()
+        self._tr = NativeTransport()
+        self.host, self.port = host, 0
+        if listen:
+            self.port = self._tr.listen(host, port)
+        self._services: Dict[str, Any] = {}
+        self._req_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Tuple[int, Future]] = {}  # req_id → (conn, fut)
+        self._conns: Dict[Tuple[str, int], int] = {}  # addr → conn id
+        self._closed = False
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="mrt-rpc-poll", daemon=True
+        )
+        self._poller.start()
+
+    # -- service side ------------------------------------------------------
+
+    def add_service(self, name: str, obj: Any) -> None:
+        """Register ``obj`` under ``name``; ``name.method`` dispatches to
+        ``obj.method`` (CamelCase RPC names map via lowercase_underscore,
+        mirroring the sim network's Service dispatch)."""
+        self._services[name] = obj
+
+    def client_end(self, host: str, port: int) -> TcpClientEnd:
+        return TcpClientEnd(self, host, port)
+
+    # -- internals ---------------------------------------------------------
+
+    def _conn_for(self, addr: Tuple[str, int]) -> Optional[int]:
+        with self._lock:
+            cid = self._conns.get(addr)
+        if cid is not None:
+            return cid
+        try:
+            cid = self._tr.connect(*addr)
+        except ConnectionError:
+            return None
+        with self._lock:
+            self._conns[addr] = cid
+        return cid
+
+    def _call(self, addr: Tuple[str, int], svc_meth: str, args: Any) -> Future:
+        fut = Future()
+        cid = self._conn_for(addr)
+        if cid is None:
+            # Resolve asynchronously so callers may attach callbacks first.
+            self.sched.call_soon(fut.resolve, None)
+            return fut
+        req_id = next(self._req_ids)
+        with self._lock:
+            self._pending[req_id] = (cid, fut)
+        ok = self._tr.send(cid, codec.encode(("req", req_id, svc_meth, args)))
+        if not ok:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            self.sched.call_soon(fut.resolve, None)
+        return fut
+
+    def _poll_loop(self) -> None:
+        while not self._closed:
+            ev = self._tr.poll(0.2)
+            if ev is None:
+                continue
+            conn, typ, payload = ev
+            if typ == EV_FRAME:
+                try:
+                    msg = codec.decode(payload)
+                except Exception:
+                    continue
+                if msg[0] == "req":
+                    _, req_id, svc_meth, args = msg
+                    self.sched.post(self._dispatch, conn, req_id, svc_meth, args)
+                elif msg[0] == "rep":
+                    _, req_id, value = msg
+                    with self._lock:
+                        entry = self._pending.pop(req_id, None)
+                    if entry is not None:
+                        self.sched.post(entry[1].resolve, value)
+            elif typ == EV_CLOSED:
+                self._on_closed(conn)
+
+    def _on_closed(self, conn: int) -> None:
+        with self._lock:
+            for addr, cid in list(self._conns.items()):
+                if cid == conn:
+                    del self._conns[addr]
+            dead = [
+                (rid, fut)
+                for rid, (cid, fut) in self._pending.items()
+                if cid == conn
+            ]
+            for rid, _ in dead:
+                del self._pending[rid]
+        for _, fut in dead:
+            self.sched.post(fut.resolve, None)
+
+    def _dispatch(self, conn: int, req_id: int, svc_meth: str, args: Any) -> None:
+        # Runs on the scheduler loop.
+        try:
+            svc_name, meth = svc_meth.split(".", 1)
+            obj = self._services[svc_name]
+            py_name = _snake(meth)
+            handler = getattr(obj, py_name)
+            result = handler(args)
+        except Exception:
+            result = None
+        reply_fut = self.sched.spawn(result) if _is_gen(result) else None
+        if reply_fut is None:
+            self._reply(conn, req_id, result)
+        else:
+            reply_fut.add_done_callback(
+                lambda f: self._reply(conn, req_id, f.value)
+            )
+
+    def _reply(self, conn: int, req_id: int, value: Any) -> None:
+        try:
+            self._tr.send(conn, codec.encode(("rep", req_id, value)))
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._poller.join(timeout=2.0)
+        self._tr.close()
+
+
+def _is_gen(obj: Any) -> bool:
+    import types
+
+    return isinstance(obj, types.GeneratorType)
+
+
+def _snake(name: str) -> str:
+    """``RequestVote`` → ``request_vote``; already-snake names pass through."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0 and (name[i - 1].islower() or name[i - 1].isdigit()):
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)

@@ -30,11 +30,15 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            # Compile to a process-unique temp and publish atomically so
+            # concurrent processes never dlopen a half-written .so.
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
                 check=True,
                 capture_output=True,
             )
+            os.replace(tmp, _SO)
         lib = ctypes.CDLL(_SO)
         lib.check_kv_partition.restype = ctypes.c_int
         lib.check_kv_partition.argtypes = [

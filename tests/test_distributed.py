@@ -1,0 +1,373 @@
+"""Tests for the real-deployment runtime: wall-clock scheduler, disk
+persister, native TCP transport, RPC nodes, and the multi-process KV
+cluster (the deployment analog of the reference's simulated harnesses,
+reference: kvraft/config.go — but over real sockets and real crashes).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from multiraft_tpu.distributed.disk import DiskPersister
+from multiraft_tpu.distributed.native import (
+    EV_ACCEPT,
+    EV_CLOSED,
+    EV_FRAME,
+    NativeTransport,
+    native_available,
+)
+from multiraft_tpu.distributed.realtime import RealtimeScheduler
+from multiraft_tpu.sim.scheduler import TIMEOUT
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+# ---------------------------------------------------------------------------
+# DiskPersister
+# ---------------------------------------------------------------------------
+
+
+class TestDiskPersister:
+    def test_roundtrip_and_restart(self, tmp_path):
+        p = DiskPersister(str(tmp_path / "d"), fsync=False)
+        p.save_state_and_snapshot(b"state-1", b"snap-1")
+        assert p.read_raft_state() == b"state-1"
+        assert p.read_snapshot() == b"snap-1"
+        # A fresh instance on the same dir sees the pair (crash/restart).
+        q = DiskPersister(str(tmp_path / "d"), fsync=False)
+        assert q.read_raft_state() == b"state-1"
+        assert q.read_snapshot() == b"snap-1"
+        assert q.raft_state_size() == 7 and q.snapshot_size() == 6
+
+    def test_state_only_save_preserves_snapshot(self, tmp_path):
+        p = DiskPersister(str(tmp_path / "d"), fsync=False)
+        p.save_state_and_snapshot(b"s1", b"snap")
+        p.save_raft_state(b"s2")
+        q = DiskPersister(str(tmp_path / "d"), fsync=False)
+        assert q.read_raft_state() == b"s2"
+        assert q.read_snapshot() == b"snap"
+
+    def test_corrupt_file_falls_back_to_empty(self, tmp_path):
+        p = DiskPersister(str(tmp_path / "d"), fsync=False)
+        p.save_state_and_snapshot(b"state", b"snap")
+        with open(p.path, "r+b") as f:
+            f.seek(20)
+            f.write(b"\xff\xff\xff")
+        q = DiskPersister(str(tmp_path / "d"), fsync=False)
+        assert q.read_raft_state() == b""
+        assert q.read_snapshot() == b""
+
+    def test_empty_dir(self, tmp_path):
+        p = DiskPersister(str(tmp_path / "nope"), fsync=False)
+        assert p.read_raft_state() == b""
+
+
+# ---------------------------------------------------------------------------
+# RealtimeScheduler
+# ---------------------------------------------------------------------------
+
+
+class TestRealtimeScheduler:
+    def test_timer_fires_in_order(self):
+        sched = RealtimeScheduler()
+        try:
+            got = []
+            sched.call_after(0.05, got.append, 2)
+            sched.call_after(0.01, got.append, 1)
+            fut = sched.sleep(0.1)
+            assert sched.wait(fut, 2.0) is None
+            assert got == [1, 2]
+        finally:
+            sched.stop()
+
+    def test_with_timeout(self):
+        sched = RealtimeScheduler()
+        try:
+            from multiraft_tpu.sim.scheduler import Future
+
+            never = Future()
+            out = sched.with_timeout(never, 0.05)
+            assert sched.wait(out, 2.0) is TIMEOUT
+
+            quick = sched.sleep(0.01)
+            out2 = sched.with_timeout(quick, 5.0)
+            assert sched.wait(out2, 2.0) is None
+        finally:
+            sched.stop()
+
+    def test_spawn_coroutine(self):
+        sched = RealtimeScheduler()
+        try:
+            def coro():
+                yield sched.sleep(0.01)
+                v = yield sched.spawn(inner())
+                return v + 1
+
+            def inner():
+                yield 0.01  # numeric yield sleeps
+                return 41
+
+            assert sched.wait(sched.spawn(coro()), 2.0) == 42
+        finally:
+            sched.stop()
+
+    def test_run_call_returns_value(self):
+        sched = RealtimeScheduler()
+        try:
+            assert sched.run_call(lambda: 7) == 7
+        finally:
+            sched.stop()
+
+    def test_cancelled_timer_does_not_fire(self):
+        sched = RealtimeScheduler()
+        try:
+            got = []
+            t = sched.call_after(0.05, got.append, 1)
+            t.cancel()
+            sched.wait(sched.sleep(0.1), 2.0)
+            assert got == []
+        finally:
+            sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Native transport
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestNativeTransport:
+    def test_frame_roundtrip(self):
+        srv, cli = NativeTransport(), NativeTransport()
+        try:
+            port = srv.listen()
+            conn = cli.connect("127.0.0.1", port)
+            assert cli.send(conn, b"hello world")
+            ev = srv.poll(2.0)
+            assert ev is not None and ev[1] == EV_ACCEPT
+            ev = srv.poll(2.0)
+            assert ev is not None and ev[1] == EV_FRAME and ev[2] == b"hello world"
+            # Reply on the accepted conn id.
+            assert srv.send(ev[0], b"pong")
+            ev2 = cli.poll(2.0)
+            assert ev2 is not None and ev2[1] == EV_FRAME and ev2[2] == b"pong"
+        finally:
+            srv.close()
+            cli.close()
+
+    def test_large_frame(self):
+        srv, cli = NativeTransport(), NativeTransport()
+        try:
+            port = srv.listen()
+            conn = cli.connect("127.0.0.1", port)
+            blob = os.urandom(3 * 1024 * 1024)
+            assert cli.send(conn, blob)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                ev = srv.poll(2.0)
+                if ev is not None and ev[1] == EV_FRAME:
+                    assert ev[2] == blob
+                    break
+            else:
+                pytest.fail("large frame never arrived")
+        finally:
+            srv.close()
+            cli.close()
+
+    def test_close_event(self):
+        srv, cli = NativeTransport(), NativeTransport()
+        try:
+            port = srv.listen()
+            cli.connect("127.0.0.1", port)
+            ev = srv.poll(2.0)
+            assert ev is not None and ev[1] == EV_ACCEPT
+            cli.close()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                ev = srv.poll(1.0)
+                if ev is not None and ev[1] == EV_CLOSED:
+                    return
+            pytest.fail("no EV_CLOSED after peer destroyed")
+        finally:
+            srv.close()
+
+    def test_many_frames_ordered(self):
+        srv, cli = NativeTransport(), NativeTransport()
+        try:
+            port = srv.listen()
+            conn = cli.connect("127.0.0.1", port)
+            for i in range(500):
+                assert cli.send(conn, f"msg-{i}".encode())
+            got = []
+            deadline = time.time() + 10
+            while len(got) < 500 and time.time() < deadline:
+                ev = srv.poll(1.0)
+                if ev is not None and ev[1] == EV_FRAME:
+                    got.append(ev[2])
+            assert got == [f"msg-{i}".encode() for i in range(500)]
+        finally:
+            srv.close()
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# RPC layer
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestRpc:
+    def test_echo_service(self):
+        from multiraft_tpu.distributed.tcp import RpcNode
+
+        class Echo:
+            def shout(self, args):
+                return ("echo", args)
+
+        server = RpcNode(listen=True)
+        client = RpcNode()
+        try:
+            server.add_service("Echo", Echo())
+            end = client.client_end("127.0.0.1", server.port)
+            fut = end.call("Echo.shout", "hi")
+            assert client.sched.wait(fut, 5.0) == ("echo", "hi")
+        finally:
+            client.close()
+            server.close()
+            client.sched.stop()
+            server.sched.stop()
+
+    def test_generator_handler(self):
+        from multiraft_tpu.distributed.tcp import RpcNode
+
+        server = RpcNode(listen=True)
+        client = RpcNode()
+
+        class Slow:
+            def __init__(self, sched):
+                self.sched = sched
+
+            def wait_then(self, args):
+                yield self.sched.sleep(0.05)
+                return args * 2
+
+        try:
+            server.add_service("Slow", Slow(server.sched))
+            end = client.client_end("127.0.0.1", server.port)
+            fut = end.call("Slow.wait_then", 21)
+            assert client.sched.wait(fut, 5.0) == 42
+        finally:
+            client.close()
+            server.close()
+            client.sched.stop()
+            server.sched.stop()
+
+    def test_call_to_dead_server_resolves_none(self):
+        from multiraft_tpu.distributed.tcp import RpcNode
+
+        client = RpcNode()
+        try:
+            end = client.client_end("127.0.0.1", 1)  # nothing listens there
+            fut = end.call("X.y", None)
+            assert client.sched.wait(fut, 5.0) is None
+        finally:
+            client.close()
+            client.sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# In-process TCP KV group (3 RpcNodes, real sockets, one process)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestTcpKVGroup:
+    def test_put_get_append_over_sockets(self, tmp_path):
+        from multiraft_tpu.distributed.cluster import BlockingClerk, serve_kv
+
+        import socket
+
+        ports = []
+        socks = []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+
+        nodes = [serve_kv(i, ports, str(tmp_path)) for i in range(3)]
+        clerk = BlockingClerk(ports)
+        try:
+            clerk.put("k", "v1")
+            assert clerk.get("k") == "v1"
+            clerk.append("k", "+v2")
+            assert clerk.get("k") == "v1+v2"
+            assert clerk.get("missing") == ""
+        finally:
+            clerk.close()
+            clerk.sched.stop()
+            for n in nodes:
+                n.close()
+                n.sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Multi-process cluster: real processes, real kill -9, disk recovery
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestProcessCluster:
+    def test_survives_minority_crash_and_restart(self, tmp_path):
+        from multiraft_tpu.distributed.cluster import KVProcessCluster
+
+        cluster = KVProcessCluster(3, str(tmp_path))
+        try:
+            cluster.start_all()
+            clerk = cluster.clerk()
+            clerk.put("a", "1")
+            clerk.append("a", "2")
+            assert clerk.get("a") == "12"
+
+            # Hard-kill one server; quorum of 2 keeps serving.
+            cluster.kill(0)
+            clerk.put("b", "x")
+            assert clerk.get("b") == "x"
+
+            # Restart it from its data dir; full cluster serves on.
+            cluster.start(0)
+            clerk.append("a", "3")
+            assert clerk.get("a") == "123"
+            clerk.close()
+            clerk.sched.stop()
+        finally:
+            cluster.shutdown()
+
+    def test_data_survives_full_cluster_restart(self, tmp_path):
+        from multiraft_tpu.distributed.cluster import KVProcessCluster
+
+        cluster = KVProcessCluster(3, str(tmp_path))
+        try:
+            cluster.start_all()
+            clerk = cluster.clerk()
+            clerk.put("persisted", "yes")
+            clerk.close()
+            clerk.sched.stop()
+
+            for i in range(3):
+                cluster.kill(i)
+            cluster.start_all()
+
+            clerk2 = cluster.clerk()
+            assert clerk2.get("persisted") == "yes"
+            clerk2.close()
+            clerk2.sched.stop()
+        finally:
+            cluster.shutdown()
