@@ -59,6 +59,11 @@ def serve_kv(
     )
     node.add_service("KVServer", srv)
     node.add_service("Raft", srv.rf)
+    if os.environ.get("MRT_DEBUG"):
+        def _dump() -> None:
+            print(f"[{time.monotonic():.2f}] {srv.rf!r}", file=sys.stderr, flush=True)
+            sched.call_after(1.0, _dump)
+        sched.call_soon(_dump)
     return node
 
 
@@ -94,8 +99,14 @@ class BlockingClerk:
         self._clerk = Clerk(self.sched, ends)
 
     def _run(self, gen, timeout: float) -> Any:
-        value = self.sched.wait(self.sched.spawn(gen), timeout)
+        fut = self.sched.spawn(gen)
+        value = self.sched.wait(fut, timeout)
         if value is TIMEOUT:
+            # Cancel the abandoned retry loop (resolving the spawn future
+            # halts the coroutine at its next step) — otherwise it would
+            # spin forever and race the caller's next command on this
+            # single-outstanding-op Clerk.
+            self.sched.post(fut.resolve, TIMEOUT)
             raise TimeoutError("cluster did not answer in time")
         return value
 
@@ -128,8 +139,10 @@ class KVProcessCluster:
         self.host = host
         self.data_dir = data_dir
         self.maxraftstate = maxraftstate
-        # Reserve n distinct ephemeral ports (bind/close; the race window
-        # is acceptable for tests and the cluster retries on failure).
+        # Reserve n distinct ephemeral ports by bind/close.  There is a
+        # small window where another process could grab one before the
+        # child listens — in that case start() raises and the caller
+        # builds a fresh cluster; acceptable for a test/ops driver.
         self.ports: List[int] = []
         socks = []
         for _ in range(n):
@@ -157,18 +170,28 @@ class KVProcessCluster:
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        self.procs[i] = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "multiraft_tpu.distributed.cluster",
-                json.dumps(spec),
-            ],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            env=env,
-            text=True,
-        )
+        log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stderr = open(os.path.join(log_dir, f"server-{i}.err"), "a")
+        else:
+            stderr = subprocess.DEVNULL
+        try:
+            self.procs[i] = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "multiraft_tpu.distributed.cluster",
+                    json.dumps(spec),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=stderr,
+                env=env,
+                text=True,
+            )
+        finally:
+            if log_dir:
+                stderr.close()
         line = self.procs[i].stdout.readline()
         if not line.startswith("ready"):
             raise RuntimeError(f"server {i} failed to start: {line!r}")

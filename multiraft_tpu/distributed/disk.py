@@ -4,15 +4,29 @@ The reference's Persister is in-memory byte slices with an atomic
 (state, snapshot) pair save (reference: raft/persister.go:57-64); crash
 realism comes from the test fixture copying it into the reborn server
 (reference: raft/config.go:113-142).  A real deployment needs the same
-contract from the filesystem: the pair must be visible atomically — the
-service snapshot must never run ahead of the raft state it belongs to.
+contract from the filesystem.
 
-Implementation: both blobs are written to one temp file
-(length-prefixed, checksummed) in the target directory, fsync'd, then
-``rename``'d over ``current.bin`` — POSIX rename atomicity gives
-all-or-nothing pair replacement.  A torn write can only lose the *new*
-pair, never corrupt the old one; a checksum mismatch falls back to
-empty state (fresh server), which Raft's protocol tolerates by design.
+Layout: two rename-atomic files, ``state.bin`` (term/vote/log) and
+``snap.bin`` (service snapshot), each length-prefixed and checksummed
+(the CRC covers the length too, so a corrupted header can't silently
+mis-frame the blob).  Splitting them keeps the hot path cheap: raft
+state is re-persisted on every vote/term/log mutation (reference quirk
+#6, raft/raft.go:205-216), and must not drag a multi-megabyte snapshot
+plus its fsync along each time.
+
+Crash-ordering invariant: the snapshot is made durable *before* any
+raft state whose log was compacted against it.  The dangerous crash is
+(new state, old snapshot): the trimmed log no longer covers the gap
+above the old snapshot, so entries are lost forever.  The reverse —
+(old state, new snapshot) — is safe: on restart the service boots from
+the newer snapshot and the re-applied older entries are filtered by the
+per-client dup table (same at-most-once machinery that absorbs
+duplicate RPCs).  ``save_state_and_snapshot`` therefore fsyncs
+``snap.bin`` to disk before touching ``state.bin``.
+
+A torn write can only lose the *new* blob, never corrupt the old one
+(POSIX rename atomicity); a checksum mismatch falls back to empty state
+(fresh server), which Raft's protocol tolerates by design.
 """
 
 from __future__ import annotations
@@ -20,27 +34,29 @@ from __future__ import annotations
 import os
 import struct
 import zlib
-from typing import Tuple
 
 __all__ = ["DiskPersister"]
 
-_MAGIC = b"MRFT"
-_HEADER = struct.Struct("<4sIQQ")  # magic, crc32(payload), len(state), len(snap)
+_MAGIC = b"MRF2"
+_HEADER = struct.Struct("<4sIQ")  # magic, crc32(len ‖ body), len(body)
+_LEN = struct.Struct("<Q")
 
 
 class DiskPersister:
     """File-backed drop-in for :class:`multiraft_tpu.raft.persister.Persister`.
 
     One instance owns one directory.  Reads are served from an in-memory
-    mirror; every save rewrites ``current.bin`` atomically.
+    mirror; every save rewrites the corresponding file atomically.
     """
 
     def __init__(self, directory: str, fsync: bool = True) -> None:
         self.dir = directory
-        self.path = os.path.join(directory, "current.bin")
+        self._state_path = os.path.join(directory, "state.bin")
+        self._snap_path = os.path.join(directory, "snap.bin")
         self._fsync = fsync
         os.makedirs(directory, exist_ok=True)
-        self._raft_state, self._snapshot = self._load()
+        self._raft_state = self._load(self._state_path)
+        self._snapshot = self._load(self._snap_path)
 
     # -- Persister API -----------------------------------------------------
 
@@ -48,7 +64,8 @@ class DiskPersister:
         return DiskPersister(self.dir, fsync=self._fsync)
 
     def save_raft_state(self, state: bytes) -> None:
-        self._write(state, self._snapshot)
+        self._write(self._state_path, state)
+        self._raft_state = state
 
     def read_raft_state(self) -> bytes:
         return self._raft_state
@@ -57,7 +74,10 @@ class DiskPersister:
         return len(self._raft_state)
 
     def save_state_and_snapshot(self, state: bytes, snapshot: bytes) -> None:
-        self._write(state, snapshot)
+        # Snapshot first — see the crash-ordering invariant above.
+        self._write(self._snap_path, snapshot)
+        self._write(self._state_path, state)
+        self._raft_state, self._snapshot = state, snapshot
 
     def read_snapshot(self) -> bytes:
         return self._snapshot
@@ -67,45 +87,44 @@ class DiskPersister:
 
     # -- internals ---------------------------------------------------------
 
-    def _write(self, state: bytes, snapshot: bytes) -> None:
-        payload = state + snapshot
-        header = _HEADER.pack(
-            _MAGIC, zlib.crc32(payload), len(state), len(snapshot)
-        )
-        tmp = self.path + ".tmp"
+    def _write(self, path: str, body: bytes) -> None:
+        # Running CRC over (length ‖ body) without concatenating — the
+        # body can be a multi-megabyte snapshot on the hot persist path.
+        crc = zlib.crc32(body, zlib.crc32(_LEN.pack(len(body))))
+        tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(header)
-            f.write(payload)
+            f.write(_HEADER.pack(_MAGIC, crc, len(body)))
+            f.write(body)
             f.flush()
             if self._fsync:
                 os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        os.replace(tmp, path)
         if self._fsync:
             # The rename itself is only durable once the directory entry
             # is — without this, a power cut can resurrect the *previous*
-            # pair, un-persisting a vote/term and allowing two leaders in
+            # blob, un-persisting a vote/term and allowing two leaders in
             # one term.
             dfd = os.open(self.dir, os.O_RDONLY)
             try:
                 os.fsync(dfd)
             finally:
                 os.close(dfd)
-        self._raft_state, self._snapshot = state, snapshot
 
-    def _load(self) -> Tuple[bytes, bytes]:
+    @staticmethod
+    def _load(path: str) -> bytes:
         try:
-            with open(self.path, "rb") as f:
+            with open(path, "rb") as f:
                 raw = f.read()
         except FileNotFoundError:
-            return b"", b""
+            return b""
         if len(raw) < _HEADER.size:
-            return b"", b""
-        magic, crc, n_state, n_snap = _HEADER.unpack_from(raw)
-        payload = raw[_HEADER.size:]
+            return b""
+        magic, crc, n = _HEADER.unpack_from(raw)
+        body = raw[_HEADER.size:]
         if (
             magic != _MAGIC
-            or len(payload) != n_state + n_snap
-            or zlib.crc32(payload) != crc
+            or len(body) != n
+            or zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc
         ):
-            return b"", b""
-        return payload[:n_state], payload[n_state:]
+            return b""
+        return body

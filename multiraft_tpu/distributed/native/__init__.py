@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
+
+from ...utils.native_build import build_and_load
 
 __all__ = ["NativeTransport", "native_available", "EV_FRAME", "EV_ACCEPT", "EV_CLOSED"]
 
@@ -37,21 +38,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-                # Compile to a process-unique temp name and publish with an
-                # atomic rename: concurrent processes (cluster children,
-                # parallel pytest) must never dlopen a half-written .so.
-                tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [
-                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                        "-pthread", _SRC, "-o", tmp,
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
-                os.replace(tmp, _SO)
-            lib = ctypes.CDLL(_SO)
+            lib = build_and_load(_SRC, _SO, extra_flags=["-pthread"])
             lib.mrt_create.restype = ctypes.c_void_p
             lib.mrt_destroy.argtypes = [ctypes.c_void_p]
             lib.mrt_listen.restype = ctypes.c_int

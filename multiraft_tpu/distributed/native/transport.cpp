@@ -328,8 +328,7 @@ class Transport {
         auto it = conns_.find(tag);
         if (it == conns_.end()) continue;
         Conn& c = it->second;
-        if (evs[i].events & (EPOLLHUP | EPOLLERR)) c.closed = true;
-        if (!c.closed && c.connecting && (evs[i].events & EPOLLOUT)) {
+        if (c.connecting && (evs[i].events & EPOLLOUT)) {
           int err = 0;
           socklen_t elen = sizeof(err);
           getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &elen);
@@ -339,7 +338,12 @@ class Transport {
             c.connecting = false;  // handshake done; flush below
           }
         }
-        if (!c.closed && (evs[i].events & EPOLLIN)) HandleReadable(tag, c);
+        // Drain readable bytes BEFORE honoring HUP/ERR: a peer that
+        // writes a reply and dies delivers EPOLLIN|EPOLLHUP in one
+        // event, and the final frame must not be discarded.
+        if (!c.closed && !c.connecting && (evs[i].events & EPOLLIN))
+          HandleReadable(tag, c);
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) c.closed = true;
         if (!c.closed && !c.connecting && (evs[i].events & EPOLLOUT)) {
           if (!FlushWrites(tag, c)) c.closed = true;
         }

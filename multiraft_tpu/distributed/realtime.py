@@ -103,6 +103,9 @@ class RealtimeScheduler:
             return result
 
         def step(send_value: Any) -> None:
+            if result.done:  # cancelled from outside (BlockingClerk timeout)
+                gen.close()
+                return
             try:
                 waited = gen.send(send_value)
             except StopIteration as stop:

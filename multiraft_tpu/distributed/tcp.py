@@ -24,6 +24,8 @@ retry loops (reference: kvraft/client.go:47-71) handle the rest.
 from __future__ import annotations
 
 import itertools
+import os
+import sys
 import threading
 from typing import Any, Dict, Optional, Tuple
 
@@ -86,15 +88,20 @@ class RpcNode:
     # -- internals ---------------------------------------------------------
 
     def _conn_for(self, addr: Tuple[str, int]) -> Optional[int]:
+        # The addr→cid store must happen under the same lock section as
+        # the connect itself: a failed non-blocking handshake can emit
+        # EV_CLOSED before this thread stores the mapping, and
+        # ``_on_closed`` (poller thread) must block on the lock until the
+        # entry exists — otherwise the dead cid is cached forever and the
+        # address goes permanently dark.
         with self._lock:
             cid = self._conns.get(addr)
-        if cid is not None:
-            return cid
-        try:
-            cid = self._tr.connect(*addr)
-        except ConnectionError:
-            return None
-        with self._lock:
+            if cid is not None:
+                return cid
+            try:
+                cid = self._tr.connect(*addr)
+            except ConnectionError:
+                return None
             self._conns[addr] = cid
         return cid
 
@@ -110,31 +117,49 @@ class RpcNode:
             self._pending[req_id] = (cid, fut)
         ok = self._tr.send(cid, codec.encode(("req", req_id, svc_meth, args)))
         if not ok:
+            # The transport no longer knows this conn (torn down between
+            # our lookup and the send) — drop the stale cache entry so the
+            # next call reconnects instead of failing fast forever.
             with self._lock:
                 self._pending.pop(req_id, None)
+                if self._conns.get(addr) == cid:
+                    del self._conns[addr]
             self.sched.call_soon(fut.resolve, None)
         return fut
 
     def _poll_loop(self) -> None:
+        # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
+        dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
         while not self._closed:
             ev = self._tr.poll(0.2)
             if ev is None:
                 continue
             conn, typ, payload = ev
             if typ == EV_FRAME:
+                # One malformed frame must never kill the poller thread —
+                # the node would go permanently dark.  Shape errors
+                # (IndexError on msg[...]) are as fatal as decode errors.
                 try:
                     msg = codec.decode(payload)
-                except Exception:
+                    if dbg:
+                        head = f"{msg[0]} conn={conn} " + (
+                            f"{msg[2]} {msg[3]!r}" if msg[0] == "req" else f"{msg[2]!r}"
+                        )
+                        print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
+                    if msg[0] == "req":
+                        _, req_id, svc_meth, args = msg
+                        self.sched.post(self._dispatch, conn, req_id, svc_meth, args)
+                    elif msg[0] == "rep":
+                        _, req_id, value = msg
+                        with self._lock:
+                            entry = self._pending.pop(req_id, None)
+                        if entry is not None:
+                            self.sched.post(entry[1].resolve, value)
+                except Exception as exc:
+                    if dbg:
+                        print(f"[rpc] bad frame dropped: {exc!r}",
+                              file=sys.stderr, flush=True)
                     continue
-                if msg[0] == "req":
-                    _, req_id, svc_meth, args = msg
-                    self.sched.post(self._dispatch, conn, req_id, svc_meth, args)
-                elif msg[0] == "rep":
-                    _, req_id, value = msg
-                    with self._lock:
-                        entry = self._pending.pop(req_id, None)
-                    if entry is not None:
-                        self.sched.post(entry[1].resolve, value)
             elif typ == EV_CLOSED:
                 self._on_closed(conn)
 
@@ -163,13 +188,16 @@ class RpcNode:
             result = handler(args)
         except Exception:
             result = None
-        reply_fut = self.sched.spawn(result) if _is_gen(result) else None
-        if reply_fut is None:
-            self._reply(conn, req_id, result)
-        else:
+        if _is_gen(result):
+            # Guard the coroutine body too: a handler that raises mid-wait
+            # must still produce a reply (None = "RPC failed"), or the
+            # caller retries the same failing request forever.
+            reply_fut = self.sched.spawn(_guarded(result))
             reply_fut.add_done_callback(
                 lambda f: self._reply(conn, req_id, f.value)
             )
+        else:
+            self._reply(conn, req_id, result)
 
     def _reply(self, conn: int, req_id: int, value: Any) -> None:
         try:
@@ -187,6 +215,16 @@ def _is_gen(obj: Any) -> bool:
     import types
 
     return isinstance(obj, types.GeneratorType)
+
+
+def _guarded(gen):
+    """Run a handler coroutine, converting an escaped exception into a
+    ``None`` result (labrpc's "RPC failed") instead of a lost reply."""
+    try:
+        result = yield from gen
+    except Exception:
+        result = None
+    return result
 
 
 def _snake(name: str) -> str:

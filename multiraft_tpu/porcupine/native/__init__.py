@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 from typing import List, Optional
+
+from ...utils.native_build import build_and_load
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "checker.cpp")
@@ -29,17 +30,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if _build_failed:
         return None
     try:
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            # Compile to a process-unique temp and publish atomically so
-            # concurrent processes never dlopen a half-written .so.
-            tmp = f"{_SO}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-                check=True,
-                capture_output=True,
-            )
-            os.replace(tmp, _SO)
-        lib = ctypes.CDLL(_SO)
+        lib = build_and_load(_SRC, _SO)
         lib.check_kv_partition.restype = ctypes.c_int
         lib.check_kv_partition.argtypes = [
             ctypes.c_int32,

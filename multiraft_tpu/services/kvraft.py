@@ -61,6 +61,11 @@ SERVER_WAIT = 0.099
 # (reference: kvraft/client.go:57 — 100 ms).
 CLERK_RETRY = 0.1
 
+# Pause after a full failed sweep of all servers before retrying
+# (reference analog: shardctrler/client.go:52-62's 100 ms inter-sweep
+# sleep); kept short so post-election client latency stays low.
+SWEEP_BACKOFF = 0.02
+
 
 @codec.registered
 @dataclasses.dataclass
@@ -265,6 +270,7 @@ class Clerk:
             client_id=self.client_id,
             command_id=self.command_id,
         )
+        failures = 0
         while True:
             fut = self.ends[self.leader].call("KVServer.command", args)
             reply = yield self.sched.with_timeout(fut, CLERK_RETRY)
@@ -274,6 +280,14 @@ class Clerk:
                 or reply.err in (ERR_WRONG_LEADER, ERR_TIMEOUT)
             ):
                 self.leader = (self.leader + 1) % len(self.ends)
+                failures += 1
+                if failures % len(self.ends) == 0:
+                    # A full sweep failed (leaderless / partitioned): pause
+                    # before sweeping again so fast-failing RPCs (real TCP
+                    # connection-refused) don't busy-spin the loop — the
+                    # reference paces the same way between sweeps
+                    # (reference: shardctrler/client.go:52-62).
+                    yield self.sched.sleep(SWEEP_BACKOFF)
                 continue
             return reply.value if reply.err != ERR_NO_KEY else ""
 

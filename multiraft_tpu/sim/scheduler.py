@@ -161,7 +161,12 @@ class Scheduler:
 
     def spawn(self, gen: Generator) -> Future:
         """Drive a generator coroutine; the returned future resolves with
-        the generator's return value."""
+        the generator's return value.
+
+        Resolving the returned future externally *cancels* the coroutine:
+        the next step notices and closes the generator instead of driving
+        it further.  ``BlockingClerk`` uses this to abandon retry loops
+        whose caller timed out."""
         result = Future()
         if not isinstance(gen, types.GeneratorType):
             # Allow plain functions that return a value immediately.
@@ -169,6 +174,9 @@ class Scheduler:
             return result
 
         def step(send_value: Any) -> None:
+            if result.done:  # cancelled from outside
+                gen.close()
+                return
             try:
                 waited = gen.send(send_value)
             except StopIteration as stop:

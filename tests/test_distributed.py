@@ -55,12 +55,38 @@ class TestDiskPersister:
     def test_corrupt_file_falls_back_to_empty(self, tmp_path):
         p = DiskPersister(str(tmp_path / "d"), fsync=False)
         p.save_state_and_snapshot(b"state", b"snap")
-        with open(p.path, "r+b") as f:
-            f.seek(20)
+        with open(p._state_path, "r+b") as f:
+            f.seek(18)
             f.write(b"\xff\xff\xff")
         q = DiskPersister(str(tmp_path / "d"), fsync=False)
         assert q.read_raft_state() == b""
-        assert q.read_snapshot() == b""
+        # Files are independent: the snapshot survives state corruption.
+        assert q.read_snapshot() == b"snap"
+
+    def test_corrupt_length_header_detected(self, tmp_path):
+        # The CRC covers the length field: shrinking the recorded length
+        # (so the framing still "fits") must not pass validation.
+        p = DiskPersister(str(tmp_path / "d"), fsync=False)
+        p.save_raft_state(b"0123456789")
+        import struct
+
+        with open(p._state_path, "r+b") as f:
+            raw = bytearray(f.read())
+            struct.pack_into("<Q", raw, 8, 3)  # lie about the length
+            f.seek(0)
+            f.write(raw)
+        q = DiskPersister(str(tmp_path / "d"), fsync=False)
+        assert q.read_raft_state() == b""
+
+    def test_state_save_does_not_rewrite_snapshot_file(self, tmp_path):
+        # Hot-path write amplification guard: persisting raft state must
+        # not touch the (potentially huge) snapshot file.
+        p = DiskPersister(str(tmp_path / "d"), fsync=False)
+        p.save_state_and_snapshot(b"s1", b"snap")
+        before = os.stat(p._snap_path).st_mtime_ns
+        for i in range(10):
+            p.save_raft_state(f"s{i}".encode())
+        assert os.stat(p._snap_path).st_mtime_ns == before
 
     def test_empty_dir(self, tmp_path):
         p = DiskPersister(str(tmp_path / "nope"), fsync=False)
@@ -131,6 +157,35 @@ class TestRealtimeScheduler:
             t.cancel()
             sched.wait(sched.sleep(0.1), 2.0)
             assert got == []
+        finally:
+            sched.stop()
+
+    def test_spawn_cancellation_halts_coroutine(self):
+        # BlockingClerk abandons timed-out retry loops by resolving the
+        # spawn future; the realtime loop must then stop stepping the
+        # coroutine (same contract as the sim Scheduler).
+        sched = RealtimeScheduler()
+        try:
+            ticks = []
+            closed = []
+
+            def looper():
+                try:
+                    while True:
+                        yield sched.sleep(0.02)
+                        ticks.append(1)
+                finally:
+                    closed.append(True)
+
+            fut = sched.spawn(looper())
+            sched.wait(sched.sleep(0.1), 2.0)
+            assert ticks
+            sched.post(fut.resolve, TIMEOUT)
+            sched.wait(sched.sleep(0.05), 2.0)
+            n = len(ticks)
+            sched.wait(sched.sleep(0.1), 2.0)
+            assert len(ticks) == n  # no further progress
+            assert closed == [True]
         finally:
             sched.stop()
 
@@ -261,6 +316,33 @@ class TestRpc:
             end = client.client_end("127.0.0.1", server.port)
             fut = end.call("Slow.wait_then", 21)
             assert client.sched.wait(fut, 5.0) == 42
+        finally:
+            client.close()
+            server.close()
+            client.sched.stop()
+            server.sched.stop()
+
+    def test_generator_handler_exception_still_replies(self):
+        # A handler coroutine that raises mid-body must produce a None
+        # reply ("RPC failed"), not leave the caller waiting forever.
+        from multiraft_tpu.distributed.tcp import RpcNode
+
+        server = RpcNode(listen=True)
+        client = RpcNode()
+
+        class Boom:
+            def __init__(self, sched):
+                self.sched = sched
+
+            def explode(self, args):
+                yield self.sched.sleep(0.01)
+                raise RuntimeError("handler bug")
+
+        try:
+            server.add_service("Boom", Boom(server.sched))
+            end = client.client_end("127.0.0.1", server.port)
+            fut = end.call("Boom.explode", None)
+            assert client.sched.wait(fut, 5.0) is None
         finally:
             client.close()
             server.close()

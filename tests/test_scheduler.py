@@ -108,3 +108,28 @@ def test_nested_coroutines():
         return v + 1
 
     assert s.run_until(s.spawn(outer())) == 8
+
+
+def test_spawn_cancellation_halts_coroutine():
+    # Resolving the spawn future externally cancels the coroutine: its
+    # next step closes the generator instead of driving it (used by
+    # BlockingClerk to abandon timed-out retry loops).
+    s = Scheduler()
+    ticks = []
+    closed = []
+
+    def looper():
+        try:
+            while True:
+                yield 0.1
+                ticks.append(s.now)
+        finally:
+            closed.append(True)
+
+    fut = s.spawn(looper())
+    s.run_until(deadline=0.35)
+    assert len(ticks) == 3
+    fut.resolve(TIMEOUT)
+    s.run_until(deadline=1.0)
+    assert len(ticks) == 3  # no further progress after cancellation
+    assert closed == [True]
