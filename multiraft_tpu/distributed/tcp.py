@@ -142,10 +142,16 @@ class RpcNode:
                 try:
                     msg = codec.decode(payload)
                     if dbg:
-                        head = f"{msg[0]} conn={conn} " + (
-                            f"{msg[2]} {msg[3]!r}" if msg[0] == "req" else f"{msg[2]!r}"
-                        )
-                        print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
+                        # Tracing must never affect delivery: a repr or
+                        # stderr failure here is swallowed, not treated
+                        # as a bad frame.
+                        try:
+                            head = f"{msg[0]} conn={conn} " + (
+                                f"{msg[2]} {msg[3]!r}" if msg[0] == "req" else f"{msg[2]!r}"
+                            )
+                            print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
+                        except Exception:
+                            pass
                     if msg[0] == "req":
                         _, req_id, svc_meth, args = msg
                         self.sched.post(self._dispatch, conn, req_id, svc_meth, args)

@@ -192,14 +192,74 @@ def empty_mailbox(cfg: EngineConfig) -> Mailbox:
 
 # ---------------------------------------------------------------------------
 # Ring-log helpers (the device mirror of raft/raft_log.go's index algebra)
+#
+# TPU-critical: computed-index gather/scatter on the minor axis are
+# catastrophically slow on TPU (measured ~8-17 ms per op at the bench
+# shapes vs ~0.05 ms for a fused pass).  Every ring access is therefore
+# expressed as compare+select+reduce over the static L axis — XLA fuses
+# the on-the-fly one-hot into a single vectorized pass, so the (…,K,L)
+# intermediate never reaches HBM.
 # ---------------------------------------------------------------------------
+
+
+def _ring_read(log: jnp.ndarray, idx: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Gather ``log[..., idx mod L]`` without a gather op.
+
+    ``log``: [..., L]; ``idx``: [..., K] absolute indices (broadcastable
+    prefix). Returns [..., K].  Slots outside the ring window read
+    whatever the ring holds — callers mask validity, as with the gather
+    formulation.
+    """
+    slot = jnp.mod(idx, L)  # [..., K]
+    lanes = jnp.arange(L, dtype=slot.dtype)
+    onehot = slot[..., None] == lanes  # [..., K, L] (fused, never stored)
+    return jnp.sum(jnp.where(onehot, log[..., None, :], 0), axis=-1)
+
+
+def _ring_write(
+    log: jnp.ndarray,
+    start: jnp.ndarray,
+    vals: jnp.ndarray,
+    n: jnp.ndarray,
+    L: int,
+) -> jnp.ndarray:
+    """Write ``vals[..., e] → slot (start+e) mod L`` for ``e < n``,
+    scatter-free.
+
+    ``log``: [..., L]; ``start``: [...] first absolute index written;
+    ``vals``: [..., E]; ``n``: [...] entries to write (≤ E ≤ L, so each
+    written slot is hit by at most one message entry).
+    """
+    E = vals.shape[-1]
+    lanes = jnp.arange(L, dtype=start.dtype)
+    # Which message entry lands on lane l (unique since E <= L).
+    e_l = jnp.mod(lanes - start[..., None], L)  # [..., L]
+    hit = e_l < n[..., None]  # [..., L]
+    ei = jnp.arange(E, dtype=start.dtype)
+    v = jnp.sum(
+        jnp.where(e_l[..., None] == ei, vals[..., None, :], 0), axis=-1
+    )  # [..., L] (fused)
+    return jnp.where(hit, v, log)
+
+
+def _kth_smallest(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """k-th smallest (0-based) along the last axis via an unrolled
+    compare-swap network — ``jnp.sort`` costs ~1.6 ms at bench shapes
+    where this is a handful of fused min/max passes.  The last axis
+    length is static and small (P peers)."""
+    cols = [x[..., i] for i in range(x.shape[-1])]
+    n = len(cols)
+    for i in range(n):
+        for j in range(n - 1 - i):
+            a, b = cols[j], cols[j + 1]
+            cols[j], cols[j + 1] = jnp.minimum(a, b), jnp.maximum(a, b)
+    return cols[k]
 
 
 def _term_at(cfg: EngineConfig, state: EngineState, idx: jnp.ndarray) -> jnp.ndarray:
     """Term of absolute index ``idx`` per replica; idx shape [G,P].
     idx == base → base_term; out-of-window reads return 0 (callers mask)."""
-    slot = jnp.mod(idx, cfg.L)
-    gathered = jnp.take_along_axis(state.log_term, slot[..., None], axis=-1)[..., 0]
+    gathered = _ring_read(state.log_term, idx[..., None], cfg.L)[..., 0]
     return jnp.where(idx == state.base, state.base_term, gathered)
 
 
@@ -224,8 +284,17 @@ def tick_impl(
     now = state.tick_no + 1
     commit_before = state.commit
 
-    gi = jnp.arange(G)[:, None]  # [G,1] group index grid
     pi = jnp.arange(P)[None, :]  # [1,P] replica index grid
+
+    # One jitter draw per tick, shared by every timer reset in this
+    # tick: per-draw PRNG costs ~150 us at bench shapes, and within a
+    # single tick the resets are interchangeable — cross-tick
+    # desynchronization (what liveness needs) comes from folding the
+    # key per tick.
+    jitter = jax.random.randint(
+        jax.random.fold_in(key, 7), (G, P),
+        cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
+    )
 
     # ---- 1. vote requests (reference: raft/raft_election.go:54-77) ----
     # Sequential over src so simultaneous candidacies serialize per dst.
@@ -250,10 +319,6 @@ def tick_impl(
             & (m_term == state.term)
             & ((state.voted_for == -1) | (state.voted_for == s))
             & up_to_date
-        )
-        jitter = jax.random.randint(
-            jax.random.fold_in(key, 101 + s), (G, P),
-            cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
         )
         state = state._replace(
             voted_for=jnp.where(grant, s, state.voted_for),
@@ -328,10 +393,6 @@ def tick_impl(
             voted_for=jnp.where(higher, -1, state.voted_for),
             role=jnp.where(ok, FOLLOWER, state.role),
         )
-        jitter = jax.random.randint(
-            jax.random.fold_in(key, 201 + s), (G, P),
-            cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
-        )
         state = state._replace(
             elect_dl=jnp.where(ok, now + jitter, state.elect_dl)
         )
@@ -361,21 +422,22 @@ def tick_impl(
 
         # Write entries prev+1..prev+n, truncating only at a genuine
         # conflict (reference: raft/raft_append_entry.go:146-155).
-        # Vectorized over the E axis: slots within one message are
-        # distinct mod L (E < L), so a single masked scatter is exact.
-        log = state.log_term
+        # Scatter-free ring write (see _ring_write): slots within one
+        # message are distinct mod L (E < L), so the lane mapping is
+        # exact.
         ei = jnp.arange(E)  # [E]
         idx = prev[..., None] + 1 + ei  # [G,P,E]
         in_msg = match[..., None] & (ei < n_ent[..., None])
-        slot = jnp.mod(idx, L)
-        old = jnp.take_along_axis(log, slot, axis=-1)  # [G,P,E]
+        old = _ring_read(state.log_term, idx, L)  # [G,P,E]
         incoming = inbox.ar_terms[:, s, :, :]  # [G,P,E]
         exists = idx <= last[..., None]
         conflict_any = jnp.any(
             in_msg & exists & (old != incoming), axis=-1
         )  # [G,P]
-        newval = jnp.where(in_msg, incoming, old)
-        log = log.at[gi[..., None], pi[..., None], slot].set(newval)
+        log = _ring_write(
+            state.log_term, prev + 1, incoming,
+            jnp.where(match, n_ent, 0), L,
+        )
         state = state._replace(log_term=log)
         msg_last = prev + n_ent
         new_last = jnp.where(
@@ -461,8 +523,9 @@ def tick_impl(
             interpret=cfg.pallas_interpret,
         )
     else:
-        sorted_match = jnp.sort(eff_match, axis=-1)  # ascending
-        quorum_idx = sorted_match[:, :, P - cfg.quorum]  # the median
+        # k-th smallest via fused compare-swap network (jnp.sort on the
+        # P axis costs ~1.6 ms at bench shapes).
+        quorum_idx = _kth_smallest(eff_match, P - cfg.quorum)  # the median
         # Current-term guard (reference: raft/raft_append_entry.go:98).
         guard = _term_at(cfg, state, quorum_idx) == state.term
         new_commit = jnp.where(
@@ -474,10 +537,6 @@ def tick_impl(
 
     # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----
     timeout = state.alive & (now >= state.elect_dl) & (state.role != LEADER)
-    jitter = jax.random.randint(
-        jax.random.fold_in(key, 7), (G, P),
-        cfg.ELECT_MIN, cfg.ELECT_MAX, dtype=jnp.int32,
-    )
     state = state._replace(
         term=jnp.where(timeout, state.term + 1, state.term),
         role=jnp.where(timeout, CANDIDATE, state.role),
@@ -511,17 +570,14 @@ def tick_impl(
     capacity = jnp.maximum(L - 2 - cfg.E - state.log_len, 0)
     want = jnp.minimum(new_cmds[:, None], cfg.INGEST)  # [G,P]
     accept = jnp.where(is_leader, jnp.minimum(want, capacity), 0)
-    log = state.log_term
     last_idx = _last_index(state)
-    # Vectorized over the INGEST axis (slots distinct mod L, one scatter).
-    ii = jnp.arange(cfg.INGEST)  # [I]
-    idx = last_idx[..., None] + 1 + ii  # [G,P,I]
-    write = ii < accept[..., None]
-    slot = jnp.mod(idx, L)
-    old = jnp.take_along_axis(log, slot, axis=-1)
-    log = log.at[gi[..., None], pi[..., None], slot].set(
-        jnp.where(write, state.term[..., None], old)
-    )
+    # Scatter-free lane write: every ingested entry carries the leader's
+    # current term, so the per-lane value is just ``term`` — no inner
+    # entry gather needed at all.
+    lanes = jnp.arange(L, dtype=jnp.int32)
+    e_l = jnp.mod(lanes - (last_idx[..., None] + 1), L)  # [G,P,L]
+    hit = e_l < accept[..., None]
+    log = jnp.where(hit, state.term[..., None], state.log_term)
     state = state._replace(log_term=log, log_len=state.log_len + accept)
     # Group accepted count (for host payload binding): the max-term
     # gate above guarantees at most one accepting replica per group,
@@ -541,22 +597,20 @@ def tick_impl(
     prev = state.next_idx - 1  # [G,P,P] per (leader, dst)
     need_snap = prev < state.base[:, :, None]
     prev = jnp.where(need_snap, state.base[:, :, None], prev)
-    # prev term per (g, p, dst): gather from sender's ring.
-    slot = jnp.mod(prev, L)
-    prev_term = jnp.take_along_axis(state.log_term, slot, axis=-1)
+    # prev term per (g, p, dst): scatter-free read from sender's ring.
+    prev_term = _ring_read(state.log_term, prev, L)  # [G,P,P]
     prev_term = jnp.where(
         prev == state.base[:, :, None], state.base_term[:, :, None], prev_term
     )
     n_send = jnp.where(
         need_snap, 0, jnp.clip(last_idx[:, :, None] - prev, 0, E)
     )
-    # Gather the outgoing suffix terms in one shot: [G,P,P,E] slots
-    # flattened onto the sender's L axis.
+    # Read the outgoing suffix terms in one fused pass: [G,P,P,E] lanes
+    # against the sender's L axis.
     send_idx = prev[..., None] + 1 + jnp.arange(E)  # [G,P,P,E]
-    send_slot = jnp.mod(send_idx, L).reshape(G, P, P * E)
-    t = jnp.take_along_axis(state.log_term, send_slot, axis=-1).reshape(
-        G, P, P, E
-    )
+    t = _ring_read(
+        state.log_term, send_idx.reshape(G, P, P * E), L
+    ).reshape(G, P, P, E)
     ar_terms = jnp.where(jnp.arange(E) < n_send[..., None], t, 0)
     out = out._replace(
         ar_active=send,
