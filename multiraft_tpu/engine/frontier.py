@@ -1,0 +1,99 @@
+"""Shared scaffolding for services on the batched engine.
+
+Both batched services (:class:`~multiraft_tpu.engine.kv.BatchedKV`,
+:class:`~multiraft_tpu.engine.shardkv.BatchedShardKV`) follow the same
+loop: advance the device tick, pop committed ``(group, index)`` payload
+bindings in order and apply them, and periodically fail tickets whose
+binding was truncated by a leader change (the batched analog of kvraft
+waiters resolving ErrWrongLeader on term change,
+reference: kvraft/server.go:98-128).  This base class owns that loop so
+the sweep condition and eviction contract live in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .host import EngineDriver
+
+__all__ = ["FrontierService"]
+
+
+class FrontierService:
+    """Applies the committed frontier of an :class:`EngineDriver` to a
+    host-side state machine.  Subclasses implement ``_apply`` (one
+    committed payload) and ``_on_evicted`` (a payload that lost its log
+    slot and can never commit as bound), and may hook ``_post_pump``
+    (runs after each frontier sweep — orchestration goes here)."""
+
+    ORPHAN_SWEEP_TICKS = 64
+
+    def __init__(self, driver: EngineDriver) -> None:
+        self.driver = driver
+        self.applied_upto = [0] * driver.cfg.G
+        driver.on_payload_evicted = self._on_evicted
+        self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
+        raise NotImplementedError
+
+    def _on_evicted(self, payload: Any) -> None:
+        raise NotImplementedError
+
+    def _post_pump(self) -> None:
+        pass
+
+    # -- the loop ----------------------------------------------------------
+
+    def pump(self, n_ticks: int = 1) -> None:
+        """Advance the engine and apply the committed frontier
+        (DeferredConsensus.pump)."""
+        self.driver.step(n_ticks)
+        commit = np.asarray(self.driver.last_metrics["commit_index"])
+        now = self.driver.tick
+        for g in range(self.driver.cfg.G):
+            upto = int(commit[g])
+            while self.applied_upto[g] < upto:
+                idx = self.applied_upto[g] + 1
+                # pop: an applied payload is never needed again (host
+                # memory stays bounded under a sustained firehose).
+                payload = self.driver.payloads.pop((g, idx), None)
+                self._apply(g, idx, payload, now)
+                self.applied_upto[g] = idx
+        self._post_pump()
+        # Periodically fail bindings orphaned by log truncation (a
+        # leader change can strand tail bindings that no future accept
+        # will overwrite if the group goes quiet).
+        self._sweep_countdown -= n_ticks
+        if self._sweep_countdown <= 0:
+            self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
+            self.sweep_orphans()
+
+    def sweep_orphans(self) -> int:
+        """Fail tickets whose bound (group, index) log entry no longer
+        exists in the current leader's log — it was truncated by a
+        leader change and can never commit as bound.  Returns the number
+        of tickets failed."""
+        if not self.driver.payloads:
+            return 0
+        st = self.driver.np_state()
+        failed = 0
+        last_cache: Dict[int, Optional[int]] = {}
+        for (g, idx) in list(self.driver.payloads.keys()):
+            if g not in last_cache:
+                p = self.driver.leader_of(g)
+                last_cache[g] = (
+                    None
+                    if p is None
+                    else int(st["base"][g, p] + st["log_len"][g, p])
+                )
+            last = last_cache[g]
+            if last is not None and idx > last:
+                payload = self.driver.payloads.pop((g, idx))
+                self._on_evicted(payload)
+                failed += 1
+        return failed

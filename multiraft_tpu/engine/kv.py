@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT, KvInput, KvOutput
 from ..porcupine.model import Operation
+from .frontier import FrontierService
 from .host import EngineDriver
 
 __all__ = ["KVOp", "Ticket", "BatchedKV"]
@@ -44,7 +45,7 @@ class Ticket:
     done_tick: int = 0
 
 
-class BatchedKV:
+class BatchedKV(FrontierService):
     """Many independent KV groups on one :class:`EngineDriver`."""
 
     def __init__(
@@ -52,12 +53,9 @@ class BatchedKV:
         driver: EngineDriver,
         record_groups: Optional[List[int]] = None,
     ) -> None:
-        self.driver = driver
+        super().__init__(driver)
         G = driver.cfg.G
         self.data: List[Dict[str, str]] = [dict() for _ in range(G)]
-        self.applied_upto = [0] * G
-        driver.on_payload_evicted = self._on_evicted
-        self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
         self._record = set(record_groups or [])
         self.histories: Dict[int, List[Operation]] = {
             g: [] for g in self._record
@@ -86,61 +84,7 @@ class BatchedKV:
             ticket.done = True
             ticket.failed = True
 
-    # -- pumping ---------------------------------------------------------
-
-    def pump(self, n_ticks: int = 1) -> None:
-        """Advance the engine and apply the committed frontier
-        (DeferredConsensus.pump)."""
-        import numpy as np
-
-        self.driver.step(n_ticks)
-        commit = np.asarray(self.driver.last_metrics["commit_index"])
-        now = self._now()
-        for g in range(self.driver.cfg.G):
-            upto = int(commit[g])
-            while self.applied_upto[g] < upto:
-                idx = self.applied_upto[g] + 1
-                # pop: an applied payload is never needed again (host
-                # memory stays bounded under a sustained firehose).
-                payload = self.driver.payloads.pop((g, idx), None)
-                self._apply(g, idx, payload, now)
-                self.applied_upto[g] = idx
-        # Periodically fail bindings orphaned by log truncation (a
-        # leader change can strand tail bindings that no future accept
-        # will overwrite if the group goes quiet).
-        self._sweep_countdown -= n_ticks
-        if self._sweep_countdown <= 0:
-            self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
-            self.sweep_orphans()
-
-    ORPHAN_SWEEP_TICKS = 64
-
-    def sweep_orphans(self) -> int:
-        """Fail tickets whose bound (group, index) log entry no longer
-        exists in the current leader's log — it was truncated by a
-        leader change and can never commit as bound.  Returns the number
-        of tickets failed.  (The batched analog of kvraft waiters being
-        resolved ErrWrongLeader on term change,
-        reference: kvraft/server.go:98-128.)"""
-        if not self.driver.payloads:
-            return 0
-        st = self.driver.np_state()
-        failed = 0
-        last_cache: Dict[int, Optional[int]] = {}
-        for (g, idx) in list(self.driver.payloads.keys()):
-            if g not in last_cache:
-                p = self.driver.leader_of(g)
-                last_cache[g] = (
-                    None
-                    if p is None
-                    else int(st["base"][g, p] + st["log_len"][g, p])
-                )
-            last = last_cache[g]
-            if last is not None and idx > last:
-                payload = self.driver.payloads.pop((g, idx))
-                self._on_evicted(payload)
-                failed += 1
-        return failed
+    # -- pumping/sweeping inherited from FrontierService -----------------
 
     def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
         if payload is None:

@@ -1,0 +1,588 @@
+"""Sharded multi-group KV on the batched engine — the routing analog.
+
+The sim backend runs the sharded stack as one process per server with
+leader tickers (services/shardkv.py).  This module is the TPU-native
+form (SURVEY §2.1: "shard→group table is a small device array — the
+EP/expert-routing analog"): one :class:`~multiraft_tpu.engine.host.
+EngineDriver` consensus-orders *every* replica group's log on device —
+engine group 0 is the config RSM (the shardctrler), engine groups
+``1..G-1`` are replica groups with ``gid == engine group index`` — and
+a per-pump host sweep replaces the reference's three leader tickers
+(config poll / shard pull / GC, reference: shardkv server tickers;
+see services/shardkv.py:310-397 for the sim equivalents).
+
+Semantics match the sim backend (and therefore the reference's shardkv
+test spec, SURVEY §4.4):
+
+* per-shard serving states SERVING / PULLING / BEPULLING / GCING;
+* configs apply strictly in order, only when no migration is in flight;
+* Challenge 1 — migrated shards are *deleted* at the old owner once the
+  new owner has them (DeleteShard → ConfirmGC handshake through both
+  groups' logs);
+* Challenge 2 — unaffected shards serve during migration, and freshly
+  inserted shards serve (GCING) before the old copy is deleted;
+* per-shard client dedup tables migrate with the shard data.
+
+Deliberate divergences (documented):
+
+* The "pull shard" and "query config" RPCs become direct host reads of
+  the source group's *applied* state — all groups share the host
+  process, so the network hop of the sim backend is an identity; the
+  read is gated on the source having applied the same config number,
+  which is exactly the ErrNotReady handshake of the sim's pull RPC.
+  Cross-host group placement rides the distributed transport instead
+  (multiraft_tpu/distributed/), not this module.
+* Proposals are deduplicated by outstanding-ticket bookkeeping rather
+  than timer cadence; duplicate applies are idempotent regardless.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT, KvInput, KvOutput
+from ..porcupine.model import Operation
+from ..services.shardctrler import NSHARDS, Config, rebalance
+from ..services.shardkv import (
+    BEPULLING,
+    GCING,
+    PULLING,
+    SERVING,
+    key2shard,
+)
+from .frontier import FrontierService
+from .host import EngineDriver
+
+__all__ = [
+    "ShardTicket",
+    "BatchedShardKV",
+    "BatchedShardClerk",
+    "route_keys",
+]
+
+OK = "OK"
+ERR_NO_KEY = "ErrNoKey"
+ERR_WRONG_GROUP = "ErrWrongGroup"
+ERR_NOT_READY = "ErrNotReady"
+
+GET, PUT, APPEND = "Get", "Put", "Append"
+
+_PORCUPINE_OPCODE = {GET: OP_GET, PUT: OP_PUT, APPEND: OP_APPEND}
+
+
+@dataclasses.dataclass
+class ShardTicket:
+    """Resolution of one proposed command.  ``failed`` means the command
+    lost its log slot to a leader change and never committed — the
+    caller resubmits (dedup tables make write retries exactly-once)."""
+
+    group: int
+    done: bool = False
+    failed: bool = False
+    err: str = OK
+    value: str = ""
+    done_tick: int = 0
+    command_id: int = 0  # set on ctrler tickets so retries can dedup
+
+
+# Host payload records bound to (group, index) by the driver.  Every op
+# carries a ticket slot so evictions (lost log slots) can fail it.
+
+
+@dataclasses.dataclass
+class _ClientOp:
+    op: str
+    key: str
+    value: str
+    client_id: int
+    command_id: int
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _CtrlOp:
+    kind: str  # "join" | "leave" | "move"
+    arg: Any
+    client_id: int
+    command_id: int
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _ConfigOp:
+    config: Config
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _InsertOp:
+    config_num: int
+    shard: int
+    data: Dict[str, str]
+    latest: Dict[int, int]
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _DeleteOp:
+    config_num: int
+    shard: int
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _ConfirmOp:
+    config_num: int
+    shard: int
+    ticket: Optional[ShardTicket] = None
+
+
+@dataclasses.dataclass
+class _ShardSlot:
+    state: int = SERVING
+    data: Dict[str, str] = dataclasses.field(default_factory=dict)
+    latest: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+
+class _Replica:
+    """Host-side applied state of one replica group (gid = engine
+    group index)."""
+
+    def __init__(self, gid: int) -> None:
+        self.gid = gid
+        self.cur = Config(num=0, shards=[0] * NSHARDS, groups={})
+        self.prev = self.cur
+        self.shards: Dict[int, _ShardSlot] = {
+            s: _ShardSlot() for s in range(NSHARDS)
+        }
+        # Outstanding internal proposals (ticket per kind/shard).
+        self.pending_config: Optional[ShardTicket] = None
+        self.pending_insert: Dict[int, ShardTicket] = {}
+        self.pending_delete: Dict[int, ShardTicket] = {}
+        self.pending_confirm: Dict[int, ShardTicket] = {}
+
+    def can_serve(self, shard: int) -> bool:
+        """Challenge 2 gate (mirror of services/shardkv.py:225-232)."""
+        return self.cur.shards[shard] == self.gid and self.shards[
+            shard
+        ].state in (SERVING, GCING)
+
+
+@functools.partial(jax.jit)
+def route_keys(table: jnp.ndarray, key_hashes: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized client-op routing: key hash → shard → engine group.
+
+    ``table`` is the i32[NSHARDS] shard→gid array maintained by
+    :meth:`BatchedShardKV.shard_table`; this is the device half of the
+    reference's ``key2shard`` + config lookup
+    (reference: shardkv/client.go:22-29, 68-129) for batched firehoses.
+    """
+    return table[key_hashes % NSHARDS]
+
+
+class BatchedShardKV(FrontierService):
+    """The full sharded stack on one batched engine.
+
+    Engine group 0 = config RSM; groups ``1..G-1`` = replica groups.
+    """
+
+    def __init__(self, driver: EngineDriver) -> None:
+        if driver.cfg.G < 2:
+            raise ValueError("BatchedShardKV needs G >= 2 (ctrler + >=1 group)")
+        super().__init__(driver)
+        G = driver.cfg.G
+        self.gids = list(range(1, G))
+        # Config RSM applied state (group 0).
+        self.configs: List[Config] = [
+            Config(num=0, shards=[0] * NSHARDS, groups={})
+        ]
+        self._ctrl_latest: Dict[int, int] = {}
+        self.reps: Dict[int, _Replica] = {g: _Replica(g) for g in self.gids}
+        self._route = jnp.zeros((NSHARDS,), jnp.int32)
+        self._ctrl_cmd = 0
+        self._orchestrate_enabled = True
+
+    # -- client/admin surface ---------------------------------------------
+
+    def submit(self, gid: int, op: str, key: str, value: str = "",
+               client_id: int = 0, command_id: int = 0) -> ShardTicket:
+        t = ShardTicket(group=gid)
+        self.driver.start(
+            gid,
+            _ClientOp(op=op, key=key, value=value, client_id=client_id,
+                      command_id=command_id, ticket=t),
+        )
+        return t
+
+    def _ctrl(self, kind: str, arg: Any,
+              command_id: Optional[int] = None) -> ShardTicket:
+        """Propose a ctrler op.  Pass the ``command_id`` of a failed
+        ticket to retry it — the ctrler dedup table then guarantees
+        exactly-once application even if the original did commit."""
+        if command_id is None:
+            self._ctrl_cmd += 1
+            command_id = self._ctrl_cmd
+        t = ShardTicket(group=0, command_id=command_id)
+        self.driver.start(
+            0, _CtrlOp(kind=kind, arg=arg, client_id=0,
+                       command_id=command_id, ticket=t)
+        )
+        return t
+
+    def join(self, gids: List[int],
+             command_id: Optional[int] = None) -> ShardTicket:
+        """Add replica groups (reference: shardctrler Join).  Group
+        "server names" are synthesized from the engine group index."""
+        servers = {g: [f"engine-group-{g}"] for g in gids}
+        return self._ctrl("join", servers, command_id)
+
+    def leave(self, gids: List[int],
+              command_id: Optional[int] = None) -> ShardTicket:
+        return self._ctrl("leave", list(gids), command_id)
+
+    def move(self, shard: int, gid: int,
+             command_id: Optional[int] = None) -> ShardTicket:
+        return self._ctrl("move", (shard, gid), command_id)
+
+    def query_latest(self) -> Config:
+        """Latest *committed* config (direct read of the applied config
+        RSM — the in-process form of the clerk's Query)."""
+        return self.configs[-1].clone()
+
+    def shard_table(self) -> jnp.ndarray:
+        """Device shard→gid routing table for :func:`route_keys`."""
+        return self._route
+
+    # -- admin convenience (pump until the ctrler op commits) -------------
+
+    def admin_sync(self, kind: str, arg: Any, max_ticks: int = 3000) -> None:
+        mk = {
+            "join": lambda cid: self.join(arg, command_id=cid),
+            "leave": lambda cid: self.leave(arg, command_id=cid),
+            "move": lambda cid: self.move(*arg, command_id=cid),
+        }[kind]
+        t = mk(None)
+        waited = 0
+        while waited < max_ticks:
+            self.pump(5)
+            waited += 5
+            if t.done and not t.failed:
+                return
+            if t.failed:
+                t = mk(t.command_id)  # retry under the same dedup id
+        raise TimeoutError(f"ctrler {kind} did not commit in {max_ticks} ticks")
+
+    # -- pumping (frontier/sweep machinery in FrontierService) -------------
+
+    def pump(self, n_ticks: int = 1, orchestrate: bool = True) -> None:
+        self._orchestrate_enabled = orchestrate
+        super().pump(n_ticks)
+
+    def _post_pump(self) -> None:
+        if self._orchestrate_enabled:
+            self._orchestrate()
+
+    def _on_evicted(self, payload: Any) -> None:
+        t = getattr(payload, "ticket", None)
+        if t is not None and not t.done:
+            t.done = True
+            t.failed = True
+
+    # -- apply path --------------------------------------------------------
+
+    def _resolve(self, op: Any, now: int, err: str = OK, value: str = "") -> None:
+        t = op.ticket
+        if t is not None and not t.done:
+            t.done = True
+            t.err = err
+            t.value = value
+            t.done_tick = now
+
+    def _apply(self, g: int, idx: int, op: Any, now: int) -> None:
+        if op is None:
+            return  # binding lost to a leader change before commit
+        if g == 0:
+            self._apply_ctrl(op, now)
+        else:
+            self._apply_replica(self.reps[g], op, now)
+
+    def _apply_ctrl(self, op: Any, now: int) -> None:
+        if not isinstance(op, _CtrlOp):
+            return
+        if self._ctrl_latest.get(op.client_id, -1) >= op.command_id:
+            self._resolve(op, now)  # duplicate join/leave/move: no-op
+            return
+        self._ctrl_latest[op.client_id] = op.command_id
+        cfg = self.configs[-1].clone()
+        cfg.num += 1
+        if op.kind == "join":
+            cfg.groups.update({g: list(s) for g, s in op.arg.items()})
+            cfg.shards = rebalance(cfg.shards, cfg.groups)
+        elif op.kind == "leave":
+            for gid in op.arg:
+                cfg.groups.pop(gid, None)
+            cfg.shards = rebalance(cfg.shards, cfg.groups)
+        else:  # move
+            shard, gid = op.arg
+            cfg.shards[shard] = gid
+        self.configs.append(cfg)
+        self._route = jnp.asarray(np.array(cfg.shards, np.int32))
+        self._resolve(op, now)
+
+    def _apply_replica(self, rep: _Replica, op: Any, now: int) -> None:
+        if isinstance(op, _ClientOp):
+            self._apply_client(rep, op, now)
+        elif isinstance(op, _ConfigOp):
+            # Strictly in-order, never mid-migration
+            # (mirror of services/shardkv.py:459-477).
+            if op.config.num == rep.cur.num + 1 and all(
+                sh.state == SERVING for sh in rep.shards.values()
+            ):
+                rep.prev = rep.cur
+                rep.cur = op.config
+                for s in range(NSHARDS):
+                    was = rep.prev.shards[s] == rep.gid
+                    mine = op.config.shards[s] == rep.gid
+                    if mine and not was:
+                        rep.shards[s].state = (
+                            SERVING if rep.prev.shards[s] == 0 else PULLING
+                        )
+                    elif was and not mine:
+                        rep.shards[s].state = BEPULLING
+            rep.pending_config = None
+            self._resolve(op, now)
+        elif isinstance(op, _InsertOp):
+            sh = rep.shards[op.shard]
+            if op.config_num == rep.cur.num and sh.state == PULLING:
+                sh.data = dict(op.data)
+                sh.latest = dict(op.latest)
+                sh.state = GCING  # serve before the old copy is deleted
+            rep.pending_insert.pop(op.shard, None)
+            self._resolve(op, now)
+        elif isinstance(op, _DeleteOp):
+            # Runs in the OLD owner's log.  ErrNotReady if this group
+            # hasn't seen the config yet (it would still be serving).
+            if op.config_num > rep.cur.num:
+                self._resolve(op, now, err=ERR_NOT_READY)
+                return
+            if op.config_num == rep.cur.num:
+                sh = rep.shards[op.shard]
+                if sh.state == BEPULLING:
+                    rep.shards[op.shard] = _ShardSlot()  # Challenge 1
+            self._resolve(op, now)  # < cur.num: already gone, idempotent
+        elif isinstance(op, _ConfirmOp):
+            sh = rep.shards[op.shard]
+            if op.config_num == rep.cur.num and sh.state == GCING:
+                sh.state = SERVING
+            rep.pending_confirm.pop(op.shard, None)
+            self._resolve(op, now)
+
+    def _apply_client(self, rep: _Replica, op: _ClientOp, now: int) -> None:
+        shard = key2shard(op.key)
+        sh = rep.shards[shard]
+        # Ownership re-checked at apply time: the config may have moved
+        # between proposal and commit (reference: shardkv apply path).
+        if not rep.can_serve(shard):
+            self._resolve(op, now, err=ERR_WRONG_GROUP)
+            return
+        if op.op != GET and sh.latest.get(op.client_id, -1) >= op.command_id:
+            self._resolve(op, now)  # duplicate write: already applied
+            return
+        if op.op == GET:
+            if op.key in sh.data:
+                self._resolve(op, now, value=sh.data[op.key])
+            else:
+                self._resolve(op, now, err=ERR_NO_KEY)
+            return
+        if op.op == PUT:
+            sh.data[op.key] = op.value
+        else:
+            sh.data[op.key] = sh.data.get(op.key, "") + op.value
+        sh.latest[op.client_id] = op.command_id
+        self._resolve(op, now)
+
+    # -- migration orchestration (the batched form of the tickers) ---------
+
+    @staticmethod
+    def _live(t: Optional[ShardTicket]) -> bool:
+        return t is not None and not t.done
+
+    def _orchestrate(self) -> None:
+        latest = self.configs[-1]
+        for gid in self.gids:
+            rep = self.reps[gid]
+            # (a) config advance — only participating (or about to
+            # participate) groups need to track configs.
+            if (
+                latest.num > rep.cur.num
+                and not self._live(rep.pending_config)
+                and all(sh.state == SERVING for sh in rep.shards.values())
+            ):
+                nxt = self.configs[rep.cur.num + 1].clone()
+                t = ShardTicket(group=gid)
+                rep.pending_config = t
+                self.driver.start(gid, _ConfigOp(config=nxt, ticket=t))
+            # (b) shard pull: read the source group's applied state once
+            # it has applied the same config (the ErrNotReady gate).
+            for s in range(NSHARDS):
+                sh = rep.shards[s]
+                if sh.state == PULLING and not self._live(
+                    rep.pending_insert.get(s)
+                ):
+                    src = self.reps.get(rep.prev.shards[s])
+                    if src is None or src.cur.num < rep.cur.num:
+                        continue  # source hasn't caught up; retry later
+                    t = ShardTicket(group=gid)
+                    rep.pending_insert[s] = t
+                    self.driver.start(
+                        gid,
+                        _InsertOp(
+                            config_num=rep.cur.num,
+                            shard=s,
+                            data=dict(src.shards[s].data),
+                            latest=dict(src.shards[s].latest),
+                            ticket=t,
+                        ),
+                    )
+                # (c) GC handshake: delete at the old owner, then
+                # confirm locally (Challenge 1).
+                elif sh.state == GCING:
+                    dt = rep.pending_delete.get(s)
+                    if dt is None or (dt.done and (dt.failed or dt.err != OK)):
+                        src_gid = rep.prev.shards[s]
+                        if src_gid not in self.reps:
+                            rep.pending_delete[s] = ShardTicket(
+                                group=0, done=True, err=OK
+                            )
+                        else:
+                            t = ShardTicket(group=src_gid)
+                            rep.pending_delete[s] = t
+                            self.driver.start(
+                                src_gid,
+                                _DeleteOp(config_num=rep.cur.num, shard=s,
+                                          ticket=t),
+                            )
+                    elif (
+                        dt.done
+                        and dt.err == OK
+                        and not self._live(rep.pending_confirm.get(s))
+                    ):
+                        t = ShardTicket(group=gid)
+                        rep.pending_confirm[s] = t
+                        self.driver.start(
+                            gid,
+                            _ConfirmOp(config_num=rep.cur.num, shard=s,
+                                       ticket=t),
+                        )
+                elif sh.state == SERVING:
+                    rep.pending_delete.pop(s, None)
+
+
+class BatchedShardClerk:
+    """Client of :class:`BatchedShardKV` with the reference clerk's
+    retry loop (re-query config on ErrWrongGroup, resubmit on lost
+    leadership; reference: shardkv/client.go:68-129) and optional
+    porcupine recording on sampled shards."""
+
+    def __init__(
+        self,
+        skv: BatchedShardKV,
+        client_id: int,
+        record_shards: Optional[List[int]] = None,
+    ) -> None:
+        self.skv = skv
+        self.client_id = client_id
+        self.command_id = 0
+        self._record = set(record_shards or [])
+        self.histories: Dict[int, List[Operation]] = {
+            s: [] for s in self._record
+        }
+
+    # -- async sessions (for concurrent-client tests) ----------------------
+
+    class Session:
+        def __init__(self, clerk: "BatchedShardClerk", op: str, key: str,
+                     value: str, command_id: int) -> None:
+            self.clerk = clerk
+            self.op, self.key, self.value = op, key, value
+            self.command_id = command_id
+            self.call_tick = clerk.skv.driver.tick
+            self.ticket: Optional[ShardTicket] = None
+            self.done = False
+            self.result = ""
+            self._submit()
+
+        def _submit(self) -> None:
+            cfg = self.clerk.skv.query_latest()
+            gid = cfg.shards[key2shard(self.key)]
+            if gid not in self.clerk.skv.reps:
+                self.ticket = None  # shard unassigned; retry after pump
+                return
+            self.ticket = self.clerk.skv.submit(
+                gid, self.op, self.key, self.value,
+                client_id=self.clerk.client_id, command_id=self.command_id,
+            )
+
+        def poll(self) -> bool:
+            """Advance after a pump; True when the op has a final reply."""
+            if self.done:
+                return True
+            t = self.ticket
+            if t is None:
+                self._submit()
+                return False
+            if not t.done:
+                return False
+            if t.failed or t.err == ERR_WRONG_GROUP:
+                self._submit()  # same command_id: dedup makes it safe
+                return False
+            self.done = True
+            self.result = t.value if t.err == OK else ""
+            self.clerk._record_op(self)
+            return True
+
+    def begin(self, op: str, key: str, value: str = "") -> "Session":
+        self.command_id += 1
+        return self.Session(self, op, key, value, self.command_id)
+
+    def _record_op(self, s: "Session") -> None:
+        shard = key2shard(s.key)
+        if shard in self._record:
+            self.histories[shard].append(
+                Operation(
+                    client_id=self.client_id,
+                    input=KvInput(op=_PORCUPINE_OPCODE[s.op], key=s.key,
+                                  value=s.value),
+                    call=float(s.call_tick),
+                    output=KvOutput(value=s.result),
+                    ret=float(self.skv.driver.tick) + 0.5,
+                )
+            )
+
+    # -- blocking convenience ----------------------------------------------
+
+    def _run(self, op: str, key: str, value: str = "",
+             max_ticks: int = 4000) -> str:
+        s = self.begin(op, key, value)
+        waited = 0
+        while waited < max_ticks:
+            self.skv.pump(5)
+            waited += 5
+            if s.poll():
+                return s.result
+        raise TimeoutError(f"{op}({key!r}) unresolved after {max_ticks} ticks")
+
+    def get(self, key: str) -> str:
+        return self._run(GET, key)
+
+    def put(self, key: str, value: str) -> None:
+        self._run(PUT, key, value)
+
+    def append(self, key: str, value: str) -> None:
+        self._run(APPEND, key, value)
