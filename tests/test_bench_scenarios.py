@@ -1,0 +1,48 @@
+"""Smoke tests for the scenario bench rig (tiny shapes, CPU)."""
+
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def tiny_shapes(monkeypatch):
+    monkeypatch.setenv("MULTIRAFT_BENCH_G", "16")
+    monkeypatch.setenv("MULTIRAFT_BENCH_CHUNK", "60")
+    monkeypatch.setenv("MULTIRAFT_BENCH_CHUNKS", "2")
+    monkeypatch.setenv("MULTIRAFT_BENCH_SWEEP_MAX", "1000")
+
+
+def _run(name, capsys):
+    from benchmarks import scenarios
+
+    # sweep ignores MULTIRAFT_BENCH_G; cap it to one small point
+    if name == "sweep":
+        scenarios_points = [1000]
+    rec = scenarios.SCENARIOS[name]()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(out)
+    assert parsed["metric"] == rec["metric"]
+    return rec
+
+
+def test_churn_scenario_commits_under_churn(capsys):
+    rec = _run("churn", capsys)
+    assert rec["value"] > 0
+
+
+def test_skew_scenario_hot_groups_dominate(capsys):
+    rec = _run("skew", capsys)
+    assert rec["value"] > 0
+    hot_per_group = rec["hot_commits_per_sec"] / rec["hot_groups"]
+    cold_per_group = rec["cold_commits_per_sec"] / (
+        rec["groups"] - rec["hot_groups"]
+    )
+    assert hot_per_group > cold_per_group
+
+
+def test_snapstorm_scenario_laggards_catch_up(capsys):
+    rec = _run("snapstorm", capsys)
+    assert rec["caught_up_frac"] == 1.0
+    assert rec["value"] > 0
