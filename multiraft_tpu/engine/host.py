@@ -35,7 +35,18 @@ from .core import (
     tick,
 )
 
-__all__ = ["EngineDriver", "apply_faults"]
+__all__ = ["EngineDriver", "apply_faults", "mask_active"]
+
+# The message channels' liveness fields; every fault transform (drop,
+# partition, crash edge-kill) is a mask over exactly these.  Derived
+# from the Mailbox schema so a new channel can't bypass fault injection.
+_ACTIVE_FIELDS = tuple(f for f in Mailbox._fields if f.endswith("_active"))
+
+
+def mask_active(mb: Mailbox, fn) -> Mailbox:
+    """Apply ``fn(field_name, bool_array) -> bool_array`` over every
+    ``*_active`` channel of the mailbox."""
+    return mb._replace(**{k: fn(k, getattr(mb, k)) for k in _ACTIVE_FIELDS})
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -47,18 +58,13 @@ def apply_faults(
     (reference: labrpc/labrpc.go:228-239,279-284; request and reply
     drops both land here because each direction is its own edge-slot)."""
     shape = (cfg.G, cfg.P, cfg.P)
-    k1, k2, k3 = jax.random.split(key, 3)
-    keep_vr = jax.random.uniform(k1, shape) >= drop_prob
-    keep_vp = jax.random.uniform(k2, shape) >= drop_prob
-    keep_ap = jax.random.uniform(k3, shape) >= drop_prob
-    k4 = jax.random.fold_in(k1, 9)
-    keep_ar = jax.random.uniform(k4, shape) >= drop_prob
-    return mailbox._replace(
-        vr_active=mailbox.vr_active & keep_vr,
-        vp_active=mailbox.vp_active & keep_vp,
-        ar_active=mailbox.ar_active & keep_ar,
-        ap_active=mailbox.ap_active & keep_ap,
-    )
+    keys = jax.random.split(key, len(_ACTIVE_FIELDS))
+
+    def drop(name, a):
+        k = keys[_ACTIVE_FIELDS.index(name)]
+        return a & (jax.random.uniform(k, shape) >= drop_prob)
+
+    return mask_active(mailbox, drop)
 
 
 class EngineDriver:
@@ -68,6 +74,18 @@ class EngineDriver:
         self.state: EngineState = init_state(cfg, jax.random.fold_in(self.key, 0))
         self.inbox: Mailbox = empty_mailbox(cfg)
         self.drop_prob = 0.0
+        # Per-edge enables [G, src, dst] — the dense form of labrpc's
+        # per-ClientEnd enable/disable (reference: labrpc/labrpc.go:
+        # 316-364; SURVEY §5.8 "partition by per-edge boolean enables").
+        # Unlike ``alive`` (a crash mask that freezes the replica), a
+        # partitioned replica stays live: timers run, candidacies fire,
+        # but no message crosses a disabled edge.  ``replica_conn`` is
+        # the per-replica connectivity that partition_replica derives
+        # edges from (labrpc connect() semantics: an edge is up iff
+        # *both* endpoints are connected).
+        self.edge_up = np.ones((cfg.G, cfg.P, cfg.P), bool)
+        self.replica_conn = np.ones((cfg.G, cfg.P), bool)
+        self._edge_dev: Optional[jnp.ndarray] = None  # lazy device copy
         self.total_commits = 0
         self.backlog = np.zeros(cfg.G, np.int64)  # pending Start()s
         # Host-side payloads: (group, index) -> command.  The device
@@ -90,6 +108,38 @@ class EngineDriver:
             alive=self.state.alive.at[g, p].set(alive)
         )
 
+    def set_edge(self, g: int, src: int, dst: int, up: bool) -> None:
+        """Enable/disable the directed message edge src→dst in group g
+        (asymmetric partitions, labrpc's raw per-ClientEnd enable).
+        Note: a later ``partition_replica`` call on either endpoint
+        recomputes group g's edges from per-replica connectivity,
+        overriding raw edge settings."""
+        self.edge_up[g, src, dst] = up
+        self._edges_changed()
+
+    def partition_replica(self, g: int, p: int, connected: bool) -> None:
+        """Cut (or heal) live replica (g, p): labrpc connect()
+        semantics — an edge is up iff both endpoints are connected, so
+        healing one replica never resurrects edges of another that is
+        still partitioned (reference: labrpc/labrpc.go:316-364)."""
+        self.replica_conn[g, p] = connected
+        conn = self.replica_conn[g]
+        self.edge_up[g] = conn[:, None] & conn[None, :]
+        self._edges_changed()
+
+    def _edges_changed(self) -> None:
+        """In-flight messages on now-disabled edges die immediately —
+        the partition takes effect this tick, not next."""
+        self._edge_dev = None
+        if not self.edge_up.all():
+            self.inbox = self._mask_partitions(self.inbox)
+
+    def _mask_partitions(self, mb: Mailbox) -> Mailbox:
+        if self._edge_dev is None:
+            self._edge_dev = jnp.asarray(self.edge_up)
+        m = self._edge_dev
+        return mask_active(mb, lambda _, a: a & m)
+
     def restart_replica(self, g: int, p: int) -> None:
         """Crash-restart: persistent columns (term/vote/log/base/commit
         floor) survive; volatile leadership state resets
@@ -108,14 +158,8 @@ class EngineDriver:
         self.inbox = self._mask_edges(self.inbox, g, p)
 
     def _mask_edges(self, mb: Mailbox, g: int, p: int) -> Mailbox:
-        def mask(a):
-            return a.at[g, p, :].set(False).at[g, :, p].set(False)
-
-        return mb._replace(
-            vr_active=mask(mb.vr_active),
-            vp_active=mask(mb.vp_active),
-            ar_active=mask(mb.ar_active),
-            ap_active=mask(mb.ap_active),
+        return mask_active(
+            mb, lambda _, a: a.at[g, p, :].set(False).at[g, :, p].set(False)
         )
 
     # -- Start() ----------------------------------------------------------
@@ -150,6 +194,8 @@ class EngineDriver:
                     jnp.float32(self.drop_prob),
                     cfg,
                 )
+            if not self.edge_up.all():
+                outbox = self._mask_partitions(outbox)
             self.state, self.inbox = state, outbox
             if have_backlog:
                 # Host sync only while commands are in flight.
@@ -214,9 +260,15 @@ class EngineDriver:
         terms = st["term"][g][lead]
         return int(lead[np.argmax(terms)])
 
-    def log_terms_of(self, g: int, p: int) -> Dict[int, int]:
-        """Absolute index -> term for replica (g, p)'s ring window."""
-        st = self.np_state()
+    def log_terms_of(
+        self, g: int, p: int, st: Optional[Dict[str, np.ndarray]] = None
+    ) -> Dict[int, int]:
+        """Absolute index -> term for replica (g, p)'s ring window.
+
+        Pass a pre-read ``st`` (from :meth:`np_state`) when reading many
+        replicas — each call otherwise syncs the full state to host."""
+        if st is None:
+            st = self.np_state()
         base, ln = int(st["base"][g, p]), int(st["log_len"][g, p])
         ring = st["log_term"][g, p]
         return {
@@ -229,7 +281,7 @@ class EngineDriver:
         st = self.np_state()
         commits = st["commit"][g]
         floor = int(min(commits))
-        views = [self.log_terms_of(g, p) for p in range(self.cfg.P)]
+        views = [self.log_terms_of(g, p, st) for p in range(self.cfg.P)]
         bases = st["base"][g]
         for i in range(int(max(bases)) + 1, floor + 1):
             terms = {v[i] for v in views if i in v}
