@@ -44,7 +44,9 @@ def main() -> None:
 
     G = int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
     P = int(os.environ.get("MULTIRAFT_BENCH_P", "3"))
-    use_pallas = os.environ.get("MULTIRAFT_BENCH_PALLAS", "0") == "1"
+    # Pallas quorum-commit/vote-tally kernels measure ~4% faster than
+    # the pure-XLA lowering at the 10k-group bench shape; default on.
+    use_pallas = os.environ.get("MULTIRAFT_BENCH_PALLAS", "1") == "1"
     cfg = EngineConfig(
         G=G, P=P, L=64, E=16, INGEST=16, HB_TICKS=9, use_pallas=use_pallas
     )

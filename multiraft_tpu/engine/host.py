@@ -42,6 +42,17 @@ __all__ = ["EngineDriver", "apply_faults", "mask_active"]
 # from the Mailbox schema so a new channel can't bypass fault injection.
 _ACTIVE_FIELDS = tuple(f for f in Mailbox._fields if f.endswith("_active"))
 
+# Channel prefix -> all fields of that channel (e.g. "ar_" -> ar_active,
+# ar_term, ..., ar_snap).  The reorder fault mode lifts whole messages —
+# every field of a channel slot — out of the stream and redelivers them
+# ticks later, so it needs the grouping, not just the active bits.
+_CHANNELS = {
+    f[: -len("active")]: tuple(
+        g for g in Mailbox._fields if g.startswith(f[: -len("active")])
+    )
+    for f in _ACTIVE_FIELDS
+}
+
 
 def mask_active(mb: Mailbox, fn) -> Mailbox:
     """Apply ``fn(field_name, bool_array) -> bool_array`` over every
@@ -86,6 +97,16 @@ class EngineDriver:
         self.edge_up = np.ones((cfg.G, cfg.P, cfg.P), bool)
         self.replica_conn = np.ones((cfg.G, cfg.P), bool)
         self._edge_dev: Optional[jnp.ndarray] = None  # lazy device copy
+        # Long-reordering mode (reference: labrpc/labrpc.go:289-299 —
+        # 2/3 of replies delayed 200–2400 ms): each in-flight message is
+        # independently pulled from the stream with ``reorder_prob`` and
+        # redelivered reorder_min..reorder_max ticks later, landing
+        # *behind* messages sent after it.  Held messages die if their
+        # edge partitions or either endpoint restarts while in flight.
+        self.reorder_prob = 0.0
+        self.reorder_min, self.reorder_max = 2, 8
+        self._np_rng = np.random.default_rng(seed ^ 0x5EED)
+        self._delayed: list = []  # (release, prefix, (g,src,dst), fields)
         self.total_commits = 0
         self.backlog = np.zeros(cfg.G, np.int64)  # pending Start()s
         # Host-side payloads: (group, index) -> command.  The device
@@ -129,16 +150,75 @@ class EngineDriver:
 
     def _edges_changed(self) -> None:
         """In-flight messages on now-disabled edges die immediately —
-        the partition takes effect this tick, not next."""
+        the partition takes effect this tick, not next.  That includes
+        messages held in the reorder delay queue: a cut-then-heal
+        between two ticks must not resurrect them."""
         self._edge_dev = None
         if not self.edge_up.all():
             self.inbox = self._mask_partitions(self.inbox)
+        if self._delayed:
+            self._delayed = [
+                it for it in self._delayed if self.edge_up[it[2]]
+            ]
 
     def _mask_partitions(self, mb: Mailbox) -> Mailbox:
         if self._edge_dev is None:
             self._edge_dev = jnp.asarray(self.edge_up)
         m = self._edge_dev
         return mask_active(mb, lambda _, a: a & m)
+
+    def set_reorder(
+        self, prob: float, min_ticks: int = 2, max_ticks: int = 8
+    ) -> None:
+        """Enable labrpc-style long reordering on the tensor transport:
+        each message is delayed ``min_ticks..max_ticks`` ticks with
+        probability ``prob`` (labrpc uses 2/3), arriving after traffic
+        sent later — the non-FIFO delivery the conflict-backoff and
+        staleness guards must survive (reference:
+        raft/raft_append_entry.go:146-155)."""
+        if not 0.0 <= prob <= 1.0 or min_ticks < 1 or max_ticks < min_ticks:
+            raise ValueError("set_reorder: bad parameters")
+        self.reorder_prob = float(prob)
+        self.reorder_min, self.reorder_max = int(min_ticks), int(max_ticks)
+
+    def _apply_reorder(self, mb: Mailbox) -> Mailbox:
+        """Host-side delay queue over the dense mailbox.  A held message
+        is redelivered once its release tick passes *and* its slot is
+        free that tick (otherwise it waits — delaying further only
+        increases reordering).  Test-path only: syncs the mailbox to
+        host, so keep it off for throughput runs."""
+        if self.reorder_prob == 0.0 and not any(
+            release <= self.tick for release, *_ in self._delayed
+        ):
+            return mb  # nothing to pick, nothing due: skip the sync
+        host = {f: np.array(getattr(mb, f)) for f in Mailbox._fields}
+        rng = self._np_rng
+        if self.reorder_prob > 0.0:
+            for prefix, fields in _CHANNELS.items():
+                act = host[prefix + "active"]
+                pick = act & (rng.random(act.shape) < self.reorder_prob)
+                for g, s, dst in np.argwhere(pick):
+                    release = self.tick + int(
+                        rng.integers(self.reorder_min, self.reorder_max + 1)
+                    )
+                    payload = {f: host[f][g, s, dst].copy() for f in fields}
+                    self._delayed.append(
+                        (release, prefix, (int(g), int(s), int(dst)), payload)
+                    )
+                act[pick] = False
+        if self._delayed:
+            held = []
+            for item in self._delayed:
+                release, prefix, (g, s, dst), payload = item
+                if not self.edge_up[g, s, dst]:
+                    continue  # partitioned while in flight: message dies
+                if release <= self.tick and not host[prefix + "active"][g, s, dst]:
+                    for f, v in payload.items():
+                        host[f][g, s, dst] = v
+                else:
+                    held.append(item)
+            self._delayed = held
+        return Mailbox(**{f: jnp.asarray(v) for f, v in host.items()})
 
     def restart_replica(self, g: int, p: int) -> None:
         """Crash-restart: persistent columns (term/vote/log/base/commit
@@ -154,8 +234,14 @@ class EngineDriver:
             applied=st.applied.at[g, p].set(st.base[g, p]),
             alive=st.alive.at[g, p].set(True),
         )
-        # In-flight messages to/from the old incarnation die.
+        # In-flight messages to/from the old incarnation die — including
+        # any held in the reorder delay queue.
         self.inbox = self._mask_edges(self.inbox, g, p)
+        self._delayed = [
+            it
+            for it in self._delayed
+            if not (it[2][0] == g and p in (it[2][1], it[2][2]))
+        ]
 
     def _mask_edges(self, mb: Mailbox, g: int, p: int) -> Mailbox:
         return mask_active(
@@ -196,6 +282,8 @@ class EngineDriver:
                 )
             if not self.edge_up.all():
                 outbox = self._mask_partitions(outbox)
+            if self.reorder_prob > 0.0 or self._delayed:
+                outbox = self._apply_reorder(outbox)
             self.state, self.inbox = state, outbox
             if have_backlog:
                 # Host sync only while commands are in flight.

@@ -24,11 +24,14 @@ def run_fuzz(
     p_crash: float = 0.02,
     p_restart: float = 0.25,
     drop_choices=(0.0, 0.0, 0.1, 0.3),
+    reorder: float = 0.0,
 ) -> int:
     """Drive a random fault script; return total commits observed."""
     rng = np.random.default_rng(seed)
     cfg = EngineConfig(G=G, P=P, L=32, E=4, INGEST=4)
     d = EngineDriver(cfg, seed=seed)
+    if reorder:
+        d.set_reorder(reorder, 2, 10)
     mon = InvariantMonitor(d)
     dead = set()
     cut = set()  # live-partitioned replicas
@@ -76,6 +79,42 @@ def test_fuzz_five_peers_heavier_faults():
     """P=5 tolerates two concurrent failures; crank the fault rates."""
     commits = run_fuzz(seed=101, P=5, ticks=300, p_crash=0.05)
     assert commits > 0
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_fuzz_long_reordering(seed):
+    """labrpc's long-reordering mode (2/3 of messages delayed,
+    reference: labrpc/labrpc.go:289-299) on the tensor transport, on
+    top of crashes/partitions/loss: stale out-of-order appends and vote
+    replies must bounce off the staleness guards (reference comment:
+    raft/raft_append_entry.go:146-148) without ever violating a safety
+    invariant — and the cluster must still commit."""
+    commits = run_fuzz(seed=seed, ticks=400, reorder=2.0 / 3.0)
+    assert commits > 0
+
+
+def test_reordering_heals_to_full_progress():
+    """After sustained reordering, switching it off lets every group
+    elect and drain a backlog — no message permanently wedged in the
+    delay queue."""
+    cfg = EngineConfig(G=4, P=3, L=32, E=4, INGEST=4)
+    d = EngineDriver(cfg, seed=7)
+    mon = InvariantMonitor(d)
+    d.set_reorder(2.0 / 3.0, 3, 12)
+    for t in range(250):
+        if t % 3 == 0:
+            d.start(t % cfg.G, f"cmd-{t}")
+        d.step()
+        mon.observe()
+    d.set_reorder(0.0)
+    before = d.commits_total
+    for g in range(cfg.G):
+        d.start(g, f"post-heal-{g}")
+    for _ in range(150):
+        d.step()
+        mon.observe()
+    assert not d._delayed, "delay queue must drain once reordering stops"
+    assert d.commits_total >= before + cfg.G, "post-heal backlog must commit"
 
 
 def test_figure8_leader_crash_loop():
