@@ -45,8 +45,13 @@ def main() -> None:
     G = int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
     P = int(os.environ.get("MULTIRAFT_BENCH_P", "3"))
     # Pallas quorum-commit/vote-tally kernels measure ~4% faster than
-    # the pure-XLA lowering at the 10k-group bench shape; default on.
-    use_pallas = os.environ.get("MULTIRAFT_BENCH_PALLAS", "1") == "1"
+    # the pure-XLA lowering at the 10k-group bench shape; default on
+    # where they have a real lowering (CPU-only hosts would need the
+    # interpreter, which is far slower than the XLA path).
+    default_pallas = "1" if platform == "tpu" else "0"
+    use_pallas = (
+        os.environ.get("MULTIRAFT_BENCH_PALLAS", default_pallas) == "1"
+    )
     cfg = EngineConfig(
         G=G, P=P, L=64, E=16, INGEST=16, HB_TICKS=9, use_pallas=use_pallas
     )

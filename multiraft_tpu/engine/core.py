@@ -493,10 +493,20 @@ def tick_impl(
             next_idx=state.next_idx.at[:, :, s].set(
                 jnp.where(
                     succ,
-                    new_match + 1,
+                    # max(): appends are pipelined (next_idx advances
+                    # optimistically at send, phase 5c), so an ack for
+                    # batch k must not rewind past batches k+1... already
+                    # in flight.
+                    jnp.maximum(state.next_idx[:, :, s], new_match + 1),
                     jnp.where(
                         fail,
-                        jnp.clip(inbox.ap_conflict[:, s, :], 1, None),
+                        # Floor at match_idx+1: a reordered stale
+                        # failure must not rewind below what this
+                        # follower has already acked.
+                        jnp.maximum(
+                            jnp.clip(inbox.ap_conflict[:, s, :], 1, None),
+                            state.match_idx[:, :, s] + 1,
+                        ),
                         state.next_idx[:, :, s],
                     ),
                 )
@@ -623,7 +633,15 @@ def tick_impl(
         ar_snap=need_snap & send,
     )
     state = state._replace(
-        hb_due=jnp.where(hb_fire, now + cfg.HB_TICKS, state.hb_due)
+        hb_due=jnp.where(hb_fire, now + cfg.HB_TICKS, state.hb_due),
+        # Pipelined replication: advance next_idx at send time instead
+        # of waiting the 2-tick ack RTT, so a fresh E-batch streams
+        # every tick (2x steady-state throughput).  A dropped batch
+        # self-heals: the follower's failure reply repositions next_idx
+        # via the conflict backoff above.  (The reference replicator is
+        # one-at-a-time per peer, raft/raft_append_entry.go:20-65 — a
+        # deliberate divergence.)
+        next_idx=jnp.where(send, state.next_idx + n_send, state.next_idx),
     )
 
     # ---- 6. apply frontier + ring compaction ----
