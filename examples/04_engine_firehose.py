@@ -1,0 +1,64 @@
+"""The batched TPU engine: 1,024 independent Raft groups advanced by
+one jit-compiled tick function over (groups, peers) state tensors,
+fed by a synthetic Start() firehose, with linearizability spot-checked
+on sampled groups.
+
+On a TPU chip the same code at G=10,000 sustains >100M commits/sec
+(see bench.py); this example runs anywhere on CPU.
+"""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import time
+import numpy as np
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.kv import BatchedKV, KVOp
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET
+
+
+def main() -> None:
+    G = 1024
+    d = EngineDriver(EngineConfig(G=G, P=3, L=64, E=8, INGEST=8), seed=1)
+    print(f"ticking {G} Raft groups x 3 peers as one jitted function...")
+    assert d.run_until_quiet_leaders(400)
+    print(f"every group elected a leader by tick {d.tick}")
+
+    # Firehose: saturate every group, count commits.
+    t0 = time.perf_counter()
+    ticks = 60
+    for _ in range(ticks):
+        d.start_bulk(np.full(G, 8, np.int64))
+        d.step()
+    dt = time.perf_counter() - t0
+    print(f"{d.commits_total:,} commits in {ticks} ticks "
+          f"({d.commits_total / dt:,.0f} commits/sec on CPU)")
+
+    # The service layer on top: KV ops on a few groups, verified.
+    kv = BatchedKV(d, record_groups=[0, 1])
+    t = {}
+    for g in (0, 1):
+        kv.submit(g, KVOp(op=OP_APPEND, key="x", value=f"g{g}"))
+        t[g] = kv.submit(g, KVOp(op=OP_GET, key="x"))
+    for _ in range(60):
+        kv.pump()
+        if all(tk.done for tk in t.values()):
+            break
+    for g, tk in t.items():
+        assert tk.done and tk.value == f"g{g}"
+    kv.check_sampled_linearizability()
+    print("sampled-group linearizability: OK")
+
+
+if __name__ == "__main__":
+    main()

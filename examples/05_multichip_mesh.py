@@ -1,0 +1,73 @@
+"""Multi-chip scale-out: the engine's groups axis sharded over a
+`jax.sharding.Mesh`.
+
+Consensus traffic never crosses a group boundary, so the sharded tick
+lowers with ZERO collectives — scaling is linear in devices by
+construction. Here the "chips" are 8 virtual CPU devices (the same
+path the driver's dryrun_multichip validates); on real hardware the
+mesh is the chip/ICI topology.
+"""
+
+import sys, os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiraft_tpu.engine.core import EngineConfig, empty_mailbox, init_state, tick
+
+
+def main() -> None:
+    devices = jax.devices()
+    mesh = Mesh(devices, axis_names=("groups",))
+    print(f"mesh: {len(devices)} devices along axis 'groups'")
+
+    cfg = EngineConfig(G=64, P=3, L=32, E=4, INGEST=4)
+    key = jax.random.PRNGKey(0)
+    state, inbox = init_state(cfg, key), empty_mailbox(cfg)
+
+    def spec(x):
+        sharded = getattr(x, "ndim", 0) >= 1 and x.shape and x.shape[0] == cfg.G
+        return NamedSharding(mesh, P("groups") if sharded else P())
+
+    state = jax.tree.map(lambda x: jax.device_put(x, spec(x)), state)
+    inbox = jax.tree.map(lambda x: jax.device_put(x, spec(x)), inbox)
+    new_cmds = jax.device_put(
+        jnp.full((cfg.G,), 2, jnp.int32), NamedSharding(mesh, P("groups"))
+    )
+
+    for i in range(120):
+        state, inbox, metrics = tick(
+            cfg, state, inbox, new_cmds, jax.random.fold_in(key, i)
+        )
+    jax.block_until_ready(state.term)
+
+    assert state.term.sharding.spec[0] == "groups", "sharding was lost!"
+    print(f"after 120 ticks: {int(metrics['leaders'])} leaders across "
+          f"{cfg.G} groups, state still sharded as {state.term.sharding.spec}")
+    # Proof of the scaling story: a consensus-only step (the global
+    # scalar *metrics* are the only cross-shard reductions; drop them
+    # and XLA DCEs their all-reduces) compiles with zero collectives.
+    def consensus_only(state, inbox, new_cmds, key):
+        st, mb, _metrics = tick(cfg, state, inbox, new_cmds, key)
+        return st, mb
+
+    hlo = jax.jit(consensus_only).lower(
+        state, inbox, new_cmds, key
+    ).compile().as_text()
+    for coll in ("all-reduce", "all-gather", "collective-permute"):
+        assert coll not in hlo, f"unexpected collective {coll} in sharded tick"
+    print("consensus-only sharded step compiles with zero collectives — "
+          "scaling is linear in devices")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Keep examples/ runnable: each script is executed as a subprocess
+the way a user would run it (fresh interpreter, no pytest fixtures)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(ROOT, "examples")) if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs(script):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
