@@ -16,6 +16,7 @@ analog of the reference's Persister carryover
 from __future__ import annotations
 
 import functools
+import time
 from collections import defaultdict
 from typing import Any, Dict, Optional
 
@@ -119,6 +120,10 @@ class EngineDriver:
         # overwritten — i.e. the old command lost its slot to a leader
         # change and will never commit at that index.
         self.on_payload_evicted: Optional[Any] = None
+        # Optional utils.trace.Tracer: each tick becomes a wall-clock
+        # span carrying its metrics.  Forces a device sync per tick, so
+        # it is a diagnostic mode, not a throughput mode.
+        self.tracer = None
 
     # -- fault injection --------------------------------------------------
 
@@ -265,6 +270,7 @@ class EngineDriver:
         cfg = self.cfg
         for _ in range(n):
             self.tick += 1
+            t_wall = time.perf_counter() if self.tracer else 0.0
             tick_key = jax.random.fold_in(self.key, self.tick)
             have_backlog = bool(self.backlog.any())
             new_cmds = jnp.asarray(
@@ -306,6 +312,22 @@ class EngineDriver:
                 getattr(self, "_commits_dev", jnp.int32(0)) + metrics["commits"]
             )
             self.last_metrics = metrics
+            if self.tracer:
+                commits = int(metrics["commits"])  # forces the sync
+                now_us = time.perf_counter() * 1e6
+                self.tracer.span(
+                    "tick",
+                    t_wall * 1e6,
+                    now_us - t_wall * 1e6,
+                    track="engine",
+                    tick=self.tick,
+                    commits=commits,
+                    leaders=int(metrics["leaders"]),
+                )
+                self.tracer.counter(
+                    "consensus", now_us,
+                    {"commits": commits, "backlog": int(self.backlog.sum())},
+                )
         return self.last_metrics
 
     @property

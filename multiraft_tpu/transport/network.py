@@ -112,6 +112,21 @@ class Network:
         self._total_count = 0
         self._total_bytes = 0
         self._done = False
+        # Optional utils.trace.Tracer: every RPC becomes a span
+        # (send→resolve) tagged with its outcome; None = zero overhead.
+        self.tracer = None
+
+    def _trace_rpc(
+        self, endname: Any, svc_meth: str, t0: float, end: float, status: str
+    ) -> None:
+        if self.tracer is not None:
+            self.tracer.span(
+                svc_meth,
+                t0 * 1e6,
+                (end - t0) * 1e6,
+                track=str(endname),
+                status=status,
+            )
 
     # -- topology ---------------------------------------------------------
 
@@ -169,6 +184,7 @@ class Network:
             return fut  # never resolves after Cleanup, like a closed network
         self._total_count += 1
         req_bytes = codec.encode(args)
+        t0 = self.sched.now
 
         enabled = self._enabled.get(endname, False)
         servername = self._connections.get(endname)
@@ -182,6 +198,7 @@ class Network:
             else:
                 delay = self.rng.uniform(0, 0.1)
             self.sched.call_after(delay, fut.resolve, None)
+            self._trace_rpc(endname, svc_meth, t0, t0 + delay, "timeout")
             return fut
 
         delay = RELIABLE_HOP_DELAY
@@ -193,10 +210,13 @@ class Network:
                 # Drop the request: caller sees a failure quickly
                 # (reference: labrpc/labrpc.go:233-239).
                 self.sched.call_after(delay, fut.resolve, None)
+                self._trace_rpc(
+                    endname, svc_meth, t0, t0 + delay, "drop_request"
+                )
                 return fut
         self.sched.call_after(
             delay, self._execute, endname, servername, server, svc_meth,
-            req_bytes, fut,
+            req_bytes, fut, t0,
         )
         return fut
 
@@ -208,12 +228,13 @@ class Network:
         svc_meth: str,
         req_bytes: bytes,
         fut: Future,
+        t0: float,
     ) -> None:
         # Fresh decode per delivery: value isolation across the wire.
         if self._servers.get(servername) is not server:
             # Server crashed while the request was in flight
             # (reference: labrpc/labrpc.go:253-265 death polling).
-            self._dead_server_reply(fut)
+            self._dead_server_reply(fut, endname, svc_meth, t0, "dead_server")
             return
         args = codec.decode(req_bytes)
         self._count[servername] += 1
@@ -221,10 +242,12 @@ class Network:
         result = server.dispatch(svc_meth, args)
         done = self.sched.spawn(result) if _is_gen(result) else None
         if done is None:
-            self._finish(endname, servername, server, result, fut)
+            self._finish(endname, servername, server, result, fut, svc_meth, t0)
         else:
             done.add_done_callback(
-                lambda f: self._finish(endname, servername, server, f.value, fut)
+                lambda f: self._finish(
+                    endname, servername, server, f.value, fut, svc_meth, t0
+                )
             )
 
     def _finish(
@@ -234,17 +257,25 @@ class Network:
         server: Server,
         reply: Any,
         fut: Future,
+        svc_meth: str,
+        t0: float,
     ) -> None:
         if self._servers.get(servername) is not server:
             # DeleteServer() while the handler ran: suppress the reply so a
             # client can't receive an answer from a crashed server
             # (reference: labrpc/labrpc.go:267-277).
-            self._dead_server_reply(fut)
+            self._dead_server_reply(
+                fut, endname, svc_meth, t0, "reply_suppressed"
+            )
             return
         reply_bytes = codec.encode(reply)
         if not self.reliable and self.rng.random() < 0.1:
             # Drop the reply (reference: labrpc/labrpc.go:279-284).
             self.sched.call_after(RELIABLE_HOP_DELAY, fut.resolve, None)
+            self._trace_rpc(
+                endname, svc_meth, t0,
+                self.sched.now + RELIABLE_HOP_DELAY, "drop_reply",
+            )
             return
         delay = RELIABLE_HOP_DELAY
         if self.long_reordering and self.rng.random() < (2.0 / 3.0):
@@ -253,10 +284,20 @@ class Network:
             delay += 0.2 + self.rng.uniform(0, 2.4)
         self._total_bytes += len(reply_bytes)
         self.sched.call_after(delay, fut.resolve, codec.decode(reply_bytes))
+        self._trace_rpc(endname, svc_meth, t0, self.sched.now + delay, "ok")
 
-    def _dead_server_reply(self, fut: Future) -> None:
+    def _dead_server_reply(
+        self,
+        fut: Future,
+        endname: Any = None,
+        svc_meth: str = "",
+        t0: float = 0.0,
+        status: str = "dead_server",
+    ) -> None:
         delay = self.rng.uniform(0, 0.1)
         self.sched.call_after(delay, fut.resolve, None)
+        if svc_meth:
+            self._trace_rpc(endname, svc_meth, t0, self.sched.now + delay, status)
 
 
 def _is_gen(obj: Any) -> bool:

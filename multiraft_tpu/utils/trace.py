@@ -1,0 +1,101 @@
+"""Structured tracing: Chrome-trace (catapult JSON) event capture.
+
+The reference's only observability is per-test counters and gated
+printf (SURVEY §5.1 — `raft/config.go:624-651`, `raft/utility.go:55-72`);
+this subsystem goes beyond it: attach a :class:`Tracer` to the simulated
+:class:`~multiraft_tpu.transport.network.Network` and every RPC becomes
+a span tagged with its outcome (ok/timeout/drop/suppressed), or to
+an :class:`~multiraft_tpu.engine.host.EngineDriver` and every device
+tick becomes a span carrying its metrics. Export with :meth:`Tracer.save`
+and open in ``chrome://tracing`` / Perfetto.
+
+Timestamps are microseconds. The sim uses virtual-time seconds
+(×1e6); the engine driver uses wall-clock ticks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+__all__ = ["Tracer"]
+
+
+class Tracer:
+    """Bounded in-memory event buffer in Chrome trace-event format.
+
+    ``max_events`` guards long runs: once full, new events are dropped
+    and :attr:`dropped` counts them (a trace that silently self-truncates
+    is worse than one that says so).
+    """
+
+    def __init__(self, max_events: int = 200_000) -> None:
+        self.events: List[Dict[str, Any]] = []
+        self.max_events = max_events
+        self.dropped = 0
+
+    # -- recording --------------------------------------------------------
+
+    def _emit(self, ev: Dict[str, Any]) -> None:
+        if len(self.events) >= self.max_events:
+            self.dropped += 1
+            return
+        self.events.append(ev)
+
+    def span(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        track: str = "main",
+        pid: int = 0,
+        **args: Any,
+    ) -> None:
+        """A complete event: ``[ts, ts+dur]`` on ``track``."""
+        self._emit(
+            {
+                "ph": "X",
+                "name": name,
+                "ts": ts_us,
+                "dur": max(dur_us, 0.0),
+                "pid": pid,
+                "tid": track,
+                "args": args,
+            }
+        )
+
+    def instant(
+        self, name: str, ts_us: float, track: str = "main", pid: int = 0, **args: Any
+    ) -> None:
+        self._emit(
+            {
+                "ph": "i",
+                "s": "t",
+                "name": name,
+                "ts": ts_us,
+                "pid": pid,
+                "tid": track,
+                "args": args,
+            }
+        )
+
+    def counter(
+        self, name: str, ts_us: float, values: Dict[str, float], pid: int = 0
+    ) -> None:
+        """A counter sample (renders as a stacked area in the viewer)."""
+        self._emit(
+            {"ph": "C", "name": name, "ts": ts_us, "pid": pid, "args": values}
+        )
+
+    # -- export -----------------------------------------------------------
+
+    def to_json(self) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {"displayTimeUnit": "ms"}
+        if self.dropped:
+            meta["otherData"] = {"dropped_events": self.dropped}
+        return {"traceEvents": self.events, **meta}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f)
+        return path

@@ -62,3 +62,92 @@ def test_metrics_registry():
     with m.timer("t"):
         pass
     assert m.percentile("t", 0.5) is not None
+
+
+# ---------------------------------------------------------------------------
+# Tracer (utils/trace.py) — beyond the reference's counters (SURVEY §5.1)
+# ---------------------------------------------------------------------------
+
+
+def _traced_net():
+    from multiraft_tpu.sim.scheduler import Scheduler
+    from multiraft_tpu.transport.network import Network, Server, Service
+    from multiraft_tpu.utils.trace import Tracer
+
+    class Echo:
+        def ping(self, args: str) -> str:
+            return "pong:" + args
+
+    sched = Scheduler()
+    net = Network(sched, seed=1)
+    net.tracer = Tracer()
+    srv = Server()
+    srv.add_service(Service(Echo(), name="Echo"))
+    net.add_server("s0", srv)
+    end = net.make_end("c0")
+    net.connect("c0", "s0")
+    net.enable("c0", True)
+    return sched, net, end
+
+
+def test_tracer_records_rpc_spans(tmp_path):
+    import json
+
+    sched, net, end = _traced_net()
+    for i in range(5):
+        fut = end.call("Echo.ping", f"{i}")
+        sched.run_until(fut)
+        assert fut.value == f"pong:{i}"
+    evs = net.tracer.events
+    ok = [e for e in evs if e["args"].get("status") == "ok"]
+    assert len(ok) == 5
+    assert all(e["name"] == "Echo.ping" and e["ph"] == "X" for e in ok)
+    assert all(e["dur"] > 0 for e in ok)
+    # Valid Chrome trace JSON on disk.
+    path = net.tracer.save(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    assert data["traceEvents"] and data["displayTimeUnit"] == "ms"
+
+
+def test_tracer_tags_faulty_outcomes():
+    sched, net, end = _traced_net()
+    # Timeout: disabled endpoint.
+    net.enable("c0", False)
+    fut = end.call("Echo.ping", "x")
+    sched.run_until(fut)
+    assert fut.value is None
+    statuses = [e["args"]["status"] for e in net.tracer.events]
+    assert "timeout" in statuses
+    # Unreliable: drive enough calls that drops show up.
+    net.enable("c0", True)
+    net.set_reliable(False)
+    for i in range(60):
+        fut = end.call("Echo.ping", "y")
+        sched.run_until(fut)
+    statuses = {e["args"]["status"] for e in net.tracer.events}
+    assert "drop_request" in statuses or "drop_reply" in statuses
+
+
+def test_tracer_bounded_buffer():
+    from multiraft_tpu.utils.trace import Tracer
+
+    tr = Tracer(max_events=3)
+    for i in range(10):
+        tr.instant("e", float(i))
+    assert len(tr.events) == 3 and tr.dropped == 7
+    assert tr.to_json()["otherData"]["dropped_events"] == 7
+
+
+def test_tracer_engine_tick_spans():
+    from multiraft_tpu.engine.core import EngineConfig
+    from multiraft_tpu.engine.host import EngineDriver
+    from multiraft_tpu.utils.trace import Tracer
+
+    d = EngineDriver(EngineConfig(G=4, P=3), seed=0)
+    d.tracer = Tracer()
+    d.step(20)
+    ticks = [e for e in d.tracer.events if e["name"] == "tick"]
+    assert len(ticks) == 20
+    assert [e["args"]["tick"] for e in ticks] == list(range(1, 21))
+    counters = [e for e in d.tracer.events if e["ph"] == "C"]
+    assert len(counters) == 20
