@@ -69,6 +69,50 @@ class BatchedKV(FrontierService):
         self.driver.start(group, (op, t))
         return t
 
+    def get(self, group: int, key: str) -> Ticket:
+        """Linearizable read served WITHOUT a log entry — the batched
+        form of the ReadIndex optimization the reference never built
+        (SURVEY §3.4: "Gets go through the log too ... no
+        lease/read-index optimization anywhere").
+
+        Classic ReadIndex records the leader's commit index and
+        confirms leadership with a quorum round before serving.  Here
+        both steps collapse: this service is the *sole acker* of every
+        write in the group (acks happen only at :meth:`pump`'s applied
+        frontier), so ``applied_upto[g]`` already covers every
+        acknowledged write — the read index is satisfied by
+        construction, and no concurrent acker exists for a stale leader
+        to race.  The read linearizes at its submit tick.  Reads
+        therefore cost zero device work; Gets submitted via
+        :meth:`submit` still take the log path (useful for the
+        cross-host runtime, where per-replica ackers make the quorum
+        round real again).
+        """
+        now = self._now()
+        out = self.data[group].get(key, "")
+        t = Ticket(
+            group=group, done=True, value=out,
+            submit_tick=now, done_tick=now,
+        )
+        self._record_op(group, KvInput(op=OP_GET, key=key), out, now, now)
+        return t
+
+    def _record_op(
+        self, g: int, inp: KvInput, out: str, call: int, ret: int
+    ) -> None:
+        """Append a porcupine operation for a recorded group.  ``ret``
+        is padded by 0.5 so intervals are non-degenerate in tick time."""
+        if g in self._record:
+            self.histories[g].append(
+                Operation(
+                    client_id=0,
+                    input=inp,
+                    call=float(call),
+                    output=KvOutput(value=out),
+                    ret=float(ret) + 0.5,
+                )
+            )
+
     def _now(self) -> int:
         # Host-side tick mirror: avoids a device readback per submit.
         return self.driver.tick
@@ -104,18 +148,14 @@ class BatchedKV(FrontierService):
             ticket.value = out
             ticket.index = idx
             ticket.done_tick = now
-            if g in self._record:
-                self.histories[g].append(
-                    Operation(
-                        client_id=0,
-                        input=KvInput(op=op.op, key=op.key, value=op.value),
-                        call=float(ticket.submit_tick),
-                        output=KvOutput(value=out),
-                        # Tickets resolve at the apply readback; pad so
-                        # intervals are non-degenerate in tick time.
-                        ret=float(now) + 0.5,
-                    )
-                )
+            # Tickets resolve at the apply readback.
+            self._record_op(
+                g,
+                KvInput(op=op.op, key=op.key, value=op.value),
+                out,
+                ticket.submit_tick,
+                now,
+            )
 
     # -- verification ----------------------------------------------------
 
