@@ -47,6 +47,17 @@ class FrontierService:
     def _post_pump(self) -> None:
         pass
 
+    # -- checkpoint hooks (pair with EngineDriver.save/restore) -----------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Service state to checkpoint alongside the engine — pass as
+        ``driver.save(path, extra=svc.state_dict())`` so both snapshot
+        the same tick boundary.  Subclasses extend."""
+        return {"applied_upto": list(self.applied_upto)}
+
+    def load_state_dict(self, blob: Dict[str, Any]) -> None:
+        self.applied_upto = list(blob["applied_upto"])
+
     # -- the loop ----------------------------------------------------------
 
     def pump(self, n_ticks: int = 1) -> None:

@@ -16,6 +16,8 @@ analog of the reference's Persister carryover
 from __future__ import annotations
 
 import functools
+import os
+import pickle
 import time
 from collections import defaultdict
 from typing import Any, Dict, Optional
@@ -81,10 +83,16 @@ def apply_faults(
 
 class EngineDriver:
     def __init__(self, cfg: EngineConfig, seed: int = 0) -> None:
-        self.cfg = cfg
-        self.key = jax.random.PRNGKey(seed)
+        self._init_host(cfg, seed)
         self.state: EngineState = init_state(cfg, jax.random.fold_in(self.key, 0))
         self.inbox: Mailbox = empty_mailbox(cfg)
+
+    def _init_host(self, cfg: EngineConfig, seed: int) -> None:
+        """Host-side bookkeeping shared by __init__ and restore() —
+        restore overwrites state/inbox from the checkpoint, so it must
+        not pay for (or double-allocate) fresh device tensors."""
+        self.cfg = cfg
+        self.key = jax.random.PRNGKey(seed)
         self.drop_prob = 0.0
         # Per-edge enables [G, src, dst] — the dense form of labrpc's
         # per-ClientEnd enable/disable (reference: labrpc/labrpc.go:
@@ -343,6 +351,93 @@ class EngineDriver:
                 if self.leaders_at_max_term_per_group().max() <= 1:
                     return True
         return False
+
+    # -- checkpoint / resume ----------------------------------------------
+    #
+    # Whole-engine suspend/resume: the batched analog of the reference's
+    # Persister (reference: raft/persister.go:57-64 atomic pair save),
+    # scaled to the world where one host owns every replica of every
+    # group.  Because the checkpoint captures the ENTIRE cluster
+    # atomically at a tick boundary (state + in-flight mailbox + host
+    # bookkeeping), restoring it is equivalent to pausing and resuming
+    # the world — consistent by construction, no per-replica recovery
+    # protocol needed.  This is the TPU-preemption recovery path;
+    # *individual* crash fidelity stays with restart_replica().
+
+    CKPT_VERSION = 1
+
+    def save(self, path: str, extra: Optional[Dict[str, Any]] = None) -> str:
+        """Atomically write a full checkpoint.  ``extra`` carries
+        service-level state (e.g. ``FrontierService.state_dict()``) so
+        engine and services checkpoint at the same tick boundary."""
+        blob = {
+            "version": self.CKPT_VERSION,
+            "cfg": self.cfg,
+            "state": {
+                k: np.asarray(v) for k, v in self.state._asdict().items()
+            },
+            "inbox": {
+                k: np.asarray(v) for k, v in self.inbox._asdict().items()
+            },
+            "tick": self.tick,
+            "key": np.asarray(self.key),
+            "backlog": self.backlog,
+            "payloads": self.payloads,
+            "pending_payloads": dict(self._pending_payloads),
+            "edge_up": self.edge_up,
+            "replica_conn": self.replica_conn,
+            "drop_prob": self.drop_prob,
+            "reorder": (self.reorder_prob, self.reorder_min, self.reorder_max),
+            # The reorder RNG's position: a resumed run must draw the
+            # same picks/delays as the uninterrupted one (determinism
+            # is the sim's debugging contract).
+            "np_rng": self._np_rng.bit_generator.state,
+            "delayed": self._delayed,
+            "commits_total": self.commits_total,
+            "extra": extra or {},
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic: a crash mid-save keeps the old one
+        return path
+
+    @classmethod
+    def restore(cls, path: str) -> "EngineDriver":
+        """Rebuild a driver from :meth:`save`.  The returned driver
+        continues from the exact saved tick; the checkpoint's ``extra``
+        dict is available as ``driver.restored_extra``."""
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob.get("version") != cls.CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint version {blob.get('version')} != {cls.CKPT_VERSION}"
+            )
+        d = object.__new__(cls)  # skip __init__: no throwaway device state
+        d._init_host(blob["cfg"], seed=0)
+        d.state = EngineState(
+            **{k: jnp.asarray(v) for k, v in blob["state"].items()}
+        )
+        d.inbox = Mailbox(
+            **{k: jnp.asarray(v) for k, v in blob["inbox"].items()}
+        )
+        d.tick = blob["tick"]
+        d.key = jnp.asarray(blob["key"])
+        d.backlog = blob["backlog"]
+        d.payloads = blob["payloads"]
+        d._pending_payloads = defaultdict(list, blob["pending_payloads"])
+        d.edge_up = blob["edge_up"]
+        d.replica_conn = blob["replica_conn"]
+        d._edge_dev = None
+        d.drop_prob = blob["drop_prob"]
+        d.reorder_prob, d.reorder_min, d.reorder_max = blob["reorder"]
+        d._np_rng.bit_generator.state = blob["np_rng"]
+        d._delayed = blob["delayed"]
+        d.total_commits = blob["commits_total"]
+        d.restored_extra = blob["extra"]
+        return d
 
     # -- inspection (host readbacks; test/debug path) ---------------------
 

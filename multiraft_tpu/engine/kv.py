@@ -157,6 +157,20 @@ class BatchedKV(FrontierService):
                 now,
             )
 
+    # -- checkpoint -------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        blob = super().state_dict()
+        blob["data"] = [dict(m) for m in self.data]
+        blob["histories"] = {g: list(h) for g, h in self.histories.items()}
+        return blob
+
+    def load_state_dict(self, blob: Dict[str, Any]) -> None:
+        super().load_state_dict(blob)
+        self.data = [dict(m) for m in blob["data"]]
+        self.histories = {g: list(h) for g, h in blob["histories"].items()}
+        self._record = set(self.histories.keys())
+
     # -- verification ----------------------------------------------------
 
     def check_sampled_linearizability(self, timeout: float = 5.0):

@@ -208,6 +208,31 @@ class BatchedShardKV(FrontierService):
         self._ctrl_cmd = 0
         self._orchestrate_enabled = True
 
+    # -- checkpoint (pairs with EngineDriver.save/restore) ----------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        import copy
+
+        blob = super().state_dict()
+        # Deep-copy: the checkpoint must not alias live host state
+        # (tickets inside reps resolve after the snapshot is taken).
+        blob["configs"] = copy.deepcopy(self.configs)
+        blob["ctrl_latest"] = dict(self._ctrl_latest)
+        blob["reps"] = copy.deepcopy(self.reps)
+        blob["route"] = np.asarray(self._route)
+        blob["ctrl_cmd"] = self._ctrl_cmd
+        blob["orchestrate"] = self._orchestrate_enabled
+        return blob
+
+    def load_state_dict(self, blob: Dict[str, Any]) -> None:
+        super().load_state_dict(blob)
+        self.configs = list(blob["configs"])
+        self._ctrl_latest = dict(blob["ctrl_latest"])
+        self.reps = blob["reps"]
+        self._route = jnp.asarray(blob["route"])
+        self._ctrl_cmd = blob["ctrl_cmd"]
+        self._orchestrate_enabled = blob["orchestrate"]
+
     # -- client/admin surface ---------------------------------------------
 
     def submit(self, gid: int, op: str, key: str, value: str = "",

@@ -1,0 +1,180 @@
+"""Whole-engine checkpoint/resume — the batched form of the
+reference's persistence pillar (reference: raft/persister.go, SURVEY
+§5.4), scaled to one host owning every replica: an atomic snapshot of
+cluster + services at a tick boundary (the TPU-preemption recovery
+path)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.invariants import InvariantMonitor
+from multiraft_tpu.engine.kv import BatchedKV, KVOp
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET
+
+
+def boot(G=4, seed=5, record=(0, 1)):
+    d = EngineDriver(EngineConfig(G=G, P=3, L=32, E=4, INGEST=4), seed=seed)
+    assert d.run_until_quiet_leaders(400)
+    return d, BatchedKV(d, record_groups=list(record))
+
+
+def test_checkpoint_roundtrip_continues_service(tmp_path):
+    d, kv = boot()
+    acked = {g: "" for g in range(4)}
+    for i in range(12):
+        g = i % 4
+        t = kv.submit(g, KVOp(op=OP_APPEND, key="k", value=f".{i}"))
+        for _ in range(40):
+            kv.pump()
+            if t.done:
+                break
+        assert t.done and not t.failed
+        acked[g] += f".{i}"
+
+    path = str(tmp_path / "ckpt.pkl")
+    d.save(path, extra=kv.state_dict())
+    del d, kv  # "preemption"
+
+    d2 = EngineDriver.restore(path)
+    kv2 = BatchedKV(d2)
+    kv2.load_state_dict(d2.restored_extra)
+    # All previously acked state is visible immediately.
+    for g in range(4):
+        assert kv2.get(g, "k").value == acked[g]
+    # And the resumed engine keeps committing.
+    mon = InvariantMonitor(d2)
+    mon.observe()  # prime from the restored state
+    for i in range(12, 24):
+        g = i % 4
+        t = kv2.submit(g, KVOp(op=OP_APPEND, key="k", value=f".{i}"))
+        for _ in range(40):
+            kv2.pump()
+            mon.observe()
+            if t.done:
+                break
+        assert t.done and not t.failed
+        acked[g] += f".{i}"
+        assert kv2.get(g, "k").value == acked[g]
+    # Histories span the preemption boundary and stay linearizable.
+    kv2.check_sampled_linearizability()
+
+
+def test_checkpoint_is_atomic(tmp_path):
+    d, _ = boot(G=2, record=())
+    path = str(tmp_path / "c.pkl")
+    d.save(path)
+    first = os.path.getsize(path)
+    d.step(5)
+    d.save(path)  # overwrite goes through .tmp + os.replace
+    assert not os.path.exists(path + ".tmp")
+    assert os.path.getsize(path) >= first // 2  # sane, non-truncated file
+    d2 = EngineDriver.restore(path)
+    assert d2.tick == d.tick
+
+
+def test_checkpoint_under_faults_resumes_and_heals(tmp_path):
+    d, kv = boot(G=4, seed=11)
+    d.drop_prob = 0.2
+    d.set_reorder(0.5, 2, 6)
+    d.partition_replica(1, 0, False)
+    for i in range(30):
+        kv.submit(i % 4, KVOp(op=OP_APPEND, key="x", value=f"{i},"))
+        kv.pump()
+    path = str(tmp_path / "f.pkl")
+    d.save(path, extra=kv.state_dict())
+
+    d2 = EngineDriver.restore(path)
+    kv2 = BatchedKV(d2)
+    kv2.load_state_dict(d2.restored_extra)
+    # Fault configuration survives the checkpoint...
+    assert d2.drop_prob == 0.2 and d2.reorder_prob == 0.5
+    assert not d2.edge_up[1].all()
+    # ...and healing it lets every group drain to progress.
+    d2.drop_prob = 0.0
+    d2.set_reorder(0.0)
+    d2.partition_replica(1, 0, True)
+    ts = [kv2.submit(g, KVOp(op=OP_APPEND, key="x", value="END")) for g in range(4)]
+    for _ in range(300):
+        kv2.pump()
+        if all(t.done for t in ts):
+            break
+    assert all(t.done and not t.failed for t in ts)
+    for g in range(4):
+        assert kv2.get(g, "x").value.endswith("END")
+    kv2.check_sampled_linearizability()
+
+
+def test_checkpoint_version_guard(tmp_path):
+    d, _ = boot(G=2, record=())
+    path = str(tmp_path / "v.pkl")
+    d.save(path)
+    import pickle
+
+    blob = pickle.load(open(path, "rb"))
+    blob["version"] = 999
+    pickle.dump(blob, open(path, "wb"))
+    with pytest.raises(ValueError, match="checkpoint version"):
+        EngineDriver.restore(path)
+
+
+def test_checkpoint_reorder_rng_deterministic(tmp_path):
+    """Save/resume must draw the same reorder picks as the
+    uninterrupted run — determinism is the sim's debugging contract."""
+    def build():
+        d = EngineDriver(EngineConfig(G=2, P=3, L=32, E=4, INGEST=4), seed=13)
+        d.set_reorder(0.5, 2, 6)
+        return d
+
+    a = build()
+    a.step(30)
+    path = str(tmp_path / "r.pkl")
+    a.save(path)
+    a.step(30)
+
+    b = EngineDriver.restore(path)
+    b.step(30)
+    sa, sb = a.np_state(), b.np_state()
+    for k in ("term", "commit", "log_term", "role"):
+        assert np.array_equal(sa[k], sb[k]), f"divergence in {k} after resume"
+
+
+def test_checkpoint_shardkv_keeps_shard_data(tmp_path):
+    """The sharded stack checkpoints its full service state (configs,
+    replica shard maps, dedup tables, routing) — not just the frontier."""
+    from multiraft_tpu.engine.shardkv import GET, PUT, BatchedShardKV
+
+    d = EngineDriver(EngineConfig(G=3, P=3, L=32, E=4, INGEST=4), seed=14)
+    assert d.run_until_quiet_leaders(400)
+    skv = BatchedShardKV(d)
+    skv.admin_sync("join", [1, 2])
+
+    def route(svc, k):
+        return int(np.asarray(svc.shard_table())[ord(k[0]) % 10])
+
+    for k in ("0", "5", "9"):
+        t = skv.submit(route(skv, k), PUT, k, "v" + k)
+        for _ in range(60):
+            skv.pump()
+            if t.done:
+                break
+        assert t.done and t.err == "OK"
+
+    path = str(tmp_path / "s.pkl")
+    d.save(path, extra=skv.state_dict())
+
+    d2 = EngineDriver.restore(path)
+    skv2 = BatchedShardKV(d2)
+    skv2.load_state_dict(d2.restored_extra)
+    for k in ("0", "5", "9"):
+        t = skv2.submit(route(skv2, k), GET, k)
+        for _ in range(80):
+            skv2.pump()
+            if t.done:
+                break
+        assert t.done and t.err == "OK" and t.value == "v" + k, (
+            f"key {k} lost across checkpoint: {t}"
+        )
