@@ -225,10 +225,25 @@ class BatchedShardKV(FrontierService):
         return blob
 
     def load_state_dict(self, blob: Dict[str, Any]) -> None:
+        import copy
+
         super().load_state_dict(blob)
         self.configs = list(blob["configs"])
         self._ctrl_latest = dict(blob["ctrl_latest"])
-        self.reps = blob["reps"]
+        # Copy (never alias) so re-loading the same blob starts from the
+        # checkpoint, not from this incarnation's later mutations.
+        self.reps = copy.deepcopy(blob["reps"])
+        # Pending-op tickets in the checkpoint are deepcopy clones — the
+        # driver's payload bindings hold *different* ticket objects, so
+        # an eviction after restore would resolve the payload's clone
+        # while rep.pending_* stayed live forever, wedging orchestration.
+        # Clear them: re-proposal is idempotent (config-num and
+        # shard-state gates make duplicates no-ops).
+        for rep in self.reps.values():
+            rep.pending_config = None
+            rep.pending_insert.clear()
+            rep.pending_delete.clear()
+            rep.pending_confirm.clear()
         self._route = jnp.asarray(blob["route"])
         self._ctrl_cmd = blob["ctrl_cmd"]
         self._orchestrate_enabled = blob["orchestrate"]
@@ -279,6 +294,33 @@ class BatchedShardKV(FrontierService):
         """Latest *committed* config (direct read of the applied config
         RSM — the in-process form of the clerk's Query)."""
         return self.configs[-1].clone()
+
+    def get_fast(self, key: str) -> ShardTicket:
+        """Linearizable read served from the applied frontier WITHOUT a
+        log entry — the sharded form of ``BatchedKV.get``'s ReadIndex
+        collapse (this service is the sole acker of every write across
+        all groups, so the applied frontier covers every acknowledged
+        op), additionally gated on shard ownership exactly like the
+        logged path's apply-time re-check: only a replica whose applied
+        config owns the shard in a serving state may answer
+        (`_apply_client` above; Challenge 2 gate).  During migration the
+        caller sees ``ErrWrongGroup`` and retries, as with logged ops."""
+        shard = key2shard(key)
+        # Host-side routing: configs[-1].shards and _route are assigned
+        # together in _apply_ctrl, and a device readback here would put
+        # a sync on the zero-device-work path.
+        gid = self.configs[-1].shards[shard]
+        t = ShardTicket(group=gid, done=True, done_tick=self.driver.tick)
+        rep = self.reps.get(gid)
+        if rep is None or not rep.can_serve(shard):
+            t.err = ERR_WRONG_GROUP
+            return t
+        sh = rep.shards[shard]
+        if key in sh.data:
+            t.value = sh.data[key]
+        else:
+            t.err = ERR_NO_KEY
+        return t
 
     def shard_table(self) -> jnp.ndarray:
         """Device shard→gid routing table for :func:`route_keys`."""
@@ -589,6 +631,36 @@ class BatchedShardClerk:
                     ret=float(self.skv.driver.tick) + 0.5,
                 )
             )
+
+    def get_fast(self, key: str, max_ticks: int = 4000) -> str:
+        """ReadIndex fast read with the clerk retry loop: instant when
+        the routed owner is serving; pumps through migration windows
+        (ErrWrongGroup) like any other clerk op.  Recorded in the
+        porcupine history with its full call→return interval."""
+        call = self.skv.driver.tick
+        waited = 0
+        while True:
+            t = self.skv.get_fast(key)
+            if t.err in (OK, ERR_NO_KEY):
+                value = t.value if t.err == OK else ""
+                shard = key2shard(key)
+                if shard in self._record:
+                    self.histories[shard].append(
+                        Operation(
+                            client_id=self.client_id,
+                            input=KvInput(op=OP_GET, key=key),
+                            call=float(call),
+                            output=KvOutput(value=value),
+                            ret=float(self.skv.driver.tick) + 0.5,
+                        )
+                    )
+                return value
+            if waited >= max_ticks:
+                raise TimeoutError(
+                    f"get_fast({key!r}): no serving owner in {max_ticks} ticks"
+                )
+            self.skv.pump(5)
+            waited += 5
 
     # -- blocking convenience ----------------------------------------------
 

@@ -178,3 +178,56 @@ def test_checkpoint_shardkv_keeps_shard_data(tmp_path):
         assert t.done and t.err == "OK" and t.value == "v" + k, (
             f"key {k} lost across checkpoint: {t}"
         )
+
+
+def test_checkpoint_midmigration_resumes_orchestration(tmp_path):
+    """Checkpoint taken mid-migration (internal config/insert proposals
+    in flight, target group down): the restored service must re-propose
+    and complete the migration — pending-op tickets from the old
+    incarnation must not wedge orchestration."""
+    from multiraft_tpu.engine.shardkv import OK, PUT, GET, BatchedShardKV
+
+    d = EngineDriver(EngineConfig(G=3, P=3, L=64, E=8, INGEST=8), seed=16)
+    assert d.run_until_quiet_leaders(600)
+    skv = BatchedShardKV(d)
+    skv.admin_sync("join", [1])
+    keys = [chr(c) for c in range(48, 58)]  # '0'..'9' → all shards
+    for k in keys:
+        t = skv.submit(1, PUT, k, "m" + k, client_id=1,
+                       command_id=ord(k))
+        for _ in range(60):
+            skv.pump()
+            if t.done:
+                break
+        assert t.done and t.err == OK
+    # Stall a migration: group 2's majority is down when it joins.
+    for p in (0, 1):
+        d.set_alive(2, p, False)
+    skv.admin_sync("join", [2])
+    for _ in range(30):
+        skv.pump(5)  # leaves insert/config proposals in flight
+
+    path = str(tmp_path / "mid.pkl")
+    d.save(path, extra=skv.state_dict())
+
+    d2 = EngineDriver.restore(path)
+    skv2 = BatchedShardKV(d2)
+    skv2.load_state_dict(d2.restored_extra)
+    for p in (0, 1):
+        d2.restart_replica(2, p)
+    # Orchestration must finish the migration in the new incarnation.
+    cfg = skv2.query_latest()
+    moved = [s for s in range(10) if cfg.shards[s] == 2]
+    assert moved, "nothing migrated to group 2 in this scenario"
+    for _ in range(600):
+        skv2.pump(5)
+        rep2 = skv2.reps[2]
+        if rep2.cur.num == cfg.num and all(
+            rep2.shards[s].state == 0 for s in moved  # SERVING
+        ):
+            break
+    else:
+        raise AssertionError("restored service never completed migration")
+    for k in keys:
+        v = skv2.get_fast(k)
+        assert v.err == OK and v.value == "m" + k

@@ -286,3 +286,104 @@ def test_route_keys_device_table():
     cfg = skv.query_latest()
     expect = np.array([cfg.shards[h % NSHARDS] for h in range(100)])
     assert (gids == expect).all()
+
+
+def test_fast_reads_match_logged_reads():
+    """Service-level ReadIndex fast reads agree with logged Gets on
+    every shard, and miss with ErrNoKey on absent keys."""
+    from multiraft_tpu.engine.shardkv import ERR_NO_KEY
+
+    skv = make(G=3, seed=21)
+    skv.admin_sync("join", [1, 2])
+    settle(skv)
+    clerk = BatchedShardClerk(skv, client_id=1)
+    kmap = keys_for_all_shards()
+    for shard, k in kmap.items():
+        clerk.put(k, f"w{shard}")
+    for shard, k in kmap.items():
+        t = skv.get_fast(k)
+        assert t.done and t.err == OK and t.value == f"w{shard}"
+        assert clerk.get(k) == t.value  # logged path agrees
+    # An unwritten key on a served shard misses with ErrNoKey.
+    shard0, k0 = next(iter(kmap.items()))
+    k_other = chr(ord(k0) + NSHARDS)  # same shard, never written
+    assert key2shard(k_other) == shard0
+    assert skv.get_fast(k_other).err == ERR_NO_KEY
+
+
+def test_fast_reads_respect_migration_gates():
+    """During a stalled migration, fast reads refuse moved shards at
+    the old owner (ErrWrongGroup) and keep serving kept shards; after
+    the new owner revives, fast reads return the migrated data."""
+    skv = make(G=3, seed=22)
+    skv.admin_sync("join", [1])
+    clerk = BatchedShardClerk(skv, client_id=1)
+    kmap = keys_for_all_shards()
+    for shard, k in kmap.items():
+        clerk.put(k, f"v{shard}")
+    for p in (0, 1):
+        skv.driver.set_alive(2, p, False)
+    skv.admin_sync("join", [2])
+    for _ in range(40):
+        skv.pump(5)
+    cfg = skv.query_latest()
+    kept = [s for s in range(NSHARDS) if cfg.shards[s] == 1 and s in kmap]
+    moved = [s for s in range(NSHARDS) if cfg.shards[s] == 2 and s in kmap]
+    assert kept and moved
+    for s in kept:
+        assert skv.get_fast(kmap[s]).value == f"v{s}"
+    for s in moved:
+        assert skv.get_fast(kmap[s]).err == ERR_WRONG_GROUP
+    for p in (0, 1):
+        skv.driver.restart_replica(2, p)
+    settle(skv)
+    for s in moved:
+        assert skv.get_fast(kmap[s]).value == f"v{s}"
+
+
+def test_fast_reads_in_churn_history_linearizable():
+    """Clerk fast reads interleaved with logged writes through config
+    churn stay linearizable on recorded shards."""
+    skv = make(G=4, seed=23)
+    skv.admin_sync("join", [1])
+    sample = sorted(keys_for_all_shards().items())[:2]
+    shards = [s for s, _ in sample]
+    writer = BatchedShardClerk(skv, client_id=1, record_shards=shards)
+    reader = BatchedShardClerk(skv, client_id=2, record_shards=shards)
+    session = None
+    rng = np.random.default_rng(3)
+    admin_steps = iter([("join", [2, 3]), ("leave", [3])])
+    admin_ticket = None
+    admin_op = None
+    for round_no in range(100):
+        if session is None or session.poll():
+            shard, key = sample[rng.integers(len(sample))]
+            session = writer.begin("Append", key, f"[{round_no}]")
+        if admin_ticket is not None and admin_ticket.done and admin_ticket.failed:
+            admin_ticket = getattr(skv, admin_op[0])(
+                admin_op[1], command_id=admin_ticket.command_id
+            )
+        elif admin_ticket is None or admin_ticket.done:
+            admin_op = next(admin_steps, None)
+            admin_ticket = (
+                getattr(skv, admin_op[0])(admin_op[1]) if admin_op else None
+            )
+            if admin_op is None:
+                admin_steps = iter(())
+        skv.pump(5)
+        session.poll()
+        _, key = sample[rng.integers(len(sample))]
+        reader.get_fast(key)
+    for _ in range(300):
+        skv.pump(5)
+        if session.poll():
+            break
+    from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+    from multiraft_tpu.porcupine.kv import kv_model
+
+    for shard in shards:
+        hist = writer.histories[shard] + reader.histories[shard]
+        res = check_operations(kv_model, hist, timeout=10.0)
+        assert res is not CheckResult.ILLEGAL, (
+            f"shard {shard}: fast reads broke linearizability"
+        )
