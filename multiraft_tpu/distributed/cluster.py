@@ -28,9 +28,21 @@ from .tcp import RpcNode
 
 __all__ = [
     "serve_kv",
+    "serve_ctrler",
+    "serve_shardkv",
     "KVProcessCluster",
+    "ShardKVProcessCluster",
     "BlockingClerk",
+    "BlockingShardClerk",
 ]
+
+
+def _addr_end(node: RpcNode, name: str):
+    """Resolve a ``"host:port"`` group-server name to a TcpClientEnd —
+    the deployment's ``make_end`` (the sim passes opaque endnames;
+    here the controller's group tables carry real addresses)."""
+    host, port = name.rsplit(":", 1)
+    return node.client_end(host, int(port))
 
 
 def serve_kv(
@@ -67,36 +79,96 @@ def serve_kv(
     return node
 
 
+def serve_ctrler(
+    me: int, ports: Sequence[int], data_dir: str, host: str = "127.0.0.1"
+) -> RpcNode:
+    """One shard-controller replica process (the config RSM,
+    reference: shardctrler/server.go:164-182 — over real sockets)."""
+    from ..services.shardctrler import ShardCtrler
+
+    sched = RealtimeScheduler()
+    node = RpcNode(sched, listen=True, host=host, port=ports[me])
+    ends = [node.client_end(host, p) for p in ports]
+    persister = DiskPersister(os.path.join(data_dir, f"ctrler-{me}"))
+    srv = sched.run_call(
+        lambda: ShardCtrler(sched, ends, me, persister, seed=1000 + me)
+    )
+    node.add_service("ShardCtrler", srv)
+    node.add_service("Raft", srv.rf)
+    return node
+
+
+def serve_shardkv(
+    me: int,
+    gid: int,
+    group_ports: Sequence[int],
+    ctrler_ports: Sequence[int],
+    data_dir: str,
+    host: str = "127.0.0.1",
+    maxraftstate: int = -1,
+) -> RpcNode:
+    """One replica of one shard group (the full migration-capable
+    server, reference: shardkv/server.go:77-98 wiring — raft +
+    controller clerk + make_end, here resolving "host:port" names to
+    TCP ends so groups pull shards from each other across processes)."""
+    from ..services.shardkv import ShardKVServer
+
+    sched = RealtimeScheduler()
+    node = RpcNode(sched, listen=True, host=host, port=group_ports[me])
+    ends = [node.client_end(host, p) for p in group_ports]
+    ctrler_ends = [node.client_end(host, p) for p in ctrler_ports]
+    persister = DiskPersister(os.path.join(data_dir, f"g{gid}-{me}"))
+    srv = sched.run_call(
+        lambda: ShardKVServer(
+            sched, ends, me, persister, gid, ctrler_ends,
+            lambda name: _addr_end(node, name),
+            maxraftstate=maxraftstate, seed=gid * 100 + me,
+        )
+    )
+    node.add_service("ShardKV", srv)
+    node.add_service("Raft", srv.rf)
+    return node
+
+
 def _server_main() -> None:  # pragma: no cover - subprocess entry
     import json
 
     spec = json.loads(sys.argv[2])
-    node = serve_kv(
-        me=spec["me"],
-        ports=spec["ports"],
-        data_dir=spec["data_dir"],
-        maxraftstate=spec.get("maxraftstate", -1),
-    )
+    kind = spec.get("kind", "kv")
+    if kind == "kv":
+        node = serve_kv(
+            me=spec["me"],
+            ports=spec["ports"],
+            data_dir=spec["data_dir"],
+            maxraftstate=spec.get("maxraftstate", -1),
+        )
+    elif kind == "ctrler":
+        node = serve_ctrler(spec["me"], spec["ports"], spec["data_dir"])
+    elif kind == "shardkv":
+        node = serve_shardkv(
+            me=spec["me"],
+            gid=spec["gid"],
+            group_ports=spec["ports"],
+            ctrler_ports=spec["ctrler_ports"],
+            data_dir=spec["data_dir"],
+            maxraftstate=spec.get("maxraftstate", -1),
+        )
+    else:
+        raise ValueError(f"unknown server kind {kind!r}")
     print(f"ready {node.port}", flush=True)
     while True:
         time.sleep(3600)
 
 
-class BlockingClerk:
-    """Synchronous client facade: drives the generator-coroutine Clerk on
-    a RealtimeScheduler and blocks the calling thread for the result."""
+class _BlockingClerkBase:
+    """Synchronous client facade: drives a generator-coroutine clerk on
+    a RealtimeScheduler and blocks the calling thread for the result.
+    Subclasses construct ``self._clerk`` (anything with get/put/append
+    generator methods)."""
 
-    def __init__(
-        self, ports: Sequence[int], host: str = "127.0.0.1",
-        sched: Optional[RealtimeScheduler] = None,
-        node: Optional[RpcNode] = None,
-    ) -> None:
-        from ..services.kvraft import Clerk
-
-        self.sched = sched or RealtimeScheduler()
-        self.node = node or RpcNode(self.sched)
-        ends = [self.node.client_end(host, p) for p in ports]
-        self._clerk = Clerk(self.sched, ends)
+    sched: RealtimeScheduler
+    node: RpcNode
+    _clerk: Any
 
     def _run(self, gen, timeout: float) -> Any:
         fut = self.sched.spawn(gen)
@@ -105,7 +177,7 @@ class BlockingClerk:
             # Cancel the abandoned retry loop (resolving the spawn future
             # halts the coroutine at its next step) — otherwise it would
             # spin forever and race the caller's next command on this
-            # single-outstanding-op Clerk.
+            # single-outstanding-op clerk.
             self.sched.post(fut.resolve, TIMEOUT)
             raise TimeoutError("cluster did not answer in time")
         return value
@@ -123,6 +195,40 @@ class BlockingClerk:
         self.node.close()
 
 
+class BlockingClerk(_BlockingClerkBase):
+    """Blocking client of a :class:`KVProcessCluster`."""
+
+    def __init__(
+        self, ports: Sequence[int], host: str = "127.0.0.1",
+        sched: Optional[RealtimeScheduler] = None,
+        node: Optional[RpcNode] = None,
+    ) -> None:
+        from ..services.kvraft import Clerk
+
+        self.sched = sched or RealtimeScheduler()
+        self.node = node or RpcNode(self.sched)
+        ends = [self.node.client_end(host, p) for p in ports]
+        self._clerk = Clerk(self.sched, ends)
+
+
+class BlockingShardClerk(_BlockingClerkBase):
+    """Blocking client of a sharded process cluster: drives the
+    unmodified :class:`~multiraft_tpu.services.shardkv.ShardClerk`
+    (config-tracking, per-group retry) over TCP ends."""
+
+    def __init__(
+        self, ctrler_ports: Sequence[int], host: str = "127.0.0.1"
+    ) -> None:
+        from ..services.shardkv import ShardClerk
+
+        self.sched = RealtimeScheduler()
+        self.node = RpcNode(self.sched)
+        ctrler_ends = [self.node.client_end(host, p) for p in ctrler_ports]
+        self._clerk = ShardClerk(
+            self.sched, ctrler_ends, lambda name: _addr_end(self.node, name)
+        )
+
+
 class KVProcessCluster:
     """Launch and manage ``n`` KV server OS processes (test/ops driver)."""
 
@@ -133,8 +239,6 @@ class KVProcessCluster:
         host: str = "127.0.0.1",
         maxraftstate: int = -1,
     ) -> None:
-        import socket
-
         self.n = n
         self.host = host
         self.data_dir = data_dir
@@ -143,15 +247,7 @@ class KVProcessCluster:
         # small window where another process could grab one before the
         # child listens — in that case start() raises and the caller
         # builds a fresh cluster; acceptable for a test/ops driver.
-        self.ports: List[int] = []
-        socks = []
-        for _ in range(n):
-            s = socket.socket()
-            s.bind((host, 0))
-            socks.append(s)
-            self.ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
+        self.ports: List[int] = _reserve_ports(n, host)
         self.procs: List[Optional[subprocess.Popen]] = [None] * n
 
     def start(self, i: int) -> None:
@@ -214,6 +310,152 @@ class KVProcessCluster:
     def shutdown(self) -> None:
         for i in range(self.n):
             self.kill(i)
+
+
+def _reserve_ports(n: int, host: str) -> List[int]:
+    import socket
+
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ShardKVProcessCluster:
+    """The full sharded stack as OS processes: ``nctrlers`` controller
+    replicas plus ``n`` replicas per group, all over TCP with disk
+    persistence — the deployment form of the reference's shardkv
+    harness (reference: shardkv/config.go:338-382, which only ever
+    builds one in-process simulated network)."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        gids: Sequence[int] = (100, 101),
+        n: int = 3,
+        nctrlers: int = 3,
+        host: str = "127.0.0.1",
+        maxraftstate: int = -1,
+    ) -> None:
+        self.host = host
+        self.data_dir = data_dir
+        self.maxraftstate = maxraftstate
+        self.gids = list(gids)
+        self.n = n
+        self.ctrler_ports = _reserve_ports(nctrlers, host)
+        self.group_ports = {g: _reserve_ports(n, host) for g in self.gids}
+        self.procs: dict = {}  # ("ctrler", i) | (gid, i) -> Popen
+
+    # -- process management -----------------------------------------------
+
+    def _spawn(self, key, spec) -> None:
+        import json
+
+        old = self.procs.get(key)
+        assert old is None or old.poll() is not None
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            stderr = open(os.path.join(log_dir, f"server-{key}.err"), "a")
+        else:
+            stderr = subprocess.DEVNULL
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "multiraft_tpu.distributed.cluster",
+                 json.dumps(spec)],
+                stdout=subprocess.PIPE, stderr=stderr,
+                env=env, text=True,
+            )
+        finally:
+            if log_dir:
+                stderr.close()
+        # Register before the readiness check so shutdown() can reap a
+        # half-started server even when the check below raises.
+        self.procs[key] = proc
+        line = proc.stdout.readline()
+        if not line.startswith("ready"):
+            raise RuntimeError(f"server {key} failed to start: {line!r}")
+
+    def start_ctrler(self, i: int) -> None:
+        self._spawn(("ctrler", i), {
+            "kind": "ctrler", "me": i, "ports": self.ctrler_ports,
+            "data_dir": self.data_dir,
+        })
+
+    def start_server(self, gid: int, i: int) -> None:
+        self._spawn((gid, i), {
+            "kind": "shardkv", "me": i, "gid": gid,
+            "ports": self.group_ports[gid],
+            "ctrler_ports": self.ctrler_ports,
+            "data_dir": self.data_dir,
+            "maxraftstate": self.maxraftstate,
+        })
+
+    def start_all(self) -> None:
+        for i in range(len(self.ctrler_ports)):
+            self.start_ctrler(i)
+        for g in self.gids:
+            for i in range(self.n):
+                self.start_server(g, i)
+
+    def kill(self, key) -> None:
+        """SIGKILL ("ctrler", i) or (gid, i); disk carries the restart."""
+        p = self.procs.get(key)
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self.procs[key] = None
+
+    def shutdown(self) -> None:
+        for key in list(self.procs):
+            self.kill(key)
+
+    # -- admin (controller ops over TCP) ----------------------------------
+
+    def _group_names(self, gid: int) -> List[str]:
+        return [f"{self.host}:{p}" for p in self.group_ports[gid]]
+
+    def _admin(self, fn, timeout: float = 30.0) -> Any:
+        from ..services.shardctrler import CtrlerClerk
+
+        sched = RealtimeScheduler()
+        node = RpcNode(sched)
+        try:
+            ck = CtrlerClerk(
+                sched, [node.client_end(self.host, p) for p in self.ctrler_ports]
+            )
+            fut = sched.spawn(fn(ck))
+            value = sched.wait(fut, timeout)
+            if value is TIMEOUT:
+                sched.post(fut.resolve, TIMEOUT)
+                raise TimeoutError("controller did not answer in time")
+            return value
+        finally:
+            node.close()
+            sched.stop()  # the loop thread would otherwise leak per call
+
+    def join(self, gid: int) -> None:
+        self._admin(lambda ck: ck.join({gid: self._group_names(gid)}))
+
+    def leave(self, gid: int) -> None:
+        self._admin(lambda ck: ck.leave([gid]))
+
+    def query(self):
+        return self._admin(lambda ck: ck.query(-1))
+
+    def clerk(self) -> BlockingShardClerk:
+        return BlockingShardClerk(self.ctrler_ports, host=self.host)
 
 
 if __name__ == "__main__":  # pragma: no cover - subprocess entry

@@ -253,11 +253,17 @@ class Clerk:
     _next_client_id = 0
 
     def __init__(self, sched: Scheduler, ends: List[ClientEnd]) -> None:
+        from ..utils.ids import unique_client_id
+
         self.sched = sched
         self.ends = ends
         self.leader = 0
         Clerk._next_client_id += 1
-        self.client_id = Clerk._next_client_id
+        # Nonce-qualified: the class counter is only unique within one
+        # process, but the distributed deployment runs clerks in many
+        # (every server process owns internal clerks) — a collision
+        # makes dedup tables swallow another client's commands.
+        self.client_id = unique_client_id(Clerk._next_client_id)
         self.command_id = 0
 
     def _command(self, op: str, key: str, value: str):

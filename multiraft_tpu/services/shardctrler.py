@@ -279,8 +279,11 @@ class CtrlerClerk:
         self.sched = sched
         self.ends = ends
         self.leader = 0
+        from ..utils.ids import unique_client_id
+
         CtrlerClerk._next_client_id += 1
-        self.client_id = CtrlerClerk._next_client_id
+        # Nonce-qualified for cross-process uniqueness (see utils/ids.py).
+        self.client_id = unique_client_id(CtrlerClerk._next_client_id)
         self.command_id = 0
 
     def _command(self, args: CtrlerArgs):

@@ -544,7 +544,7 @@ class ShardClerk:
     Routes by ``key2shard`` through the latest known config; re-queries
     the controller on ErrWrongGroup or exhausted retries."""
 
-    _next_client_id = 1 << 30
+    _next_client_id = 1 << 22  # distinct range from KV/ctrler clerks
 
     def __init__(
         self,
@@ -557,8 +557,11 @@ class ShardClerk:
         self.make_end = make_end
         self._ends: Dict[Any, ClientEnd] = {}
         self.config = Config()
+        from ..utils.ids import unique_client_id
+
         ShardClerk._next_client_id += 1
-        self.client_id = ShardClerk._next_client_id
+        # Nonce-qualified for cross-process uniqueness (see utils/ids.py).
+        self.client_id = unique_client_id(ShardClerk._next_client_id)
         self.command_id = 0
 
     def _end_to(self, servername: Any) -> ClientEnd:
