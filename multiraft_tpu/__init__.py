@@ -14,6 +14,11 @@ linearizability checker) designed for JAX/XLA/Pallas:
                   over ``(groups, peers)`` state tensors, Pallas kernels
                   for quorum-commit/vote-tally hot ops
 * ``harness``   — test fixtures: partitions, crashes, churn drivers
+* ``distributed`` — real deployment: epoll TCP transport (C++ core),
+                  wall-clock scheduler, checksummed disk persister,
+                  multi-process KV and sharded clusters
+* ``utils``     — config system, metrics registry, Chrome-trace tracer,
+                  cross-process client identity
 """
 
 __version__ = "0.1.0"

@@ -45,6 +45,43 @@ def _addr_end(node: RpcNode, name: str):
     return node.client_end(host, int(port))
 
 
+def _launch_server(spec: dict, label: Any) -> subprocess.Popen:
+    """Spawn one server subprocess (shared by both cluster drivers):
+    env setup, optional MRT_SERVER_LOG_DIR stderr capture, Popen."""
+    import json
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # server procs never need a chip
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        stderr = open(os.path.join(log_dir, f"server-{label}.err"), "a")
+    else:
+        stderr = subprocess.DEVNULL
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m", "multiraft_tpu.distributed.cluster",
+             json.dumps(spec)],
+            stdout=subprocess.PIPE, stderr=stderr, env=env, text=True,
+        )
+    finally:
+        if log_dir:
+            stderr.close()
+
+
+def _check_ready(proc: subprocess.Popen, label: Any) -> None:
+    """Block until the child prints its readiness line.  Callers must
+    register ``proc`` for reaping BEFORE calling this — a child that
+    fails the check is still a live process."""
+    line = proc.stdout.readline()
+    if not line.startswith("ready"):
+        raise RuntimeError(f"server {label} failed to start: {line!r}")
+
+
 def serve_kv(
     me: int,
     ports: Sequence[int],
@@ -169,6 +206,7 @@ class _BlockingClerkBase:
     sched: RealtimeScheduler
     node: RpcNode
     _clerk: Any
+    _owns_sched: bool = True
 
     def _run(self, gen, timeout: float) -> Any:
         fut = self.sched.spawn(gen)
@@ -192,7 +230,11 @@ class _BlockingClerkBase:
         self._run(self._clerk.append(key, value), timeout)
 
     def close(self) -> None:
+        """Close the RPC node and, when this clerk created its own
+        scheduler, stop its loop thread too (one call cleans up)."""
         self.node.close()
+        if self._owns_sched:
+            self.sched.stop()
 
 
 class BlockingClerk(_BlockingClerkBase):
@@ -205,6 +247,7 @@ class BlockingClerk(_BlockingClerkBase):
     ) -> None:
         from ..services.kvraft import Clerk
 
+        self._owns_sched = sched is None
         self.sched = sched or RealtimeScheduler()
         self.node = node or RpcNode(self.sched)
         ends = [self.node.client_end(host, p) for p in ports]
@@ -251,8 +294,6 @@ class KVProcessCluster:
         self.procs: List[Optional[subprocess.Popen]] = [None] * n
 
     def start(self, i: int) -> None:
-        import json
-
         assert self.procs[i] is None or self.procs[i].poll() is not None
         spec = {
             "me": i,
@@ -260,37 +301,10 @@ class KVProcessCluster:
             "data_dir": self.data_dir,
             "maxraftstate": self.maxraftstate,
         }
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")  # server procs never need a chip
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            stderr = open(os.path.join(log_dir, f"server-{i}.err"), "a")
-        else:
-            stderr = subprocess.DEVNULL
-        try:
-            self.procs[i] = subprocess.Popen(
-                [
-                    sys.executable,
-                    "-m",
-                    "multiraft_tpu.distributed.cluster",
-                    json.dumps(spec),
-                ],
-                stdout=subprocess.PIPE,
-                stderr=stderr,
-                env=env,
-                text=True,
-            )
-        finally:
-            if log_dir:
-                stderr.close()
-        line = self.procs[i].stdout.readline()
-        if not line.startswith("ready"):
-            raise RuntimeError(f"server {i} failed to start: {line!r}")
+        # Register before the readiness check so shutdown() can reap a
+        # half-started server even when the check raises.
+        self.procs[i] = _launch_server(spec, i)
+        _check_ready(self.procs[i], i)
 
     def start_all(self) -> None:
         for i in range(self.n):
@@ -350,42 +364,19 @@ class ShardKVProcessCluster:
         self.ctrler_ports = _reserve_ports(nctrlers, host)
         self.group_ports = {g: _reserve_ports(n, host) for g in self.gids}
         self.procs: dict = {}  # ("ctrler", i) | (gid, i) -> Popen
+        self._admin_sched: Optional[RealtimeScheduler] = None
+        self._admin_node: Optional[RpcNode] = None
+        self._admin_ck: Any = None
 
     # -- process management -----------------------------------------------
 
     def _spawn(self, key, spec) -> None:
-        import json
-
         old = self.procs.get(key)
         assert old is None or old.poll() is not None
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            stderr = open(os.path.join(log_dir, f"server-{key}.err"), "a")
-        else:
-            stderr = subprocess.DEVNULL
-        try:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "multiraft_tpu.distributed.cluster",
-                 json.dumps(spec)],
-                stdout=subprocess.PIPE, stderr=stderr,
-                env=env, text=True,
-            )
-        finally:
-            if log_dir:
-                stderr.close()
         # Register before the readiness check so shutdown() can reap a
-        # half-started server even when the check below raises.
-        self.procs[key] = proc
-        line = proc.stdout.readline()
-        if not line.startswith("ready"):
-            raise RuntimeError(f"server {key} failed to start: {line!r}")
+        # half-started server even when the check raises.
+        self.procs[key] = _launch_server(spec, key)
+        _check_ready(self.procs[key], key)
 
     def start_ctrler(self, i: int) -> None:
         self._spawn(("ctrler", i), {
@@ -420,6 +411,10 @@ class ShardKVProcessCluster:
     def shutdown(self) -> None:
         for key in list(self.procs):
             self.kill(key)
+        if self._admin_sched is not None:
+            self._admin_node.close()
+            self._admin_sched.stop()
+            self._admin_sched = self._admin_node = self._admin_ck = None
 
     # -- admin (controller ops over TCP) ----------------------------------
 
@@ -427,23 +422,26 @@ class ShardKVProcessCluster:
         return [f"{self.host}:{p}" for p in self.group_ports[gid]]
 
     def _admin(self, fn, timeout: float = 30.0) -> Any:
+        """Run a controller-clerk op on a lazily-created persistent
+        admin client (one scheduler thread + node for the cluster's
+        lifetime — callers poll query() in loops)."""
         from ..services.shardctrler import CtrlerClerk
 
-        sched = RealtimeScheduler()
-        node = RpcNode(sched)
-        try:
-            ck = CtrlerClerk(
-                sched, [node.client_end(self.host, p) for p in self.ctrler_ports]
+        if self._admin_sched is None:
+            self._admin_sched = RealtimeScheduler()
+            self._admin_node = RpcNode(self._admin_sched)
+            self._admin_ck = CtrlerClerk(
+                self._admin_sched,
+                [self._admin_node.client_end(self.host, p)
+                 for p in self.ctrler_ports],
             )
-            fut = sched.spawn(fn(ck))
-            value = sched.wait(fut, timeout)
-            if value is TIMEOUT:
-                sched.post(fut.resolve, TIMEOUT)
-                raise TimeoutError("controller did not answer in time")
-            return value
-        finally:
-            node.close()
-            sched.stop()  # the loop thread would otherwise leak per call
+        sched = self._admin_sched
+        fut = sched.spawn(fn(self._admin_ck))
+        value = sched.wait(fut, timeout)
+        if value is TIMEOUT:
+            sched.post(fut.resolve, TIMEOUT)
+            raise TimeoutError("controller did not answer in time")
+        return value
 
     def join(self, gid: int) -> None:
         self._admin(lambda ck: ck.join({gid: self._group_names(gid)}))

@@ -394,7 +394,6 @@ class TestTcpKVGroup:
             assert clerk.get("missing") == ""
         finally:
             clerk.close()
-            clerk.sched.stop()
             for n in nodes:
                 n.close()
                 n.sched.stop()
@@ -428,7 +427,6 @@ class TestProcessCluster:
             clerk.append("a", "3")
             assert clerk.get("a") == "123"
             clerk.close()
-            clerk.sched.stop()
         finally:
             cluster.shutdown()
 
@@ -441,7 +439,6 @@ class TestProcessCluster:
             clerk = cluster.clerk()
             clerk.put("persisted", "yes")
             clerk.close()
-            clerk.sched.stop()
 
             for i in range(3):
                 cluster.kill(i)
@@ -450,7 +447,6 @@ class TestProcessCluster:
             clerk2 = cluster.clerk()
             assert clerk2.get("persisted") == "yes"
             clerk2.close()
-            clerk2.sched.stop()
         finally:
             cluster.shutdown()
 
@@ -511,6 +507,5 @@ class TestShardProcessCluster:
                     f"key {k} lost when group 100 left"
                 )
             clerk.close()
-            clerk.sched.stop()
         finally:
             cluster.shutdown()

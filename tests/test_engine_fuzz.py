@@ -213,3 +213,13 @@ def test_fuzz_partition_majority_minority():
             mon.observe()
     for g in range(cfg.G):
         d.check_log_matching(g)
+
+
+def test_fuzz_full_cocktail_five_peers():
+    """Everything at once on P=5: crashes, restarts, live partitions,
+    message loss, AND long reordering — per-tick safety throughout."""
+    commits = run_fuzz(
+        seed=77, P=5, ticks=400, p_crash=0.04, reorder=0.5,
+        drop_choices=(0.0, 0.1, 0.2),
+    )
+    assert commits > 0
