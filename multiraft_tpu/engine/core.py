@@ -36,7 +36,10 @@ Deliberate divergences (documented):
   host reads).
 
 Sharding: every tensor is independent along G, so the whole engine
-shards over a ``Mesh`` 'groups' axis with zero collectives — consensus
+shards over a ``Mesh`` 'groups' axis with zero collectives (use
+``jax.shard_map`` so the steady-state fast-path conds evaluate
+per-device — under plain GSPMD jit their global predicates lower to
+scalar all-reduces; see ``__graft_entry__.dryrun_multichip``) — consensus
 *within* a group never crosses a shard boundary.  (Cross-host traffic
 only appears when a logical group spans hosts, which the transport
 layer handles, not the kernel.)
@@ -441,7 +444,9 @@ def tick_impl(
                 & (_ring_read(state.log_term, idx, L) != incoming),
                 axis=-1,
             ),
-            lambda _: jnp.zeros((G, P), bool),
+            # zeros_like(match), not zeros((G,P)): under shard_map's
+            # rep-tracking both branches must vary over the mesh axis.
+            lambda _: jnp.zeros_like(match),
             None,
         )  # [G,P]
         log = _ring_write(
