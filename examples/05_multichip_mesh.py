@@ -65,6 +65,7 @@ def main() -> None:
     # PER DEVICE instead of becoming cross-shard all-reduces, and the
     # global scalar metrics are dropped — so the compiled consensus
     # step contains zero collectives.
+    assert cfg.G % len(devices) == 0, "G must divide over the mesh"
     local_cfg = dataclasses.replace(cfg, G=cfg.G // len(devices))
 
     def consensus_local(state, inbox, new_cmds, key):

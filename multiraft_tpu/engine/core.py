@@ -82,6 +82,13 @@ class EngineConfig:
     # non-TPU backends (parity/testing path).
     use_pallas: bool = False
     pallas_interpret: bool = False
+    # PreVote (etcd/TiKV-style, beyond the reference): an election
+    # timeout launches a NON-BINDING prevote round at term+1 first;
+    # only a prevote quorum promotes to a real candidacy.  Voters that
+    # heard a live leader within ELECT_MIN ticks refuse, so a replica
+    # rejoining from a partition cannot depose a healthy leader by
+    # term inflation.  Off by default (reference-faithful elections).
+    prevote: bool = False
 
     def __post_init__(self) -> None:
         # The ring-log algebra requires headroom: vectorized scatters
@@ -121,19 +128,24 @@ class EngineState(NamedTuple):
     elect_dl: jnp.ndarray  # i32[G,P] election deadline tick
     hb_due: jnp.ndarray  # i32[G,P] next heartbeat tick
     alive: jnp.ndarray  # bool[G,P] fault-injection: replica up
+    pre_votes: jnp.ndarray  # bool[G,P,P] prevote grants (prevote mode)
+    last_heard: jnp.ndarray  # i32[G,P] last tick a leader was heard
 
 
 class Mailbox(NamedTuple):
     """Dense per-edge messages, all ``[G, src, dst]`` (+ trailing dims)."""
 
-    # RequestVote (reference: raft/raft_rpc.go RequestVote args/reply)
+    # RequestVote (reference: raft/raft_rpc.go RequestVote args/reply);
+    # the ``pre`` bits mark non-binding PreVote rounds.
     vr_active: jnp.ndarray  # bool[G,P,P]
     vr_term: jnp.ndarray  # i32[G,P,P]
     vr_last_idx: jnp.ndarray  # i32[G,P,P]
     vr_last_term: jnp.ndarray  # i32[G,P,P]
+    vr_pre: jnp.ndarray  # bool[G,P,P]
     vp_active: jnp.ndarray  # bool[G,P,P]  src=voter, dst=candidate
     vp_term: jnp.ndarray  # i32[G,P,P]
     vp_granted: jnp.ndarray  # bool[G,P,P]
+    vp_pre: jnp.ndarray  # bool[G,P,P]
     # AppendEntries / InstallSnapshot (snap flag)
     ar_active: jnp.ndarray  # bool[G,P,P]
     ar_term: jnp.ndarray  # i32[G,P,P]
@@ -173,6 +185,8 @@ def init_state(cfg: EngineConfig, key: jax.Array) -> EngineState:
         elect_dl=deadlines,
         hb_due=z(G, P),
         alive=jnp.ones((G, P), bool),
+        pre_votes=jnp.zeros((G, P, P), bool),
+        last_heard=z(G, P),
     )
 
 
@@ -183,7 +197,9 @@ def empty_mailbox(cfg: EngineConfig) -> Mailbox:
     return Mailbox(
         vr_active=b(G, P, P), vr_term=z(G, P, P),
         vr_last_idx=z(G, P, P), vr_last_term=z(G, P, P),
+        vr_pre=b(G, P, P),
         vp_active=b(G, P, P), vp_term=z(G, P, P), vp_granted=b(G, P, P),
+        vp_pre=b(G, P, P),
         ar_active=b(G, P, P), ar_term=z(G, P, P),
         ar_prev_idx=z(G, P, P), ar_prev_term=z(G, P, P),
         ar_n=z(G, P, P), ar_terms=z(G, P, P, E), ar_commit=z(G, P, P),
@@ -270,6 +286,28 @@ def _last_index(state: EngineState) -> jnp.ndarray:
     return state.base + state.log_len
 
 
+def _step_down(
+    cfg: EngineConfig,
+    state: EngineState,
+    higher: jnp.ndarray,
+    m_term: jnp.ndarray,
+) -> EngineState:
+    """Observe a higher term: adopt it, clear the vote, drop to
+    follower (reference: the term-check prologue of every RPC handler).
+    In prevote mode a term bump also invalidates any prevote round in
+    flight — its grants were collected at a now-stale term."""
+    kw = dict(
+        term=jnp.where(higher, m_term, state.term),
+        voted_for=jnp.where(higher, -1, state.voted_for),
+        role=jnp.where(higher, FOLLOWER, state.role),
+    )
+    if cfg.prevote:
+        kw["pre_votes"] = jnp.where(
+            higher[..., None], False, state.pre_votes
+        )
+    return state._replace(**kw)
+
+
 # ---------------------------------------------------------------------------
 # The tick
 # ---------------------------------------------------------------------------
@@ -301,16 +339,16 @@ def tick_impl(
 
     # ---- 1. vote requests (reference: raft/raft_election.go:54-77) ----
     # Sequential over src so simultaneous candidacies serialize per dst.
+    # PreVote requests (vr_pre lanes) are handled non-bindingly: no
+    # term step-down, no voted_for, no timer reset.
     for s in range(P):
-        active = inbox.vr_active[:, s, :] & state.alive  # [G,P] at dst
+        arrived = inbox.vr_active[:, s, :] & state.alive  # [G,P] at dst
+        is_pre = inbox.vr_pre[:, s, :]
+        active = arrived & ~is_pre
         m_term = inbox.vr_term[:, s, :]
         # Step down on higher term.
         higher = active & (m_term > state.term)
-        state = state._replace(
-            term=jnp.where(higher, m_term, state.term),
-            voted_for=jnp.where(higher, -1, state.voted_for),
-            role=jnp.where(higher, FOLLOWER, state.role),
-        )
+        state = _step_down(cfg, state, higher, m_term)
         last_idx = _last_index(state)
         last_term = _term_at(cfg, state, last_idx)
         up_to_date = (inbox.vr_last_term[:, s, :] > last_term) | (
@@ -326,25 +364,49 @@ def tick_impl(
         state = state._replace(
             voted_for=jnp.where(grant, s, state.voted_for),
             elect_dl=jnp.where(grant, now + jitter, state.elect_dl),
+            last_heard=jnp.where(grant, now, state.last_heard),
         )
-        # Reply: out.vp[g, dst(voter)=·, dst_slot=s(candidate)]
+        if cfg.prevote:
+            pre_act = arrived & is_pre
+            # Grant iff the proposed term would win AND the log is up
+            # to date AND this voter has not heard a live leader within
+            # ELECT_MIN ticks (the disruption guard).  A LEADER never
+            # grants: it is in-lease by definition (its own last_heard
+            # is not refreshed while leading — etcd refuses likewise).
+            lease_expired = (now - state.last_heard) >= cfg.ELECT_MIN
+            grant_pre = (
+                pre_act
+                & (state.role != LEADER)
+                & (m_term > state.term)
+                & lease_expired
+                & up_to_date
+            )
+        else:
+            pre_act = jnp.zeros_like(active)
+            grant_pre = pre_act
+        # Reply: out.vp[g, dst(voter)=·, dst_slot=s(candidate)].  A src
+        # sends either a real or a pre request per tick, so the lanes
+        # are disjoint; merge into one write.
         out = out._replace(
-            vp_active=out.vp_active.at[:, :, s].set(active),
-            vp_term=out.vp_term.at[:, :, s].set(state.term),
-            vp_granted=out.vp_granted.at[:, :, s].set(grant),
+            vp_active=out.vp_active.at[:, :, s].set(active | pre_act),
+            vp_pre=out.vp_pre.at[:, :, s].set(pre_act),
+            vp_term=out.vp_term.at[:, :, s].set(
+                jnp.where(pre_act, m_term, state.term)
+            ),
+            vp_granted=out.vp_granted.at[:, :, s].set(
+                jnp.where(pre_act, grant_pre, grant)
+            ),
         )
 
     # ---- 2. vote replies → tally → leadership
     # (reference: raft/raft_election.go:27-49) ----
     for s in range(P):
-        active = inbox.vp_active[:, s, :] & state.alive  # at candidate dst
+        arrived = inbox.vp_active[:, s, :] & state.alive  # at candidate dst
+        reply_pre = inbox.vp_pre[:, s, :]
+        active = arrived & ~reply_pre
         m_term = inbox.vp_term[:, s, :]
         higher = active & (m_term > state.term)
-        state = state._replace(
-            term=jnp.where(higher, m_term, state.term),
-            voted_for=jnp.where(higher, -1, state.voted_for),
-            role=jnp.where(higher, FOLLOWER, state.role),
-        )
+        state = _step_down(cfg, state, higher, m_term)
         good = (
             active
             & (state.role == CANDIDATE)
@@ -354,6 +416,40 @@ def tick_impl(
         state = state._replace(
             votes=state.votes.at[:, :, s].set(state.votes[:, :, s] | good)
         )
+        if cfg.prevote:
+            # Pre replies echo the proposed term (our term+1); stale
+            # rounds (term moved on) are discarded.
+            good_pre = (
+                arrived
+                & reply_pre
+                & (m_term == state.term + 1)
+                & inbox.vp_granted[:, s, :]
+            )
+            state = state._replace(
+                pre_votes=state.pre_votes.at[:, :, s].set(
+                    state.pre_votes[:, :, s] | good_pre
+                )
+            )
+
+    if cfg.prevote:
+        # Prevote quorum → promote to a REAL candidacy (the only place
+        # a term bump happens in prevote mode).  The real vote requests
+        # go out in phase 5 via ``promote``.
+        diag = jnp.arange(P)[None, :, None] == jnp.arange(P)[None, None, :]
+        n_pre = jnp.sum(state.pre_votes, axis=-1)  # [G,P]
+        promote = (
+            state.alive & (state.role != LEADER) & (n_pre >= cfg.quorum)
+        )
+        state = state._replace(
+            term=jnp.where(promote, state.term + 1, state.term),
+            role=jnp.where(promote, CANDIDATE, state.role),
+            voted_for=jnp.where(promote, pi, state.voted_for),
+            votes=jnp.where(promote[..., None], diag, state.votes),
+            pre_votes=jnp.where(promote[..., None], False, state.pre_votes),
+            elect_dl=jnp.where(promote, now + jitter, state.elect_dl),
+        )
+    else:
+        promote = None
     if cfg.use_pallas:
         from .pallas_ops import vote_tally_pallas
 
@@ -397,8 +493,17 @@ def tick_impl(
             role=jnp.where(ok, FOLLOWER, state.role),
         )
         state = state._replace(
-            elect_dl=jnp.where(ok, now + jitter, state.elect_dl)
+            elect_dl=jnp.where(ok, now + jitter, state.elect_dl),
+            last_heard=jnp.where(ok, now, state.last_heard),
         )
+        if cfg.prevote:
+            # Hearing a live leader ABORTS any in-flight prevote round:
+            # grants collected during the leader's hiccup must not
+            # promote one tick after we acknowledged it (etcd aborts
+            # its campaign on MsgApp/MsgHeartbeat the same way).
+            state = state._replace(
+                pre_votes=jnp.where(ok[..., None], False, state.pre_votes)
+            )
 
         prev = inbox.ar_prev_idx[:, s, :]
         prev_t = inbox.ar_prev_term[:, s, :]
@@ -490,11 +595,7 @@ def tick_impl(
         active = inbox.ap_active[:, s, :] & state.alive  # at leader dst
         m_term = inbox.ap_term[:, s, :]
         higher = active & (m_term > state.term)
-        state = state._replace(
-            term=jnp.where(higher, m_term, state.term),
-            voted_for=jnp.where(higher, -1, state.voted_for),
-            role=jnp.where(higher, FOLLOWER, state.role),
-        )
+        state = _step_down(cfg, state, higher, m_term)
         good = active & (state.role == LEADER) & (m_term == state.term)
         succ = good & inbox.ap_success[:, s, :]
         fail = good & ~inbox.ap_success[:, s, :]
@@ -562,23 +663,40 @@ def tick_impl(
 
     # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----
     timeout = state.alive & (now >= state.elect_dl) & (state.role != LEADER)
-    state = state._replace(
-        term=jnp.where(timeout, state.term + 1, state.term),
-        role=jnp.where(timeout, CANDIDATE, state.role),
-        voted_for=jnp.where(timeout, pi, state.voted_for),
-        votes=jnp.where(timeout[..., None], own[0][None], state.votes),
-        elect_dl=jnp.where(timeout, now + jitter, state.elect_dl),
-    )
+    if not cfg.prevote:
+        state = state._replace(
+            term=jnp.where(timeout, state.term + 1, state.term),
+            role=jnp.where(timeout, CANDIDATE, state.role),
+            voted_for=jnp.where(timeout, pi, state.voted_for),
+            votes=jnp.where(timeout[..., None], own[0][None], state.votes),
+            elect_dl=jnp.where(timeout, now + jitter, state.elect_dl),
+        )
+        send_real = timeout
+        send_pre = jnp.zeros_like(timeout)
+    else:
+        # Timeout launches a fresh NON-BINDING prevote round: grant
+        # ourselves, ask peers at term+1, reset the retry window.  No
+        # term bump, no role change — promotion happened in phase 2.
+        state = state._replace(
+            pre_votes=jnp.where(timeout[..., None], own[0][None],
+                                state.pre_votes),
+            elect_dl=jnp.where(timeout, now + jitter, state.elect_dl),
+        )
+        send_real = promote  # phase-2 promotions announce immediately
+        send_pre = timeout  # disjoint: promote reset elect_dl this tick
     last_idx = _last_index(state)
     last_term = _term_at(cfg, state, last_idx)
     # Vote requests to every peer (dst masked to alive senders; self slot
     # excluded).
-    vr_act = timeout[:, :, None] & ~own & state.alive[:, :, None]
+    sending = send_real | send_pre
+    vr_act = sending[:, :, None] & ~own & state.alive[:, :, None]
+    vr_term_per = jnp.where(send_pre, state.term + 1, state.term)
     out = out._replace(
         vr_active=vr_act,
-        vr_term=jnp.broadcast_to(state.term[:, :, None], (G, P, P)),
+        vr_term=jnp.broadcast_to(vr_term_per[:, :, None], (G, P, P)),
         vr_last_idx=jnp.broadcast_to(last_idx[:, :, None], (G, P, P)),
         vr_last_term=jnp.broadcast_to(last_term[:, :, None], (G, P, P)),
+        vr_pre=jnp.broadcast_to(send_pre[:, :, None], (G, P, P)) & vr_act,
     )
 
     # ---- 5b. Start() ingestion: leaders append the firehose ----

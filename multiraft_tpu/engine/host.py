@@ -241,6 +241,10 @@ class EngineDriver:
         self.state = st._replace(
             role=st.role.at[g, p].set(FOLLOWER),
             votes=st.votes.at[g, p].set(False),
+            pre_votes=st.pre_votes.at[g, p].set(False),
+            # Conservative lease on rebirth: wait out ELECT_MIN before
+            # granting prevotes (volatile, like the vote tallies).
+            last_heard=st.last_heard.at[g, p].set(st.tick_no),
             # Applied rewinds to the snapshot floor: the service replays
             # the log above base (commit knowledge is volatile in Raft).
             commit=st.commit.at[g, p].set(st.base[g, p]),
@@ -364,7 +368,9 @@ class EngineDriver:
     # protocol needed.  This is the TPU-preemption recovery path;
     # *individual* crash fidelity stays with restart_replica().
 
-    CKPT_VERSION = 1
+    # v2: EngineState gained pre_votes/last_heard (PreVote support);
+    # Mailbox gained vr_pre/vp_pre.
+    CKPT_VERSION = 2
 
     def save(self, path: str, extra: Optional[Dict[str, Any]] = None) -> str:
         """Atomically write a full checkpoint.  ``extra`` carries

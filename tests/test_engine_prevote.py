@@ -1,0 +1,134 @@
+"""PreVote (EngineConfig.prevote=True) — the etcd/TiKV election
+hardening the reference lacks: an election timeout runs a non-binding
+prevote round first, and voters that heard a live leader within
+ELECT_MIN ticks refuse, so a replica rejoining from a partition cannot
+depose a healthy leader by term inflation."""
+
+import numpy as np
+
+from multiraft_tpu.engine.core import LEADER, EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.invariants import InvariantMonitor
+
+
+def boot(G=2, P=3, seed=0, **kw):
+    d = EngineDriver(
+        EngineConfig(G=G, P=P, L=32, E=4, INGEST=4, prevote=True, **kw),
+        seed=seed,
+    )
+    assert d.run_until_quiet_leaders(600), "prevote cluster never elected"
+    return d
+
+
+def test_prevote_elects_and_commits():
+    """Liveness: elections work end-to-end through the prevote round,
+    and the cluster commits."""
+    d = boot(G=4, seed=1)
+    for g in range(4):
+        d.start(g, f"c{g}")
+    for _ in range(60):
+        d.step()
+    assert d.commits_total >= 4
+
+
+def test_prevote_rejoin_does_not_depose_leader():
+    """The marquee property: partition a follower, let it time out for
+    a long while, heal — the healthy leader keeps its term and seat.
+    (Without prevote the rejoiner's inflated term forces re-election,
+    as test_fuzz_partition_majority_minority documents.)"""
+    d = boot(G=2, seed=2)
+    st = d.np_state()
+    leaders = {g: d.leader_of(g) for g in range(2)}
+    terms = {g: int(st["term"][g][leaders[g]]) for g in range(2)}
+
+    victim = {g: (leaders[g] + 1) % 3 for g in range(2)}
+    for g in range(2):
+        d.partition_replica(g, victim[g], False)
+    # Long isolation with live load: many election timeouts fire on the
+    # victim, each running a prevote round that cannot win.
+    for t in range(200):
+        d.start(t % 2, f"mid-{t}")
+        d.step()
+    st = d.np_state()
+    for g in range(2):
+        # No term inflation on the isolated replica...
+        assert int(st["term"][g][victim[g]]) == terms[g], (
+            f"group {g}: isolated replica inflated its term"
+        )
+    for g in range(2):
+        d.partition_replica(g, victim[g], True)
+    for _ in range(80):
+        d.step()
+    st = d.np_state()
+    for g in range(2):
+        # ...and the incumbent still leads at the SAME term after heal.
+        assert int(st["term"][g][leaders[g]]) == terms[g]
+        assert st["role"][g][leaders[g]] == LEADER, (
+            f"group {g}: healthy leader was deposed by a rejoiner"
+        )
+
+
+def test_prevote_leader_death_still_recovers():
+    """Prevotes must not block a LEGITIMATE election: kill the leader
+    and the rest elect a new one (their leases expire together)."""
+    d = boot(G=2, seed=3)
+    for g in range(2):
+        p = d.leader_of(g)
+        d.set_alive(g, p, False)
+    assert d.run_until_quiet_leaders(800), "no re-election after leader death"
+    for g in range(2):
+        assert d.leader_of(g) is not None
+
+
+def test_prevote_fuzz_safety():
+    """The full fault cocktail with prevote on: per-tick safety holds
+    and progress continues."""
+    rng = np.random.default_rng(55)
+    cfg = EngineConfig(G=4, P=3, L=32, E=4, INGEST=4, prevote=True)
+    d = EngineDriver(cfg, seed=55)
+    d.set_reorder(0.4, 2, 8)
+    mon = InvariantMonitor(d)
+    dead = set()
+    for t in range(400):
+        if rng.random() < 0.03:
+            g, p = int(rng.integers(4)), int(rng.integers(3))
+            if (g, p) not in dead:
+                d.set_alive(g, p, False)
+                dead.add((g, p))
+        if dead and rng.random() < 0.25:
+            g, p = list(dead)[int(rng.integers(len(dead)))]
+            d.restart_replica(g, p)
+            mon.note_restart(g, p)
+            dead.discard((g, p))
+        if t % 60 == 0:
+            d.drop_prob = float(rng.choice([0.0, 0.1, 0.2]))
+        if rng.random() < 0.5:
+            d.start(int(rng.integers(4)), f"c{t}")
+        d.step()
+        mon.observe()
+    assert d.commits_total > 0
+
+
+def test_prevote_oneway_partition_no_disruption():
+    """The review-found disruption case: a follower that merely MISSES
+    the leader's heartbeats (one-way cut: leader->victim down, victim's
+    outbound up) must not win a prevote round — the leader refuses
+    (in-lease by role) and the healthy follower refuses (in-lease by
+    last_heard), so self-grant alone never reaches quorum."""
+    d = boot(G=1, P=3, seed=5)
+    leader = d.leader_of(0)
+    term0 = int(d.np_state()["term"][0][leader])
+    victim = (leader + 1) % 3
+    d.set_edge(0, leader, victim, False)  # heartbeats lost, outbound fine
+    for t in range(250):
+        d.start(0, f"c{t}")
+        d.step()
+    st = d.np_state()
+    assert st["role"][0][leader] == LEADER, "leader deposed"
+    assert int(st["term"][0][leader]) == term0, (
+        "one-way partition inflated the cluster term"
+    )
+    d.set_edge(0, leader, victim, True)
+    for _ in range(60):
+        d.step()
+    d.check_log_matching(0)
