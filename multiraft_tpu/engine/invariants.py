@@ -66,6 +66,21 @@ class InvariantMonitor:
     def note_restart(self, g: int, p: int) -> None:
         self._restarted[(g, p)] = int(self.d.state.base[g, p])
 
+    def prune_below_snapshot_floor(self) -> int:
+        """Drop committed-term records below each group's cluster-wide
+        snapshot floor (min ``base`` over replicas): no replica still
+        holds those ring slots, so the records can never be re-checked.
+        Bounds memory for soak-length runs; returns entries dropped."""
+        base = np.asarray(self.d.state.base)
+        floor = base.min(axis=1)  # [G]
+        before = len(self.committed_term)
+        self.committed_term = {
+            (g, i): t
+            for (g, i), t in self.committed_term.items()
+            if i > floor[g]
+        }
+        return before - len(self.committed_term)
+
     # -- the four checks ---------------------------------------------------
 
     def observe(self) -> None:

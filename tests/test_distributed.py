@@ -509,3 +509,29 @@ class TestShardProcessCluster:
             clerk.close()
         finally:
             cluster.shutdown()
+
+    def test_controller_replica_crash_during_ops(self, tmp_path):
+        """Kill one controller replica (possibly its leader): admin
+        ops and client routing keep working on the remaining quorum,
+        and the replica rejoins from disk."""
+        from multiraft_tpu.distributed.cluster import ShardKVProcessCluster
+
+        cluster = ShardKVProcessCluster(str(tmp_path), gids=(100,), n=3)
+        try:
+            cluster.start_all()
+            cluster.join(100)
+            clerk = cluster.clerk()
+            clerk.put("a", "1")
+            cluster.kill(("ctrler", 0))
+            # Admin + data paths survive on the 2/3 controller quorum.
+            conf = cluster.query()
+            assert 100 in conf.groups
+            clerk.append("a", "2")
+            assert clerk.get("a") == "12"
+            cluster.start_ctrler(0)  # disk recovery
+            assert 100 in cluster.query().groups
+            clerk.put("b", "x")
+            assert clerk.get("b") == "x"
+            clerk.close()
+        finally:
+            cluster.shutdown()
