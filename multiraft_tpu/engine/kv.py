@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
-from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT, KvInput, KvOutput
+from ..porcupine.kv import OP_GET, OP_PUT, KvInput, KvOutput
 from ..porcupine.model import Operation
 from .frontier import FrontierService
 from .host import EngineDriver

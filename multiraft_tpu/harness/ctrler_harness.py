@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import random
-from typing import List
 
 from ..raft.persister import Persister
 from ..services.shardctrler import CtrlerClerk, ShardCtrler

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import defaultdict
-from typing import Any, List
+from typing import List
 
 from ..transport import codec
 from .model import Model, Operation

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import List, Optional
+from typing import Optional
 
 from ...utils.native_build import build_and_load
 

@@ -11,7 +11,6 @@ behind the same five methods.
 
 from __future__ import annotations
 
-from typing import Optional
 
 __all__ = ["Persister"]
 

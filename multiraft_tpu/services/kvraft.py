@@ -20,7 +20,7 @@ types are not reproduced.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..raft.messages import ApplyMsg
 from ..raft.node import RaftNode

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 __all__ = ["RaftTiming", "ServiceTiming", "FaultModel", "Settings"]
 

@@ -2,7 +2,6 @@
 
 import os
 
-from multiraft_tpu.porcupine.checker import CheckResult
 from multiraft_tpu.porcupine.kv import KvInput, KvOutput, OP_APPEND, OP_GET, OP_PUT, kv_model
 from multiraft_tpu.porcupine.model import Operation
 from multiraft_tpu.porcupine.visualization import visualize

@@ -3,7 +3,6 @@ tensor tick (SURVEY §7.2 step 5), plus cross-backend invariants shared
 with the event-driven sim (election safety, log matching, progress)."""
 
 import numpy as np
-import pytest
 
 from multiraft_tpu.engine.core import (
     CANDIDATE,

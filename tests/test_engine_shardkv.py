@@ -9,7 +9,6 @@ sim scheduler.
 """
 
 import numpy as np
-import pytest
 
 from multiraft_tpu.engine.core import EngineConfig
 from multiraft_tpu.engine.host import EngineDriver

@@ -8,7 +8,6 @@ Client workloads are op-count-bounded (the reference bounds by
 wall-clock; virtual time makes op counts the meaningful budget).
 """
 
-import pytest
 
 from multiraft_tpu.harness.kv_harness import KVHarness
 from multiraft_tpu.porcupine.checker import CheckResult, check_operations

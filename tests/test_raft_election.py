@@ -1,6 +1,5 @@
 """Raft 2A election tests (reference: raft/test_test.go:24-127)."""
 
-import pytest
 
 from multiraft_tpu.harness.raft_harness import RaftHarness
 from multiraft_tpu.raft.node import ELECTION_TIMEOUT

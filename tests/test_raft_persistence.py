@@ -5,7 +5,6 @@ reference's 1000 (wall-clock-bound in Go, event-bound here); the
 scenario structure is identical.
 """
 
-import pytest
 
 from multiraft_tpu.harness.raft_harness import RaftHarness
 from multiraft_tpu.raft.node import ELECTION_TIMEOUT

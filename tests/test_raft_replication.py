@@ -1,8 +1,7 @@
 """Raft 2B replication tests (reference: raft/test_test.go:128-683)."""
 
-import pytest
 
-from multiraft_tpu.harness.raft_harness import HarnessError, RaftHarness
+from multiraft_tpu.harness.raft_harness import RaftHarness
 from multiraft_tpu.raft.node import ELECTION_TIMEOUT
 
 

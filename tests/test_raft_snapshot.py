@@ -5,7 +5,6 @@ matrix with the MAXLOGSIZE gate; the harness applier snapshots every
 SNAPSHOT_INTERVAL applies (reference: raft/config.go:215-274).
 """
 
-import pytest
 
 from multiraft_tpu.harness.raft_harness import (
     MAX_LOG_SIZE,

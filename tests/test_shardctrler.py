@@ -3,7 +3,6 @@ property tests for the pure rebalancer."""
 
 import random
 
-import pytest
 
 from multiraft_tpu.harness.ctrler_harness import CtrlerHarness
 from multiraft_tpu.services.shardctrler import NSHARDS, Config, rebalance

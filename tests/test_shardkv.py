@@ -3,7 +3,6 @@ defines the server behavior the reference left unimplemented,
 SURVEY §2.7/§4.4), including Challenge 1 (shard deletion, bounded
 storage) and Challenge 2 (partial availability during migration)."""
 
-import pytest
 
 from multiraft_tpu.harness.shardkv_harness import ShardKVHarness
 from multiraft_tpu.porcupine.checker import CheckResult, check_operations

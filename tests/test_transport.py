@@ -3,7 +3,6 @@
 
 import dataclasses
 
-import pytest
 
 from multiraft_tpu.sim.scheduler import Scheduler
 from multiraft_tpu.transport import codec
