@@ -43,7 +43,9 @@ class RaftHarness:
         unreliable: bool = False,
         snapshot: bool = False,
         seed: int = 0,
+        prevote: bool = False,
     ) -> None:
+        self.prevote = prevote
         self.sched = Scheduler()
         self.net = Network(self.sched, seed=seed)
         self.net.set_reliable(not unreliable)
@@ -100,7 +102,8 @@ class RaftHarness:
         else:
             apply_fn = self._make_applier(i)
         raft = RaftNode(
-            self.sched, ends, i, persister, apply_fn, seed=self.seed * 131 + inc
+            self.sched, ends, i, persister, apply_fn,
+            seed=self.seed * 131 + inc, prevote=self.prevote,
         )
         self.rafts[i] = raft
         if self.use_snapshot:

@@ -63,6 +63,9 @@ class RequestVoteArgs:
     candidate_id: int = -1
     last_log_index: int = 0
     last_log_term: int = 0
+    # Non-binding PreVote probe (opt-in; see RaftNode(prevote=True)):
+    # ``term`` then carries the PROPOSED term (candidate's term + 1).
+    pre: bool = False
 
 
 @codec.registered

@@ -72,6 +72,7 @@ class RaftNode:
         persister: Persister,
         apply_fn: Callable[[ApplyMsg], None],
         seed: int = 0,
+        prevote: bool = False,
     ) -> None:
         self.sched = sched
         self.peers = peers
@@ -79,6 +80,11 @@ class RaftNode:
         self.persister = persister
         self.apply_fn = apply_fn
         self.rng = random.Random((seed << 16) ^ me)
+        # PreVote (etcd/TiKV-style, beyond the reference): election
+        # timeouts probe with a non-binding prevote round first; see
+        # the engine's EngineConfig.prevote for the design notes.
+        self.prevote = prevote
+        self._last_heard = float("-inf")  # time a leader was last heard
 
         self.current_term = 0
         self.voted_for: Optional[int] = None
@@ -206,8 +212,64 @@ class RaftNode:
         if self._killed:
             return
         if self.role != Role.LEADER:
-            self._start_election()
+            if self.prevote:
+                self._start_prevote()
+            else:
+                self._start_election()
         self._reset_election_timer()
+
+    def _start_prevote(self) -> None:
+        """Non-binding probe at term+1: no term bump, no voted_for, no
+        persistence.  A quorum of grants (self included) launches the
+        real election; hearing a leader mid-round aborts it."""
+        term = self.current_term
+        started = self.sched.now
+        granted = [1]
+        if self._quorum(granted[0]):
+            self._start_election()
+            return
+        args = RequestVoteArgs(
+            term=term + 1,
+            candidate_id=self.me,
+            last_log_index=self.log.last_index,
+            last_log_term=self.log.last_term,
+            pre=True,
+        )
+        for p in range(len(self.peers)):
+            if p == self.me:
+                continue
+            fut = self.peers[p].call("Raft.request_vote", args)
+            fut.add_done_callback(
+                lambda f, _t=term, _s=started, _g=granted: (
+                    self._on_prevote_reply(_t, _s, _g, f.value)
+                )
+            )
+
+    def _on_prevote_reply(
+        self,
+        term: int,
+        started: float,
+        granted: list,
+        reply: Optional[RequestVoteReply],
+    ) -> None:
+        if self._killed or reply is None:
+            return
+        if reply.term > self.current_term:
+            self._step_down(reply.term)
+            return
+        # Round still current?  Same term, still not leader, and no
+        # leader heard since the round began (an accepted append aborts
+        # the campaign, as etcd does on MsgApp/MsgHeartbeat).
+        if (
+            self.role == Role.LEADER
+            or self.current_term != term
+            or self._last_heard >= started
+        ):
+            return
+        if reply.vote_granted:
+            granted[0] += 1
+            if self._quorum(granted[0]):
+                self._start_election()
 
     def _start_heartbeats(self) -> None:
         if self._heartbeat_timer:
@@ -296,7 +358,19 @@ class RaftNode:
             self._persist()
 
     def request_vote(self, args: RequestVoteArgs) -> RequestVoteReply:
-        """RPC handler (reference: raft/raft_election.go:54-77)."""
+        """RPC handler (reference: raft/raft_election.go:54-77).  A
+        ``pre`` probe is non-binding: grant iff the proposed term would
+        win, the log is up to date, and this voter is out of lease —
+        never while leading, never after hearing a leader within the
+        minimum election timeout."""
+        if args.pre:
+            grant = (
+                self.role != Role.LEADER
+                and args.term > self.current_term
+                and (self.sched.now - self._last_heard) >= ELECTION_TIMEOUT[0]
+                and self.log.up_to_date(args.last_log_index, args.last_log_term)
+            )
+            return RequestVoteReply(term=self.current_term, vote_granted=grant)
         if args.term > self.current_term:
             self._step_down(args.term)
         if args.term < self.current_term:
@@ -308,6 +382,7 @@ class RaftNode:
             self.voted_for = args.candidate_id
             self._persist()
             self._reset_election_timer()
+            self._last_heard = self.sched.now
         return RequestVoteReply(term=self.current_term, vote_granted=grant)
 
     # ------------------------------------------------------------------
@@ -402,6 +477,7 @@ class RaftNode:
             return AppendEntriesReply(term=self.current_term, success=False)
         self._step_down(args.term)
         self._reset_election_timer()
+        self._last_heard = self.sched.now  # lease: a live leader spoke
 
         if args.prev_log_index < self.log.base:
             # Our snapshot already covers prev; tell the leader where we
@@ -492,6 +568,7 @@ class RaftNode:
             return InstallSnapshotReply(term=self.current_term)
         self._step_down(args.term)
         self._reset_election_timer()
+        self._last_heard = self.sched.now  # lease: a live leader spoke
         if args.last_included_index <= self.commit_index:
             # Already have everything the snapshot covers.
             return InstallSnapshotReply(term=self.current_term)

@@ -1,0 +1,80 @@
+"""PreVote on the sim backend (RaftNode(prevote=True)) — feature
+parity with the engine's EngineConfig.prevote: non-binding prevote
+rounds with leader-lease refusal, so partitioned or heartbeat-starved
+replicas cannot depose a healthy leader by term inflation."""
+
+from multiraft_tpu.harness.raft_harness import RaftHarness
+from multiraft_tpu.raft.node import Role
+
+
+def test_prevote_elects_and_agrees():
+    h = RaftHarness(3, seed=60, prevote=True)
+    try:
+        leader = h.check_one_leader()
+        for i in range(1, 6):
+            idx = h.one(f"op{i}", expected_servers=3, retry=False)
+            assert idx == i
+        assert h.check_one_leader() == leader  # stable throughout
+    finally:
+        h.cleanup()
+
+
+def test_prevote_partitioned_follower_never_inflates_term():
+    """The marquee property, sim form: isolate a follower for many
+    election timeouts; its term must stay put, and healing must not
+    depose or re-elect."""
+    h = RaftHarness(3, seed=61, prevote=True)
+    try:
+        leader = h.check_one_leader()
+        term0 = h.check_terms()
+        victim = (leader + 1) % 3
+        h.disconnect(victim)
+        # ~20 election timeouts under continued commits.
+        for i in range(10):
+            h.one(f"mid{i}", expected_servers=2, retry=False)
+            h.sched.run_for(0.6)
+        assert h.rafts[victim].current_term == term0, (
+            "isolated follower inflated its term despite prevote"
+        )
+        h.connect(victim)
+        h.sched.run_for(2.0)
+        assert h.check_one_leader() == leader
+        assert h.check_terms() == term0, "heal caused a re-election"
+        h.one("after", expected_servers=3, retry=False)
+    finally:
+        h.cleanup()
+
+
+def test_prevote_leader_death_recovers():
+    h = RaftHarness(3, seed=62, prevote=True)
+    try:
+        leader = h.check_one_leader()
+        h.disconnect(leader)
+        new_leader = h.check_one_leader()
+        assert new_leader != leader
+        h.one("survive", expected_servers=2, retry=False)
+        h.connect(leader)
+        h.sched.run_for(2.0)
+        # The old leader must actually step down: it adopts the new
+        # leader's (higher) term and there is exactly one leader.
+        assert (
+            h.rafts[leader].current_term
+            == h.rafts[new_leader].current_term
+        ), "old leader never adopted the newer term"
+        assert h.check_one_leader() == new_leader
+        assert h.rafts[leader].role != Role.LEADER
+        h.one("post", expected_servers=3, retry=True)
+    finally:
+        h.cleanup()
+
+
+def test_prevote_unreliable_still_live():
+    """Message loss must not wedge prevote rounds (grants are
+    re-probed every timeout)."""
+    h = RaftHarness(5, unreliable=True, seed=63, prevote=True)
+    try:
+        h.check_one_leader()
+        for i in range(5):
+            h.one(f"u{i}", expected_servers=3, retry=True)
+    finally:
+        h.cleanup()
