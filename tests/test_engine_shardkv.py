@@ -386,3 +386,33 @@ def test_fast_reads_in_churn_history_linearizable():
         assert res is not CheckResult.ILLEGAL, (
             f"shard {shard}: fast reads broke linearizability"
         )
+
+
+def test_migration_under_reordering_and_loss():
+    """Config churn + shard pulls while the transport reorders half the
+    messages and drops 10%: migration must still complete exactly-once
+    and serve everything afterward."""
+    skv = make(G=3, seed=31)
+    skv.driver.set_reorder(0.5, 2, 8)
+    skv.driver.drop_prob = 0.1
+    skv.admin_sync("join", [1])
+    kmap = keys_for_all_shards()
+    clerk = BatchedShardClerk(skv, client_id=1)
+    # Appends, not puts: a dedup failure under drops/reorder (a retried
+    # command applied twice) shows up as a doubled suffix.
+    for shard, k in kmap.items():
+        clerk.put(k, f"r{shard}")
+        clerk.append(k, "a")
+    skv.admin_sync("join", [2])
+    skv.admin_sync("leave", [1])
+    for shard, k in kmap.items():
+        clerk.append(k, "b")  # mid/post-migration appends, still faulted
+    skv.driver.set_reorder(0.0)
+    skv.driver.drop_prob = 0.0
+    settle(skv)
+    cfg = skv.query_latest()
+    assert all(g == 2 for g in cfg.shards)
+    for shard, k in kmap.items():
+        expect = f"r{shard}ab"
+        assert clerk.get(k) == expect, f"key {k}: {clerk.get(k)!r} != {expect!r}"
+        assert skv.get_fast(k).value == expect
