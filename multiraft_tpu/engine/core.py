@@ -820,6 +820,11 @@ def tick_impl(
         "max_term": jnp.max(state.term),
         "accepted": accepted_per_group,
         "start_index": start_index,
+        # Term the accepted entries carry (the acceptor is the unique
+        # max-term alive leader, so the sum collapses the P axis) —
+        # lets the host bind payloads to (index, term), which is
+        # unambiguous where index alone is not (conformance rig).
+        "accept_term": jnp.sum(jnp.where(accept > 0, state.term, 0), axis=1),
         "commit_index": jnp.max(state.commit, axis=1),  # i32[G]
     }
     return state, out, metrics

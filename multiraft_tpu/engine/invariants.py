@@ -83,8 +83,12 @@ class InvariantMonitor:
 
     # -- the four checks ---------------------------------------------------
 
-    def observe(self) -> None:
-        st = self.d.np_state()
+    def observe(self, st=None) -> None:
+        """``st``: optionally pass a pre-fetched :meth:`EngineDriver.
+        np_state` dict to avoid a second device→host sync when the
+        caller already read the state this tick."""
+        if st is None:
+            st = self.d.np_state()
         cfg = self.d.cfg
         term = st["term"].astype(np.int64)
         commit = st["commit"].astype(np.int64)

@@ -7,7 +7,10 @@ virtual-time scheduler: timers are scheduled events, RPC replies are
 future callbacks, and apply is a drained queue — zero locks, fully
 deterministic, and structurally identical to one lane of the batched
 TPU engine's tick function (see ``multiraft_tpu.engine``), which is
-golden-tested against this class.
+golden-tested against this class by the differential conformance rig
+(``multiraft_tpu/conformance.py`` + ``tests/test_conformance.py``:
+identical seeded fault scenarios on both backends must commit
+identical command streams).
 
 Protocol semantics follow the reference:
 

@@ -1,0 +1,54 @@
+"""Sim↔engine differential (golden) conformance suite.
+
+SURVEY §7.2 step 5: the event-driven RaftNode simulator is the
+correctness oracle for the batched tensor engine.  Every test here
+drives BOTH backends through one seeded scenario script (crashes,
+partitions, message loss, reordering, snapshot pressure — see
+multiraft_tpu/conformance.py) and asserts the committed command
+streams are identical, with continuous safety checking on each side
+(sim: harness invariant appliers, reference: raft/config.go:144-186;
+engine: per-tick InvariantMonitor).
+"""
+
+import pytest
+
+from multiraft_tpu.conformance import (
+    SCENARIOS,
+    ConformanceError,
+    Scenario,
+    random_scenario,
+    run_both,
+    run_engine,
+    run_sim,
+)
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_conformance(name):
+    run_both(SCENARIOS[name], seed=7)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_fuzz_conformance(seed):
+    """Fuzz mode: a seeded random fault script runs on both backends;
+    the committed command streams must still match exactly."""
+    run_both(random_scenario(seed), seed=seed)
+
+
+def test_streams_are_cross_checked_not_vacuous():
+    """The rig really compares streams: a scenario demanding more
+    commands than the pump can commit fails loudly, on both backends."""
+    sc = Scenario(name="impossible", n_cmds=10_000, heal_at_s=0.1)
+    # Shrink the drain window via a tiny deadline by using the public
+    # runners directly and expecting the timeout diagnosis.
+    import multiraft_tpu.conformance as conf
+
+    old = conf.DRAIN_S
+    conf.DRAIN_S = 0.5
+    try:
+        with pytest.raises(ConformanceError, match="sim"):
+            run_sim(sc, seed=1)
+        with pytest.raises(ConformanceError, match="engine"):
+            run_engine(sc, seed=1)
+    finally:
+        conf.DRAIN_S = old
