@@ -1,0 +1,53 @@
+"""Debugging a linearizability failure with partial linearizations.
+
+When a history fails the porcupine check, the interesting question is
+WHERE linearization got stuck.  ``check_operations_verbose`` captures,
+for every operation, the longest linearizable prefix that includes it
+(reference: porcupine/checker.go:219-253), and the visualizer renders
+the largest such prefix as numbered linearization points — operations
+it could not absorb show up red.  Click any bar in the HTML to switch
+to the longest partial containing that operation.
+
+(Reference analog: porcupine/visualization.go:89-109 +
+kvraft/test_test.go:365-381, which dumps the viz on check failure.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multiraft_tpu.porcupine.checker import check_operations_verbose
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET, OP_PUT, KvInput, KvOutput, kv_model
+from multiraft_tpu.porcupine.model import Operation
+
+
+def main() -> None:
+    # A buggy replica served a stale read at t=[4,5]: the append at
+    # t=[2,3] had already returned, but the get doesn't see it.
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="x", value="a"), 0.0, KvOutput(), 1.0),
+        Operation(1, KvInput(op=OP_APPEND, key="x", value="b"), 2.0, KvOutput(), 3.0),
+        Operation(2, KvInput(op=OP_GET, key="x"), 4.0, KvOutput(value="a"), 5.0),
+        Operation(1, KvInput(op=OP_APPEND, key="x", value="c"), 6.0, KvOutput(), 7.0),
+        Operation(2, KvInput(op=OP_GET, key="x"), 8.0, KvOutput(value="abc"), 9.0),
+    ]
+    verdict, info = check_operations_verbose(kv_model, h)
+    print(f"verdict: {verdict.value}")
+    largest = info.largest(0)
+    print(f"longest partial linearization: {largest} "
+          f"({len(largest)}/{len(h)} ops)")
+    stuck = [i for i in range(len(h)) if all(i not in s for s in info.partials[0])]
+    print(f"never linearized: ops {stuck} — the stale read blocks there")
+
+    import tempfile
+
+    from multiraft_tpu.porcupine.visualization import visualize_info
+
+    out = os.path.join(tempfile.gettempdir(), "linearization_debug.html")
+    visualize_info(kv_model, info, out, verdict, title="stale read demo")
+    print(f"wrote {out} — open in a browser; red bar = the stuck read")
+
+
+if __name__ == "__main__":
+    main()

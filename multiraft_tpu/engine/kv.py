@@ -176,12 +176,11 @@ class BatchedKV(FrontierService):
     def check_sampled_linearizability(self, timeout: float = 5.0):
         """Porcupine over the recorded groups' histories — the sampled-
         shard verification of the north star."""
-        from ..porcupine.checker import CheckResult, check_operations
         from ..porcupine.kv import kv_model
+        from ..porcupine.visualization import assert_linearizable
 
         for g, hist in self.histories.items():
-            res = check_operations(kv_model, hist, timeout=timeout)
-            assert res is not CheckResult.ILLEGAL, (
-                f"group {g}: engine history not linearizable"
+            assert_linearizable(
+                kv_model, hist, timeout=timeout, name=f"engine-group-{g}"
             )
         return True

@@ -5,24 +5,74 @@ The algorithm: order call/return events by time into a doubly-linked
 list; DFS over "linearize next" choices among currently-pending calls,
 memoizing (linearized-set, automaton-state) pairs so revisited frontiers
 prune (reference: porcupine/checker.go:140-152 cache,
-:159-177 lift/unlift).  Per-partition histories are checked
-independently with a shared kill switch
-(reference: porcupine/checker.go:274-353 checkParallel).
+:159-177 lift/unlift).
+
+Beyond the verdict, the checker can capture **partial linearizations**
+(reference: porcupine/checker.go:219-253): for every operation, the
+longest linearizable prefix that includes it, recorded at each
+backtrack.  On an ILLEGAL or UNKNOWN verdict these show exactly where
+linearization got stuck — the visualizer renders them
+(:mod:`.visualization`).
+
+Per-partition histories are checked **in parallel** across a process
+pool with a shared kill switch (reference: porcupine/checker.go:274-353
+checkParallel): the first ILLEGAL partition terminates the remaining
+workers when no info is requested, and a wall-clock timeout downgrades
+the verdict to UNKNOWN.
 
 The linearized set is a Python int bitmask (arbitrary width — the
 bitset.go equivalent); a C++ fast path for the DFS lives in
 ``multiraft_tpu/porcupine/native`` with this implementation as fallback
-and oracle.
+and oracle (verbose mode always uses the Python DFS — the native path
+returns verdicts only).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import multiprocessing
+import os
 import time as _time
 from typing import Any, List, Optional, Tuple
 
 from .model import CheckResult, Model, Operation
 
-__all__ = ["check_operations", "check_history"]
+__all__ = [
+    "check_operations",
+    "check_operations_verbose",
+    "check_history",
+    "LinearizationInfo",
+]
+
+# Partition-count threshold below which the serial path is used (fork +
+# IPC overhead dominates tiny checks).
+_PARALLEL_MIN_PARTITIONS = 8
+
+
+@dataclasses.dataclass
+class LinearizationInfo:
+    """Partial-linearization evidence (reference:
+    porcupine/checker.go:24-27 linearizationInfo).
+
+    ``partitions[i]`` is the i-th sub-history; ``partials[i]`` is a set
+    of distinct partial linearizations for it, each a list of operation
+    indices (into ``partitions[i]``) in linearized order.  For an OK
+    partition there is exactly one entry: the full linearization.  For
+    an ILLEGAL/UNKNOWN partition, each operation's longest prefix that
+    linearizes it is included — the visualization's raw material.
+    ``verdicts[i]`` is that partition's own verdict, or None if the
+    kill switch dropped it before it ran (the visualizer renders those
+    neutrally rather than as failures)."""
+
+    partitions: List[List[Operation]]
+    partials: List[List[List[int]]]
+    verdicts: List[Optional[CheckResult]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def largest(self, i: int) -> List[int]:
+        """The longest partial linearization of partition ``i``."""
+        return max(self.partials[i], key=len, default=[])
 
 
 class _Entry:
@@ -94,23 +144,35 @@ def _check_single(
     model: Model,
     history: List[Operation],
     deadline: Optional[float],
-) -> CheckResult:
-    """DFS over one partition (reference: porcupine/checker.go:179-253)."""
+    compute_partial: bool = False,
+) -> Tuple[CheckResult, List[List[int]]]:
+    """DFS over one partition (reference: porcupine/checker.go:179-253).
+
+    Returns ``(verdict, partials)``; ``partials`` is non-empty only
+    when ``compute_partial`` — the distinct longest linearizable
+    prefixes covering each operation (recorded at every backtrack), or
+    the single full linearization on OK."""
     if not history:
-        return CheckResult.OK
+        return CheckResult.OK, ([[]] if compute_partial else [])
     head = _make_entries(history)
     n = len(history)
     linearized = 0
     cache: set = set()
     calls: List[Tuple[_Entry, Any]] = []
+    # Longest linearizable prefix that includes each op, as a shared
+    # list (identity-deduplicated at the end) — the lazy-seq trick of
+    # the reference (checker.go:219-234).
+    longest: List[Optional[List[int]]] = [None] * n
     state = model.init()
     entry = head.next
     steps = 0
+    verdict: Optional[CheckResult] = None
     while head.next is not None:
         steps += 1
         if deadline is not None and steps % 4096 == 0:
             if _time.monotonic() > deadline:
-                return CheckResult.UNKNOWN
+                verdict = CheckResult.UNKNOWN
+                break
         if not entry.is_return:
             ok, new_state = model.step(state, entry.inp, entry.out)
             advanced = False
@@ -131,18 +193,167 @@ def _check_single(
             # A return with no linearizable choice above it: backtrack
             # (reference: porcupine/checker.go:231-246).
             if not calls:
-                return CheckResult.ILLEGAL
+                verdict = CheckResult.ILLEGAL
+                break
+            if compute_partial:
+                seq: Optional[List[int]] = None
+                for e, _ in calls:
+                    cur = longest[e.op_id]
+                    if cur is None or len(calls) > len(cur):
+                        if seq is None:
+                            seq = [c.op_id for c, _ in calls]
+                        longest[e.op_id] = seq
             top, state = calls.pop()
             linearized &= ~(1 << top.op_id)
             _unlift(top)
             entry = top.next
-    return CheckResult.OK
+    if verdict is None:
+        verdict = CheckResult.OK
+    partials: List[List[int]] = []
+    if compute_partial:
+        if verdict is CheckResult.OK:
+            partials = [[c.op_id for c, _ in calls]]
+        else:
+            uniq: dict[int, List[int]] = {}
+            for seq in longest:
+                if seq is not None:
+                    uniq[id(seq)] = seq
+            partials = list(uniq.values())
+    return verdict, partials
+
+
+# -- parallel partition checking (reference: checker.go:274-353) -----------
+
+
+def _worker(
+    args: Tuple[int, Model, List[Operation], Optional[float], bool],
+) -> Tuple[int, CheckResult, List[List[int]]]:
+    idx, model, part, remaining, compute_partial = args
+    deadline = _time.monotonic() + remaining if remaining is not None else None
+    res = None
+    if model.native_check is not None and not compute_partial:
+        res = model.native_check(part, deadline)
+    if res is None:
+        res, partials = _check_single(model, part, deadline, compute_partial)
+    else:
+        partials = []
+    return idx, res, partials
+
+
+def _fork_safe() -> bool:
+    """Whether auto-parallel may use a fork pool: fork must exist on
+    this platform, and the process must not carry the multithreaded
+    JAX/XLA runtime (forking a threaded runtime can deadlock the
+    children; JAX documents fork as unsupported).  Explicit
+    ``parallel=True`` overrides — the caller owns that risk."""
+    import sys
+
+    try:
+        multiprocessing.get_context("fork")
+    except ValueError:  # pragma: no cover - non-POSIX
+        return False
+    return "jax" not in sys.modules
+
+
+def _check_partitions(
+    model: Model,
+    parts: List[List[Operation]],
+    deadline: Optional[float],
+    compute_partial: bool,
+    parallel: Optional[bool],
+) -> Tuple[CheckResult, List[List[List[int]]], List[Optional[CheckResult]]]:
+    """Fan the per-partition DFS across a process pool (the Python
+    analog of checkParallel's goroutines + atomic kill,
+    reference: porcupine/checker.go:274-353).  Without
+    ``compute_partial``, the first ILLEGAL terminates the pool — the
+    kill switch.  With it, all partitions run to completion so every
+    partial is collected (the reference waits likewise).  Also returns
+    each partition's own verdict (None where the kill switch dropped
+    it before it ran)."""
+    if parallel is None:
+        parallel = (
+            len(parts) >= _PARALLEL_MIN_PARTITIONS
+            and (os.cpu_count() or 1) > 1
+            and _fork_safe()
+        )
+    all_partials: List[List[List[int]]] = [[] for _ in parts]
+    verdicts: List[Optional[CheckResult]] = [None] * len(parts)
+
+    def remaining() -> Optional[float]:
+        return None if deadline is None else deadline - _time.monotonic()
+
+    if not parallel:
+        illegal = False
+        unknown = False
+        for i, part in enumerate(parts):
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                unknown = True
+                break
+            res = None
+            if model.native_check is not None and not compute_partial:
+                res = model.native_check(part, deadline)
+            if res is None:
+                res, partials = _check_single(
+                    model, part, deadline, compute_partial
+                )
+                all_partials[i] = partials
+            verdicts[i] = res
+            if res is CheckResult.ILLEGAL:
+                illegal = True
+                if not compute_partial:
+                    break  # kill switch: evidence not requested
+            elif res is CheckResult.UNKNOWN:
+                unknown = True
+        if illegal:
+            return CheckResult.ILLEGAL, all_partials, verdicts
+        return (
+            CheckResult.UNKNOWN if unknown else CheckResult.OK
+        ), all_partials, verdicts
+
+    ctx = multiprocessing.get_context("fork")
+    nproc = min(len(parts), os.cpu_count() or 2)
+    illegal = False
+    unknown = False
+    with ctx.Pool(processes=nproc) as pool:
+        jobs = [
+            (i, model, part, remaining(), compute_partial)
+            for i, part in enumerate(parts)
+        ]
+        it = pool.imap_unordered(_worker, jobs)
+        done = 0
+        while done < len(parts):
+            rem = remaining()
+            try:
+                idx, res, partials = it.next(timeout=rem)
+            except multiprocessing.TimeoutError:
+                unknown = True
+                pool.terminate()  # shared kill switch: drop the rest
+                break
+            except StopIteration:  # pragma: no cover - defensive
+                break
+            done += 1
+            all_partials[idx] = partials
+            verdicts[idx] = res
+            if res is CheckResult.ILLEGAL:
+                illegal = True
+                if not compute_partial:
+                    pool.terminate()  # kill switch on first failure
+                    break
+            elif res is CheckResult.UNKNOWN:
+                unknown = True
+    if illegal:
+        return CheckResult.ILLEGAL, all_partials, verdicts
+    return (
+        CheckResult.UNKNOWN if unknown else CheckResult.OK
+    ), all_partials, verdicts
 
 
 def check_operations(
     model: Model,
     history: List[Operation],
     timeout: Optional[float] = None,
+    parallel: Optional[bool] = None,
 ) -> CheckResult:
     """Check a full history, partitioned per the model
     (reference: porcupine/porcupine.go CheckOperationsTimeout).
@@ -150,23 +361,34 @@ def check_operations(
     ``timeout`` is wall-clock seconds across all partitions; on expiry
     the result is UNKNOWN (the reference's convention, treated by the
     test suite as "probably fine, too expensive to prove",
-    kvraft/test_test.go:379-381)."""
+    kvraft/test_test.go:379-381).  ``parallel`` forces the process-pool
+    path on/off (default: auto — pools kick in at
+    ≥8 partitions on multi-core hosts)."""
     deadline = _time.monotonic() + timeout if timeout is not None else None
-    unknown = False
-    for part in model.partitions(history):
-        if deadline is not None and _time.monotonic() > deadline:
-            unknown = True
-            break
-        res = None
-        if model.native_check is not None:
-            res = model.native_check(part, deadline)
-        if res is None:
-            res = _check_single(model, part, deadline)
-        if res is CheckResult.ILLEGAL:
-            return CheckResult.ILLEGAL
-        if res is CheckResult.UNKNOWN:
-            unknown = True
-    return CheckResult.UNKNOWN if unknown else CheckResult.OK
+    verdict, _, _ = _check_partitions(
+        model, model.partitions(history), deadline, False, parallel
+    )
+    return verdict
+
+
+def check_operations_verbose(
+    model: Model,
+    history: List[Operation],
+    timeout: Optional[float] = None,
+    parallel: Optional[bool] = None,
+) -> Tuple[CheckResult, LinearizationInfo]:
+    """Check and return partial-linearization evidence
+    (reference: porcupine/porcupine.go:19-27 CheckOperationsVerbose).
+    Pass the info to :func:`multiraft_tpu.porcupine.visualize` to
+    render where linearization got stuck."""
+    deadline = _time.monotonic() + timeout if timeout is not None else None
+    parts = model.partitions(history)
+    verdict, partials, verdicts = _check_partitions(
+        model, parts, deadline, True, parallel
+    )
+    return verdict, LinearizationInfo(
+        partitions=parts, partials=partials, verdicts=verdicts
+    )
 
 
 def check_history(model: Model, history: List[Operation]) -> bool:

@@ -7,9 +7,18 @@ port of its template).
 each client is a row, each operation a bar spanning [call, ret] on the
 virtual-time axis, grouped per partition, with the operation description
 (from ``model.describe_operation``) on hover and a pass/fail banner from
-the checker verdict.  Used by the kvraft/shardkv harnesses to dump
-failing histories (reference: kvraft/test_test.go:365-381 dumps
-visualization on porcupine failure).
+the checker verdict.
+
+**Partial linearizations are rendered** (the reference's headline
+feature, visualization.go:89-109 + checker.go:219-253): the longest
+partial linearization of each partition is drawn as numbered
+linearization points connected by a path; operations it could not
+absorb are flagged red — on a failed or timed-out check this shows
+exactly where linearization got stuck.  Clicking an operation switches
+the path to the longest partial that includes *that* operation;
+clicking the background restores the largest.  Used by the
+kvraft/shardkv harnesses to dump failing histories (reference:
+kvraft/test_test.go:365-381 dumps visualization on porcupine failure).
 """
 
 from __future__ import annotations
@@ -18,10 +27,14 @@ import html
 import json
 from typing import List, Optional
 
-from .checker import CheckResult, check_operations
+from .checker import (
+    CheckResult,
+    LinearizationInfo,
+    check_operations_verbose,
+)
 from .model import Model, Operation
 
-__all__ = ["visualize"]
+__all__ = ["visualize", "visualize_info", "assert_linearizable"]
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>history: {title}</title>
@@ -31,17 +44,31 @@ _PAGE = """<!DOCTYPE html>
            margin-bottom: 14px; color: #fff; font-weight: 600; }}
  .ok {{ background: #2e7d32; }} .illegal {{ background: #c62828; }}
  .unknown {{ background: #ef6c00; }}
+ .hint {{ color: #666; margin: 0 0 10px; }}
  .partition {{ margin: 18px 0 6px; font-weight: 600; color: #333; }}
  svg {{ background: #fff; border: 1px solid #ddd; border-radius: 4px; }}
  .op {{ cursor: pointer; }}
  .op rect {{ fill: #90caf9; stroke: #1565c0; }}
+ .op.lin rect {{ fill: #a5d6a7; stroke: #2e7d32; }}
+ .op.stuck rect {{ fill: #ef9a9a; stroke: #c62828; }}
+ .op.sel rect {{ stroke-width: 2.5; }}
  .op:hover rect {{ fill: #ffe082; }}
  .op text {{ font-size: 10px; pointer-events: none; }}
+ .linpath {{ fill: none; stroke: #37474f; stroke-width: 1.2;
+            stroke-dasharray: 4 3; pointer-events: none; }}
+ .linpt circle {{ fill: #263238; }}
+ .linpt text {{ fill: #fff; font-size: 8px; text-anchor: middle;
+               pointer-events: none; }}
+ .linpt {{ pointer-events: none; }}
  #tip {{ position: fixed; background: #212121; color: #fff; padding: 4px 8px;
         border-radius: 4px; font-size: 12px; display: none; z-index: 10; }}
 </style></head><body>
 <h2>Operation history</h2>
 <div class="banner {verdict_class}">{verdict}</div>
+<p class="hint">Numbered dots mark linearization points of the longest
+partial linearization; red bars never linearized within it.  Click a
+bar to show the longest partial that includes that operation; click
+the background to restore the largest.</p>
 <div id="tip"></div>
 <div id="content"></div>
 <script>
@@ -49,15 +76,26 @@ const DATA = {data};
 const tip = document.getElementById('tip');
 const content = document.getElementById('content');
 for (const part of DATA.partitions) {{
+  // A partition the kill switch dropped (or that timed out before
+  // recording any evidence) renders neutrally: red means "proven
+  // stuck", never "not checked".
+  const neutral = part.status === 'unchecked' ||
+    (part.status === 'unknown' && part.partials.length === 0);
   const div = document.createElement('div');
   div.className = 'partition';
-  div.textContent = 'partition: ' + part.name + ' (' + part.ops.length + ' ops)';
+  div.textContent = 'partition: ' + part.name + ' — ' + part.status +
+    ' (' + part.ops.length + ' ops' + (neutral ? ', no evidence recorded'
+    : ', ' + part.partials.length + ' partial linearization(s), largest ' +
+    (part.largest >= 0 ? part.partials[part.largest].length : 0) + '/' +
+    part.ops.length) + ')';
   content.appendChild(div);
   const clients = [...new Set(part.ops.map(o => o.client))].sort((a,b)=>a-b);
   const rowH = 26, pad = 44, width = 1100;
   const t0 = Math.min(...part.ops.map(o => o.call));
   const t1 = Math.max(...part.ops.map(o => o.ret));
   const scale = (width - pad - 10) / Math.max(t1 - t0, 1e-9);
+  const X = t => pad + (t - t0) * scale;
+  const rowY = i => clients.indexOf(part.ops[i].client) * rowH;
   const svgNS = 'http://www.w3.org/2000/svg';
   const svg = document.createElementNS(svgNS, 'svg');
   svg.setAttribute('width', width);
@@ -68,12 +106,13 @@ for (const part of DATA.partitions) {{
     label.setAttribute('x', 2); label.setAttribute('y', row * rowH + 17);
     label.setAttribute('font-size', '11'); svg.appendChild(label);
   }});
-  for (const op of part.ops) {{
+  const opEls = [];
+  part.ops.forEach((op, i) => {{
     const row = clients.indexOf(op.client);
     const g = document.createElementNS(svgNS, 'g');
     g.setAttribute('class', 'op');
     const r = document.createElementNS(svgNS, 'rect');
-    const x = pad + (op.call - t0) * scale;
+    const x = X(op.call);
     const w = Math.max((op.ret - op.call) * scale, 3);
     r.setAttribute('x', x); r.setAttribute('y', row * rowH + 4);
     r.setAttribute('width', w); r.setAttribute('height', rowH - 10);
@@ -87,12 +126,66 @@ for (const part of DATA.partitions) {{
       tip.style.display = 'block';
       tip.style.left = (ev.clientX + 12) + 'px';
       tip.style.top = (ev.clientY + 12) + 'px';
+      const where = g.dataset.linorder !== undefined
+        ? '  linearized #' + g.dataset.linorder : '  (not linearized)';
       tip.textContent = op.desc + '  [' + op.call.toFixed(6) + ', '
-                        + op.ret.toFixed(6) + ']';
+                        + op.ret.toFixed(6) + ']' + where;
     }});
     g.addEventListener('mouseleave', () => tip.style.display = 'none');
+    g.addEventListener('click', ev => {{
+      ev.stopPropagation();
+      if (part.op_partial[i] >= 0) showPartial(part.op_partial[i], i);
+    }});
     svg.appendChild(g);
+    opEls.push(g);
+  }});
+  const overlay = document.createElementNS(svgNS, 'g');
+  svg.appendChild(overlay);
+  function showPartial(pi, selected) {{
+    overlay.innerHTML = '';
+    const seq = pi >= 0 ? part.partials[pi] : [];
+    const inSeq = new Set(seq);
+    opEls.forEach((g, i) => {{
+      let cls = 'op';
+      if (!neutral) cls += inSeq.has(i) ? ' lin' : ' stuck';
+      if (i === selected) cls += ' sel';
+      g.setAttribute('class', cls);
+      delete g.dataset.linorder;
+    }});
+    // Linearization points: each inside its op's interval, strictly
+    // after the previous point.
+    let prevX = -1e9;
+    const pts = [];
+    seq.forEach((i, k) => {{
+      const op = part.ops[i];
+      let x = Math.max(X(op.call) + 4, prevX + 9);
+      x = Math.min(x, X(op.ret) - 2);
+      prevX = x;
+      pts.push([x, rowY(i) + rowH / 2 - 1]);
+      opEls[i].dataset.linorder = k + 1;
+    }});
+    if (pts.length > 1) {{
+      const pl = document.createElementNS(svgNS, 'polyline');
+      pl.setAttribute('class', 'linpath');
+      pl.setAttribute('points', pts.map(p => p.join(',')).join(' '));
+      overlay.appendChild(pl);
+    }}
+    pts.forEach((p, k) => {{
+      const g = document.createElementNS(svgNS, 'g');
+      g.setAttribute('class', 'linpt');
+      const c = document.createElementNS(svgNS, 'circle');
+      c.setAttribute('cx', p[0]); c.setAttribute('cy', p[1]);
+      c.setAttribute('r', 6);
+      g.appendChild(c);
+      const t = document.createElementNS(svgNS, 'text');
+      t.textContent = k + 1;
+      t.setAttribute('x', p[0]); t.setAttribute('y', p[1] + 1);
+      g.appendChild(t);
+      overlay.appendChild(g);
+    }});
   }}
+  showPartial(part.largest, -1);
+  document.body.addEventListener('click', () => showPartial(part.largest, -1));
   content.appendChild(svg);
 }}
 </script></body></html>
@@ -105,19 +198,32 @@ def _describe(model: Model, op: Operation) -> str:
     return f"{op.input!r} -> {op.output!r}"
 
 
-def visualize(
+def visualize_info(
     model: Model,
-    history: List[Operation],
+    info: LinearizationInfo,
     path: str,
-    verdict: Optional[CheckResult] = None,
+    verdict: CheckResult,
     title: str = "history",
 ) -> str:
-    """Write a self-contained HTML timeline; returns the path."""
-    if verdict is None:
-        verdict = check_operations(model, history, timeout=1.0)
+    """Render a checked history from its partial-linearization evidence
+    (reference: porcupine/visualization.go:102-109 VisualizePath).
+    Returns the path."""
     partitions = []
-    for i, part in enumerate(model.partitions(history)):
+    for i, part in enumerate(info.partitions):
         name = getattr(part[0].input, "key", str(i)) if part else str(i)
+        partials = info.partials[i]
+        largest = -1
+        if partials:
+            largest = max(range(len(partials)), key=lambda j: len(partials[j]))
+        # Longest partial containing each op (for click-to-explore).
+        op_partial = [-1] * len(part)
+        for j, seq in enumerate(partials):
+            for op_id in seq:
+                cur = op_partial[op_id]
+                if cur < 0 or len(partials[j]) > len(partials[cur]):
+                    op_partial[op_id] = j
+        pv = info.verdicts[i] if i < len(info.verdicts) else None
+        status = "unchecked" if pv is None else pv.value
         partitions.append(
             {
                 "name": str(name),
@@ -130,6 +236,10 @@ def visualize(
                     }
                     for op in part
                 ],
+                "partials": partials,
+                "largest": largest,
+                "op_partial": op_partial,
+                "status": status,
             }
         )
     verdict_class = {
@@ -141,8 +251,59 @@ def visualize(
         title=html.escape(title),
         verdict=f"linearizability: {verdict.value}",
         verdict_class=verdict_class,
-        data=json.dumps({"partitions": partitions}),
+        data=json.dumps(
+            {"partitions": partitions},
+        ),
     )
     with open(path, "w") as f:
         f.write(page)
     return path
+
+
+def visualize(
+    model: Model,
+    history: List[Operation],
+    path: str,
+    verdict: Optional[CheckResult] = None,
+    title: str = "history",
+    timeout: Optional[float] = 5.0,
+) -> str:
+    """Check ``history`` (verbose: partial linearizations captured) and
+    write a self-contained HTML timeline; returns the path.  A
+    pre-computed ``verdict`` only overrides the banner — the evidence
+    is always recomputed verbosely."""
+    v, info = check_operations_verbose(model, history, timeout=timeout)
+    return visualize_info(model, info, path, verdict or v, title=title)
+
+
+def assert_linearizable(
+    model: Model,
+    history: List[Operation],
+    timeout: Optional[float] = None,
+    name: str = "history",
+) -> CheckResult:
+    """Assert a history is linearizable; on failure, dump the partial-
+    linearization viz and point at it from the assertion message — the
+    reference harnesses' behavior (kvraft/test_test.go:365-381).
+    Returns the verdict (UNKNOWN passes, as in the reference)."""
+    import os
+    import re
+    import tempfile
+
+    from .checker import check_operations
+
+    res = check_operations(model, history, timeout=timeout)
+    if res is CheckResult.ILLEGAL:
+        safe = re.sub(r"[^\w.-]", "_", name)
+        path = os.path.join(
+            tempfile.gettempdir(), f"linearizability_{safe}.html"
+        )
+        try:
+            # Evidence pass: re-check verbosely (bounded) and render.
+            v, info = check_operations_verbose(model, history, timeout=30.0)
+            visualize_info(model, info, path, v, title=name)
+            where = f"; viz dumped to {path}"
+        except Exception as exc:  # pragma: no cover - viz must not mask
+            where = f"; viz dump failed: {exc!r}"
+        raise AssertionError(f"{name} is not linearizable{where}")
+    return res

@@ -150,3 +150,37 @@ def test_tracer_engine_tick_spans():
     assert [e["args"]["tick"] for e in ticks] == list(range(1, 21))
     counters = [e for e in d.tracer.events if e["ph"] == "C"]
     assert len(counters) == 20
+
+
+def test_visualizer_renders_partial_linearizations(tmp_path):
+    """A non-linearizable history's viz must carry the partial-
+    linearization evidence: partials data, linearization-point markers,
+    and the stuck-op styling (reference: porcupine/visualization.go
+    renders partial linearizations interactively)."""
+    from multiraft_tpu.porcupine.checker import check_operations_verbose
+    from multiraft_tpu.porcupine.kv import KvInput, KvOutput, OP_GET, OP_PUT
+    from multiraft_tpu.porcupine.model import Operation
+    from multiraft_tpu.porcupine.visualization import visualize_info
+    from multiraft_tpu.porcupine.checker import CheckResult
+
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="a", value="1"), 0, KvOutput(), 1),
+        Operation(1, KvInput(op=OP_GET, key="a"), 2, KvOutput(value=""), 3),
+        Operation(0, KvInput(op=OP_PUT, key="a", value="2"), 4, KvOutput(), 5),
+    ]
+    verdict, info = check_operations_verbose(kv_model, h)
+    assert verdict is CheckResult.ILLEGAL
+    path = str(tmp_path / "illegal.html")
+    visualize_info(kv_model, info, path, verdict)
+    text = open(path).read()
+    assert '"partials"' in text and '"op_partial"' in text
+    assert "linpt" in text and "stuck" in text
+    assert "linearizability: illegal" in text
+    # The largest partial excludes the stuck stale read (op 1).
+    import json as _json
+    import re
+
+    data = _json.loads(re.search(r"const DATA = (.*?);\n", text).group(1))
+    part = data["partitions"][0]
+    largest = part["partials"][part["largest"]]
+    assert 1 not in largest and len(largest) >= 1

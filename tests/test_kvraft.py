@@ -10,7 +10,7 @@ wall-clock; virtual time makes op counts the meaningful budget).
 
 
 from multiraft_tpu.harness.kv_harness import KVHarness
-from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+from multiraft_tpu.porcupine.visualization import assert_linearizable
 from multiraft_tpu.porcupine.kv import (
     OP_APPEND,
     OP_GET,
@@ -145,8 +145,7 @@ def generic_test(
             f"logs were not trimmed: {cfg.log_size()} > 8x{maxraftstate}"
         )
 
-    res = check_operations(kv_model, history, timeout=2.0)
-    assert res is not CheckResult.ILLEGAL, "history is not linearizable"
+    assert_linearizable(kv_model, history, timeout=2.0, name="kvraft")
     cfg.cleanup()
 
 

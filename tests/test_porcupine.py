@@ -135,3 +135,130 @@ def test_timeout_returns_unknown():
 
 def test_empty_history_ok():
     assert check_operations(kv_model, []) is CheckResult.OK
+
+
+# -- partial linearizations (reference: porcupine/checker.go:219-253) -------
+
+from multiraft_tpu.porcupine.checker import (  # noqa: E402
+    LinearizationInfo,
+    check_operations_verbose,
+)
+
+
+def test_verbose_ok_full_linearization():
+    """An OK partition yields exactly one partial: the full
+    linearization, in an order consistent with the model."""
+    h = [
+        put("a", "1", 0, 1, cid=0),
+        get("a", "1", 2, 3, cid=1),
+        app("a", "x", 4, 5, cid=0),
+        get("a", "1x", 6, 7, cid=1),
+    ]
+    verdict, info = check_operations_verbose(kv_model, h)
+    assert verdict is CheckResult.OK
+    assert len(info.partitions) == 1
+    (seq,) = info.partials[0]
+    assert sorted(seq) == [0, 1, 2, 3]
+    assert seq == [0, 1, 2, 3]  # sequential history: only one order
+
+
+def test_verbose_illegal_shows_where_stuck():
+    """The stale read can never linearize; every other op can.  The
+    longest partial must cover everything except the stuck read."""
+    h = [
+        put("a", "1", 0, 1, cid=0),
+        get("a", "", 2, 3, cid=1),  # stale: impossible
+        put("a", "2", 4, 5, cid=0),
+        get("a", "2", 6, 7, cid=1),
+    ]
+    verdict, info = check_operations_verbose(kv_model, h)
+    assert verdict is CheckResult.ILLEGAL
+    largest = info.largest(0)
+    assert 1 not in largest
+    assert 0 in largest
+    # The stuck op is absent from every partial that reaches past it.
+    assert all(1 not in seq or len(seq) < 2 for seq in info.partials[0])
+
+
+def test_verbose_partials_per_op_coverage():
+    """Each linearizable op appears in at least one partial even when
+    the overall verdict is ILLEGAL (evidence for the visualizer)."""
+    h = [
+        app("k", "x", 0, 1, cid=0),
+        get("k", "WRONG", 2, 3, cid=1),
+        app("k", "y", 4, 5, cid=0),
+    ]
+    verdict, info = check_operations_verbose(kv_model, h)
+    assert verdict is CheckResult.ILLEGAL
+    covered = set()
+    for seq in info.partials[0]:
+        covered.update(seq)
+    assert 0 in covered
+
+
+def test_parallel_matches_serial_on_many_partitions():
+    """100 per-key partitions checked through the process pool agree
+    with the serial path (reference: checker.go:274-353)."""
+    h = []
+    t = 0.0
+    for k in range(100):
+        key = f"k{k}"
+        h.append(put(key, "v", t, t + 1, cid=0))
+        h.append(get(key, "v", t + 2, t + 3, cid=1))
+        t += 4
+    assert check_operations(kv_model, h, parallel=True) is CheckResult.OK
+    assert check_operations(kv_model, h, parallel=False) is CheckResult.OK
+
+
+def test_parallel_kill_switch_on_illegal():
+    """One poisoned partition among many: the parallel check returns
+    ILLEGAL (first failure kills the pool when no info is wanted)."""
+    h = []
+    t = 0.0
+    for k in range(40):
+        key = f"k{k}"
+        h.append(put(key, "v", t, t + 1, cid=0))
+        h.append(get(key, "v", t + 2, t + 3, cid=1))
+        t += 4
+    h.append(put("bad", "1", t, t + 1, cid=0))
+    h.append(get("bad", "", t + 2, t + 3, cid=1))  # stale
+    assert check_operations(kv_model, h, parallel=True) is CheckResult.ILLEGAL
+
+
+def test_parallel_timeout_unknown():
+    """A hopeless deadline downgrades the parallel verdict to UNKNOWN,
+    never to a false OK/ILLEGAL (the shared kill-switch deadline)."""
+    import random
+
+    rng = random.Random(3)
+    h = []
+    # Heavily concurrent single-key history: exponential DFS.
+    for i in range(16):
+        c = rng.uniform(0, 10)
+        h.append(app("k", f"s{i}", c, c + rng.uniform(5, 10), cid=i))
+    for k in range(8):
+        h.append(put(f"p{k}", "v", 30 + k, 31 + k, cid=0))
+    res = check_operations(kv_model, h, timeout=1e-4, parallel=True)
+    assert res is CheckResult.UNKNOWN
+
+
+def test_verbose_timeout_marks_partitions_unchecked():
+    """Partitions the timeout kill switch dropped carry verdict None
+    (rendered neutrally by the viz — red means proven stuck, never
+    'not checked')."""
+    h = []
+    t = 0.0
+    for k in range(30):
+        key = f"k{k}"
+        h.append(put(key, "v", t, t + 1, cid=0))
+        h.append(get(key, "v", t + 2, t + 3, cid=1))
+        t += 4
+    verdict, info = check_operations_verbose(
+        kv_model, h, timeout=1e-9, parallel=False
+    )
+    assert verdict is CheckResult.UNKNOWN
+    assert any(v is None for v in info.verdicts)
+    # A full-length run records per-partition verdicts everywhere.
+    verdict, info = check_operations_verbose(kv_model, h, parallel=False)
+    assert verdict is CheckResult.OK
+    assert all(v is CheckResult.OK for v in info.verdicts)

@@ -5,7 +5,7 @@ storage) and Challenge 2 (partial availability during migration)."""
 
 
 from multiraft_tpu.harness.shardkv_harness import ShardKVHarness
-from multiraft_tpu.porcupine.checker import CheckResult, check_operations
+from multiraft_tpu.porcupine.visualization import assert_linearizable
 from multiraft_tpu.porcupine.kv import KvInput, KvOutput, OP_APPEND, OP_GET, OP_PUT, kv_model
 from multiraft_tpu.porcupine.model import Operation
 from multiraft_tpu.services.shardkv import key2shard
@@ -232,8 +232,7 @@ def _concurrent(unreliable: bool, seed: int, with_porcupine: bool = False):
                     assert off > last, f"append {tag} out of order in {v!r}"
                     last = off
     if with_porcupine:
-        res = check_operations(kv_model, history, timeout=2.0)
-        assert res is not CheckResult.ILLEGAL, "history not linearizable"
+        assert_linearizable(kv_model, history, timeout=2.0, name="shardkv")
     cfg.cleanup()
 
 
