@@ -573,6 +573,15 @@ class BatchedShardClerk:
 
     # -- async sessions (for concurrent-client tests) ----------------------
 
+    # Ticks before an unresolved ticket is re-submitted under the same
+    # (client_id, command_id).  A ticket can wedge forever without
+    # this: if its entry is truncated by a leader change, the ticket
+    # only fails when a new acceptance re-binds its log index — which
+    # never happens once client traffic drains.  The reference clerk's
+    # timeout-retry loop (shardkv/client.go:68-129) covers the same
+    # hole; dedup makes the duplicate harmless.
+    RESUBMIT_TICKS = 300
+
     class Session:
         def __init__(self, clerk: "BatchedShardClerk", op: str, key: str,
                      value: str, command_id: int) -> None:
@@ -580,12 +589,14 @@ class BatchedShardClerk:
             self.op, self.key, self.value = op, key, value
             self.command_id = command_id
             self.call_tick = clerk.skv.driver.tick
+            self.submit_tick = self.call_tick
             self.ticket: Optional[ShardTicket] = None
             self.done = False
             self.result = ""
             self._submit()
 
         def _submit(self) -> None:
+            self.submit_tick = self.clerk.skv.driver.tick
             cfg = self.clerk.skv.query_latest()
             gid = cfg.shards[key2shard(self.key)]
             if gid not in self.clerk.skv.reps:
@@ -605,6 +616,9 @@ class BatchedShardClerk:
                 self._submit()
                 return False
             if not t.done:
+                tick = self.clerk.skv.driver.tick
+                if tick - self.submit_tick >= BatchedShardClerk.RESUBMIT_TICKS:
+                    self._submit()  # wedged ticket: retry, dedup-safe
                 return False
             if t.failed or t.err == ERR_WRONG_GROUP:
                 self._submit()  # same command_id: dedup makes it safe

@@ -44,9 +44,11 @@ __all__ = [
     "LinearizationInfo",
 ]
 
-# Partition-count threshold below which the serial path is used (fork +
-# IPC overhead dominates tiny checks).
-_PARALLEL_MIN_PARTITIONS = 8
+# Work thresholds below which the serial path is used (fork + IPC
+# overhead dominates tiny checks): auto-parallel needs either this many
+# operations across all partitions, or one partition this large.
+_PARALLEL_MIN_TOTAL_OPS = 2000
+_PARALLEL_MIN_PART_OPS = 300
 
 
 @dataclasses.dataclass
@@ -271,8 +273,13 @@ def _check_partitions(
     each partition's own verdict (None where the kill switch dropped
     it before it ran)."""
     if parallel is None:
+        total_ops = sum(len(p) for p in parts)
         parallel = (
-            len(parts) >= _PARALLEL_MIN_PARTITIONS
+            len(parts) >= 2
+            and (
+                total_ops >= _PARALLEL_MIN_TOTAL_OPS
+                or max(len(p) for p in parts) >= _PARALLEL_MIN_PART_OPS
+            )
             and (os.cpu_count() or 1) > 1
             and _fork_safe()
         )
@@ -290,14 +297,10 @@ def _check_partitions(
             if rem is not None and rem <= 0:
                 unknown = True
                 break
-            res = None
-            if model.native_check is not None and not compute_partial:
-                res = model.native_check(part, deadline)
-            if res is None:
-                res, partials = _check_single(
-                    model, part, deadline, compute_partial
-                )
-                all_partials[i] = partials
+            _, res, partials = _worker(
+                (i, model, part, rem, compute_partial)
+            )
+            all_partials[i] = partials
             verdicts[i] = res
             if res is CheckResult.ILLEGAL:
                 illegal = True

@@ -160,7 +160,10 @@ for (const part of DATA.partitions) {{
       const op = part.ops[i];
       let x = Math.max(X(op.call) + 4, prevX + 9);
       x = Math.min(x, X(op.ret) - 2);
-      prevX = x;
+      // A concurrent op may lawfully linearize left of the previous
+      // point (its window ends there) — keep its point inside its own
+      // bar, but never drag LATER points leftward with it.
+      prevX = Math.max(prevX, x);
       pts.push([x, rowY(i) + rowH / 2 - 1]);
       opEls[i].dataset.linorder = k + 1;
     }});

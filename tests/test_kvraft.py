@@ -346,6 +346,49 @@ def test_snapshot_recover_concurrent():
     )
 
 
+def test_speed_3b():
+    """TestSpeed3B (reference: kvraft/test_test.go:686 + :387-419
+    GenericTestSpeed): the sequential-append latency gate — well under
+    one heartbeat interval (33.3 ms) per op — must hold while the
+    service is snapshotting (maxraftstate=1000), i.e. log compaction
+    must never stall the apply pipeline."""
+    maxraftstate = 1000
+    cfg = KVHarness(3, maxraftstate=maxraftstate, seed=58)
+    ck = cfg.make_client()
+    cfg.sched.run_for(1.0)  # let a leader emerge
+    t0 = cfg.sched.now
+    n = 200
+    for i in range(n):
+        cfg.run(ck.append("x", f"{i} "))
+    per_op = (cfg.sched.now - t0) / n
+    assert per_op < 0.0333, (
+        f"Operations completed too slowly {per_op*1000:.1f}ms/op"
+    )
+    v = cfg.run(ck.get("x"))
+    assert v == "".join(f"{i} " for i in range(n))
+    assert cfg.log_size() <= 8 * maxraftstate, "logs were not trimmed"
+    cfg.cleanup()
+
+
+def test_snapshot_unreliable():
+    """TestSnapshotUnreliable3B (reference: kvraft/test_test.go:700):
+    unreliable net + snapshots + many clients, no crashes."""
+    generic_test(
+        nclients=5, nservers=5, unreliable=True, maxraftstate=1000,
+        seed=59, nops=15,
+    )
+
+
+def test_snapshot_unreliable_recover():
+    """TestSnapshotUnreliableRecover3B (reference:
+    kvraft/test_test.go:705): unreliable net + crash-restarts +
+    snapshots + many clients."""
+    generic_test(
+        nclients=5, nservers=5, unreliable=True, crash=True,
+        maxraftstate=1000, seed=60, nops=12,
+    )
+
+
 def test_snapshot_unreliable_recover_concurrent_partition():
     """The 3B finale (reference:
     TestSnapshotUnreliableRecoverConcurrentPartitionLinearizable3B)."""

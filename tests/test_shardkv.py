@@ -364,3 +364,163 @@ def test_challenge2_partial_migration_serves_early():
         else:
             assert not fut.done, f"key {k} served without its data"
     cfg.cleanup()
+
+
+# -- crash-restart during config churn (reference: shardkv/test_test.go
+#    :385 TestConcurrent2, :456 TestConcurrent3, :566 TestUnreliable2) ---
+
+
+def _spawn_appenders(cfg, keys, vals, done):
+    """Background per-key appenders (the reference's ff goroutines):
+    each appends to its key until ``done`` flips, tracking the expected
+    value, then reports via its future."""
+    futs = []
+
+    def ff(i, c):
+        n = 0
+        while not done[0]:
+            x = f"x{i}.{n}."
+            yield from c.append(keys[i], x)
+            vals[i] += x
+            n += 1
+            yield 0.05
+        return n
+
+    for i in range(len(keys)):
+        futs.append(cfg.sched.spawn(ff(i, cfg.make_client())))
+    return futs
+
+
+def _check_final(cfg, ck, keys, vals):
+    for i, k in enumerate(keys):
+        got = cfg.run(ck.get(k))
+        assert got == vals[i], (
+            f"key {k}: got {got!r}, expected {vals[i]!r}"
+        )
+
+
+def test_concurrent2_restart_fetches_all_sources():
+    """Appends continue while groups leave/join repeatedly and two
+    groups then crash-restart: a restarting group must recover shard
+    contents from every possible source — its own snapshot, the
+    current owner, and in-flight migrations
+    (reference: shardkv/test_test.go:385-453 TestConcurrent2)."""
+    cfg = ShardKVHarness(n=3, ngroups=3, seed=81)
+    ck = cfg.make_client()
+    cfg.join(101)
+    cfg.join(100)
+    cfg.join(102)
+    cfg.sched.run_for(1.0)
+
+    keys = [str(i) for i in range(NSHARDS)]
+    vals = [f"v{i}." for i in range(NSHARDS)]
+    for i, k in enumerate(keys):
+        cfg.run(ck.put(k, vals[i]))
+
+    done = [False]
+    futs = _spawn_appenders(cfg, keys, vals, done)
+
+    cfg.leave(100)
+    cfg.leave(102)
+    cfg.sched.run_for(2.0)
+    cfg.join(100)
+    cfg.join(102)
+    cfg.leave(101)
+    cfg.sched.run_for(2.0)
+    cfg.join(101)
+    cfg.leave(100)
+    cfg.leave(102)
+    cfg.sched.run_for(2.0)
+
+    cfg.shutdown_group(101)
+    cfg.shutdown_group(102)
+    cfg.sched.run_for(0.7)
+    cfg.start_group(101)
+    cfg.start_group(102)
+    cfg.sched.run_for(1.5)
+
+    done[0] = True
+    for f in futs:
+        cfg.sched.run_until(f, max_events=10_000_000)
+    _check_final(cfg, ck, keys, vals)
+    cfg.cleanup()
+
+
+def test_concurrent3_restart_during_churn():
+    """Groups crash-restart *while* configuration changes are still in
+    flight, under snapshotting: the pull/GC state machines must survive
+    losing their volatile state mid-migration
+    (reference: shardkv/test_test.go:456-522 TestConcurrent3)."""
+    cfg = ShardKVHarness(n=3, ngroups=3, maxraftstate=300, seed=82)
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+
+    keys = [str(i) for i in range(NSHARDS)]
+    vals = [f"w{i}." for i in range(NSHARDS)]
+    for i, k in enumerate(keys):
+        cfg.run(ck.put(k, vals[i]))
+
+    done = [False]
+    futs = _spawn_appenders(cfg, keys, vals, done)
+
+    for cycle in range(3):
+        cfg.join(102)
+        cfg.join(101)
+        cfg.sched.run_for(cfg.rng.uniform(0.1, 0.9))
+        # Crash-restart every group while the joins/leaves churn.
+        for gid in cfg.gids:
+            cfg.shutdown_group(gid)
+        for gid in cfg.gids:
+            cfg.start_group(gid)
+        cfg.sched.run_for(cfg.rng.uniform(0.1, 0.9))
+        cfg.leave(101)
+        cfg.leave(102)
+        cfg.sched.run_for(cfg.rng.uniform(0.1, 0.9))
+
+    cfg.sched.run_for(2.0)
+    done[0] = True
+    for f in futs:
+        cfg.sched.run_until(f, max_events=20_000_000)
+    _check_final(cfg, ck, keys, vals)
+    cfg.cleanup()
+
+
+def test_unreliable2_churn_under_loss():
+    """Concurrent appends through config churn over an unreliable
+    network with snapshotting (reference: shardkv/test_test.go:566-634
+    TestUnreliable2)."""
+    cfg = ShardKVHarness(
+        n=3, ngroups=3, unreliable=True, maxraftstate=100, seed=83
+    )
+    ck = cfg.make_client()
+    cfg.join(100)
+    cfg.sched.run_for(1.0)
+
+    keys = [str(i) for i in range(NSHARDS)]
+    vals = [f"u{i}." for i in range(NSHARDS)]
+    for i, k in enumerate(keys):
+        cfg.run(ck.put(k, vals[i]))
+
+    done = [False]
+    futs = _spawn_appenders(cfg, keys, vals, done)
+
+    cfg.sched.run_for(0.15)
+    cfg.join(101)
+    cfg.sched.run_for(0.5)
+    cfg.join(102)
+    cfg.sched.run_for(0.5)
+    cfg.leave(100)
+    cfg.sched.run_for(0.5)
+    cfg.leave(101)
+    cfg.sched.run_for(0.5)
+    cfg.join(101)
+    cfg.join(100)
+    cfg.sched.run_for(2.0)
+
+    done[0] = True
+    cfg.net.set_reliable(True)
+    for f in futs:
+        cfg.sched.run_until(f, max_events=20_000_000)
+    _check_final(cfg, ck, keys, vals)
+    cfg.cleanup()
