@@ -30,6 +30,8 @@ __all__ = [
     "serve_kv",
     "serve_ctrler",
     "serve_shardkv",
+    "EngineProcessCluster",
+    "BlockingEngineClerk",
     "KVProcessCluster",
     "ShardKVProcessCluster",
     "BlockingClerk",
@@ -73,13 +75,44 @@ def _launch_server(spec: dict, label: Any) -> subprocess.Popen:
             stderr.close()
 
 
-def _check_ready(proc: subprocess.Popen, label: Any) -> None:
-    """Block until the child prints its readiness line.  Callers must
-    register ``proc`` for reaping BEFORE calling this — a child that
-    fails the check is still a live process."""
-    line = proc.stdout.readline()
-    if not line.startswith("ready"):
-        raise RuntimeError(f"server {label} failed to start: {line!r}")
+def _check_ready(
+    proc: subprocess.Popen, label: Any, timeout: float = 120.0
+) -> None:
+    """Block until the child prints its readiness line, bounded by
+    ``timeout`` — a child that starts but hangs before printing (e.g.
+    stuck in jax/native-build import) must not wedge the launcher
+    forever.  On timeout the child is killed and the failure raised.
+    Callers must register ``proc`` for reaping BEFORE calling this — a
+    child that fails the check is still a live process."""
+    import select
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    buf = ""
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise RuntimeError(
+                f"server {label} produced no readiness line within "
+                f"{timeout:.0f}s; killed"
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], remaining)
+        if not ready:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096).decode(
+            "utf-8", "replace"
+        )
+        if chunk == "":
+            raise RuntimeError(f"server {label} failed to start: {buf!r}")
+        buf += chunk
+        if "\n" in buf:
+            line = buf.split("\n", 1)[0]
+            if not line.startswith("ready"):
+                raise RuntimeError(
+                    f"server {label} failed to start: {line!r}"
+                )
+            return
 
 
 def serve_kv(
@@ -167,6 +200,31 @@ def serve_shardkv(
     return node
 
 
+def _pin_platform(spec: dict) -> None:
+    """Engine server processes import jax; pin the backend BEFORE any
+    backend init.  The env var alone cannot steer it when the TPU
+    plugin registers itself at interpreter start (it sets
+    jax_platforms programmatically) — tests pin "cpu"; production
+    passes "tpu" to own the chip."""
+    plat = spec.get("platform", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception as exc:
+        # A chip-owning server silently falling back to CPU would be
+        # orders of magnitude slower with no error anywhere: fatal for
+        # tpu; loud for cpu (tests would still pass, just slower).
+        if plat != "cpu":
+            raise RuntimeError(
+                f"engine server could not pin platform {plat!r}: {exc!r}"
+            )
+        print(
+            f"warning: could not pin jax platform to cpu: {exc!r}",
+            file=sys.stderr, flush=True,
+        )
+
+
 def _server_main() -> None:  # pragma: no cover - subprocess entry
     import json
 
@@ -189,6 +247,25 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             ctrler_ports=spec["ctrler_ports"],
             data_dir=spec["data_dir"],
             maxraftstate=spec.get("maxraftstate", -1),
+        )
+    elif kind == "engine_kv":
+        _pin_platform(spec)
+        from .engine_server import serve_engine_kv
+
+        node = serve_engine_kv(
+            port=spec["ports"][0],
+            G=spec.get("groups", 64),
+            seed=spec.get("seed", 0),
+        )
+    elif kind == "engine_shardkv":
+        _pin_platform(spec)
+        from .engine_server import serve_engine_shardkv
+
+        node = serve_engine_shardkv(
+            port=spec["ports"][0],
+            G=spec.get("groups", 4),
+            seed=spec.get("seed", 0),
+            join_gids=spec.get("join_gids"),
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
@@ -324,6 +401,79 @@ class KVProcessCluster:
     def shutdown(self) -> None:
         for i in range(self.n):
             self.kill(i)
+
+
+class EngineProcessCluster:
+    """One chip-owning engine server process (kind ``engine_kv`` or
+    ``engine_shardkv``) + blocking clerks — the engine-backed network
+    cluster (SURVEY §2.2's sidecar story, step 1: a single front door
+    coalescing clerk RPCs into device ticks).  Unlike the per-replica
+    ``KVProcessCluster``, consensus replication happens ON CHIP across
+    the engine's (G, P) lanes; the network carries client traffic only.
+    """
+
+    def __init__(
+        self,
+        kind: str = "engine_kv",
+        groups: int = 64,
+        host: str = "127.0.0.1",
+        seed: int = 0,
+        join_gids: Optional[List[int]] = None,
+    ) -> None:
+        assert kind in ("engine_kv", "engine_shardkv")
+        self.kind = kind
+        self.host = host
+        self.spec = {
+            "kind": kind,
+            "ports": _reserve_ports(1, host),
+            "groups": groups,
+            "seed": seed,
+            "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+        }
+        if join_gids is not None:
+            self.spec["join_gids"] = list(join_gids)
+        self.proc: Optional[subprocess.Popen] = None
+
+    @property
+    def port(self) -> int:
+        return self.spec["ports"][0]
+
+    def start(self) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        self.proc = _launch_server(self.spec, "engine")
+        _check_ready(self.proc, "engine", timeout=300.0)
+
+    def clerk(self) -> "BlockingEngineClerk":
+        return BlockingEngineClerk(
+            self.port, host=self.host,
+            service="EngineKV" if self.kind == "engine_kv"
+            else "EngineShardKV",
+        )
+
+    def shutdown(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+
+class BlockingEngineClerk(_BlockingClerkBase):
+    """Blocking client of an :class:`EngineProcessCluster`."""
+
+    def __init__(
+        self, port: int, host: str = "127.0.0.1",
+        service: str = "EngineKV",
+    ) -> None:
+        from .engine_server import EngineClerk
+
+        self.sched = RealtimeScheduler()
+        self.node = RpcNode(self.sched)
+        end = self.node.client_end(host, port)
+        self._clerk = EngineClerk(self.sched, end, service=service)
+
+    @property
+    def client_id(self) -> int:
+        return self._clerk.client_id
 
 
 def _reserve_ports(n: int, host: str) -> List[int]:

@@ -54,9 +54,21 @@ from typing import Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["EngineConfig", "EngineState", "Mailbox", "init_state", "empty_mailbox", "tick"]
+__all__ = [
+    "EngineConfig", "EngineState", "Mailbox", "init_state",
+    "empty_mailbox", "tick", "METRIC_KEYS", "SCALAR_METRIC_KEYS",
+]
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# The tick's metrics schema — single source of truth for the mesh
+# path's out_specs (engine/mesh.py) and the host's per-device scalar
+# reduction (engine/host.py).  SCALAR keys are cluster-wide scalars
+# (per-device lanes under a mesh); the rest are per-group [G] vectors.
+SCALAR_METRIC_KEYS = ("commits", "leaders", "max_term")
+METRIC_KEYS = SCALAR_METRIC_KEYS + (
+    "accepted", "start_index", "accept_term", "commit_index",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -386,12 +398,17 @@ def tick_impl(
             grant_pre = pre_act
         # Reply: out.vp[g, dst(voter)=·, dst_slot=s(candidate)].  A src
         # sends either a real or a pre request per tick, so the lanes
-        # are disjoint; merge into one write.
+        # are disjoint; merge into one write.  A GRANTED pre reply
+        # echoes the proposed term (the tally matches on it); a REFUSED
+        # pre reply carries the voter's actual term, so a candidate
+        # probing a partition-stale term learns the real one and steps
+        # down (sim parity: node.py _on_prevote_reply; etcd does the
+        # same).
         out = out._replace(
             vp_active=out.vp_active.at[:, :, s].set(active | pre_act),
             vp_pre=out.vp_pre.at[:, :, s].set(pre_act),
             vp_term=out.vp_term.at[:, :, s].set(
-                jnp.where(pre_act, m_term, state.term)
+                jnp.where(pre_act & grant_pre, m_term, state.term)
             ),
             vp_granted=out.vp_granted.at[:, :, s].set(
                 jnp.where(pre_act, grant_pre, grant)
@@ -406,6 +423,17 @@ def tick_impl(
         active = arrived & ~reply_pre
         m_term = inbox.vp_term[:, s, :]
         higher = active & (m_term > state.term)
+        if cfg.prevote:
+            # A refused pre reply carries the voter's actual term (see
+            # phase 1): adopt a higher one just like the sim does —
+            # without this, a candidate never learns a voter's real
+            # term from a prevote refusal (liveness lag).
+            higher = higher | (
+                arrived
+                & reply_pre
+                & ~inbox.vp_granted[:, s, :]
+                & (m_term > state.term)
+            )
         state = _step_down(cfg, state, higher, m_term)
         good = (
             active
@@ -682,7 +710,12 @@ def tick_impl(
                                 state.pre_votes),
             elect_dl=jnp.where(timeout, now + jitter, state.elect_dl),
         )
-        send_real = promote  # phase-2 promotions announce immediately
+        # Phase-2 promotions announce immediately — unless a later
+        # phase (3/4) already deposed the fresh candidate on a
+        # higher-term message: a FOLLOWER must not broadcast real
+        # RequestVote (voters would burn voted_for for a node that can
+        # never tally them).
+        send_real = promote & (state.role == CANDIDATE)
         send_pre = timeout  # disjoint: promote reset elect_dl this tick
     last_idx = _last_index(state)
     last_term = _term_at(cfg, state, last_idx)
@@ -827,6 +860,10 @@ def tick_impl(
         "accept_term": jnp.sum(jnp.where(accept > 0, state.term, 0), axis=1),
         "commit_index": jnp.max(state.commit, axis=1),  # i32[G]
     }
+    assert set(metrics) == set(METRIC_KEYS), (
+        "tick metrics drifted from METRIC_KEYS — update core.py's "
+        "constants (mesh.py and host.py derive their specs from them)"
+    )
     return state, out, metrics
 
 

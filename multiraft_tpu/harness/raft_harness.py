@@ -48,6 +48,10 @@ class RaftHarness:
         self.prevote = prevote
         self.sched = Scheduler()
         self.net = Network(self.sched, seed=seed)
+        # Budget accounting: the harness and network share one Metrics
+        # registry (utils/metrics.py) — RPC/byte totals accumulate
+        # there, and one() records agreement latency in virtual time.
+        self.metrics = self.net.metrics
         self.net.set_reliable(not unreliable)
         self.n = n
         self.seed = seed
@@ -313,6 +317,8 @@ class RaftHarness:
                 while self.sched.now - t1 < 2.0:
                     nd, cmd1 = self.n_committed(index)
                     if nd >= expected_servers and cmd1 == cmd:
+                        self.metrics.inc("one_agreements")
+                        self.metrics.observe("one_latency_s", self.sched.now - t0)
                         return index
                     self.sched.run_for(0.02)
                 if not retry:

@@ -54,12 +54,17 @@ from .messages import (
     Role,
 )
 from .persister import Persister
+from ..utils.metrics import trace
 
 __all__ = ["RaftNode", "HEARTBEAT_INTERVAL", "ELECTION_TIMEOUT"]
 
-# Timing constants (reference: raft/raft.go:42-50), in virtual seconds.
-HEARTBEAT_INTERVAL = 0.09
-ELECTION_TIMEOUT = (0.3, 0.6)
+# Timing (reference: raft/raft.go:42-50), in virtual seconds — read
+# from the config system (utils/config.py), overridable via
+# MULTIRAFT_HEARTBEAT / MULTIRAFT_ELECTION_MIN / _MAX.
+from ..utils.config import settings as _settings
+
+HEARTBEAT_INTERVAL = _settings().raft.heartbeat
+ELECTION_TIMEOUT = _settings().raft.election
 
 
 class RaftNode:
@@ -340,6 +345,7 @@ class RaftNode:
 
     def _become_leader(self) -> None:
         """(reference: raft/raft_election.go:30-41)"""
+        trace("raft %d: leader at term %d", self.me, self.current_term)
         self.role = Role.LEADER
         last = self.log.last_index
         for p in range(len(self.peers)):
@@ -351,6 +357,9 @@ class RaftNode:
 
     def _step_down(self, term: int) -> None:
         changed = term > self.current_term
+        if changed and self.role is not Role.FOLLOWER:
+            trace("raft %d: step down %d -> %d", self.me,
+                  self.current_term, term)
         self.current_term = max(self.current_term, term)
         if changed:
             self.voted_for = None

@@ -55,11 +55,14 @@ PUT = "Put"
 APPEND = "Append"
 
 # Server-side wait before giving up on a started op
-# (reference: kvraft/server.go:80 — 99 ms).
-SERVER_WAIT = 0.099
-# Clerk per-attempt timeout before rotating servers
-# (reference: kvraft/client.go:57 — 100 ms).
-CLERK_RETRY = 0.1
+# (reference: kvraft/server.go:80 — 99 ms) and clerk per-attempt
+# timeout before rotating servers (reference: kvraft/client.go:57 —
+# 100 ms), both from the config system (MULTIRAFT_SERVER_WAIT /
+# MULTIRAFT_CLERK_RETRY).
+from ..utils.config import settings as _settings
+
+SERVER_WAIT = _settings().service.server_wait
+CLERK_RETRY = _settings().service.clerk_retry
 
 # Pause after a full failed sweep of all servers before retrying
 # (reference analog: shardctrler/client.go:52-62's 100 ms inter-sweep
@@ -223,7 +226,9 @@ class KVServer:
         # Trigger at the documented 0.8 threshold (divergence: the
         # reference's integer division makes its check effectively 1.0×,
         # kvraft/server.go:151).
-        if self.rf.raft_state_size() >= 0.8 * self.maxraftstate:
+        if self.rf.raft_state_size() >= (
+            _settings().service.snapshot_threshold * self.maxraftstate
+        ):
             blob = codec.encode(
                 {"data": dict(self.kv.data), "latest": dict(self.latest)}
             )

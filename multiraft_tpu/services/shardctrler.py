@@ -45,7 +45,10 @@ __all__ = [
     "MOVE",
 ]
 
-NSHARDS = 10  # (reference: shardctrler/common.go:23)
+from ..utils.config import settings as _settings
+
+# (reference: shardctrler/common.go:23; MULTIRAFT_NSHARDS overrides)
+NSHARDS = _settings().nshards
 
 QUERY = "Query"
 JOIN = "Join"
@@ -56,7 +59,7 @@ OK = "OK"
 ERR_WRONG_LEADER = "ErrWrongLeader"
 ERR_TIMEOUT = "ErrTimeout"
 
-SERVER_WAIT = 0.099  # (reference: shardctrler/server.go:19)
+SERVER_WAIT = _settings().service.server_wait  # (reference: shardctrler/server.go:19)
 
 
 @codec.registered
@@ -252,7 +255,9 @@ class ShardCtrler:
     def _maybe_snapshot(self, index: int) -> None:
         if self.maxraftstate < 0:
             return
-        if self.rf.raft_state_size() >= 0.8 * self.maxraftstate:
+        if self.rf.raft_state_size() >= (
+            _settings().service.snapshot_threshold * self.maxraftstate
+        ):
             blob = codec.encode(
                 {"configs": self.configs, "latest": dict(self.latest)}
             )

@@ -65,10 +65,13 @@ GET = "Get"
 PUT = "Put"
 APPEND = "Append"
 
-SERVER_WAIT = 0.099
+from ..utils.config import settings as _settings
+
+SERVER_WAIT = _settings().service.server_wait
 # Leader ticker cadences (reference polls the controller every 100 ms,
-# shardkv hint; staggered to avoid lockstep).
-CONFIG_POLL = 0.08
+# shardkv hint; staggered to avoid lockstep).  CONFIG_POLL comes from
+# the config system (MULTIRAFT_CONFIG_POLL).
+CONFIG_POLL = _settings().service.config_poll
 PULL_INTERVAL = 0.06
 GC_INTERVAL = 0.07
 
@@ -515,7 +518,9 @@ class ShardKVServer:
     def _maybe_snapshot(self, index: int) -> None:
         if self.maxraftstate < 0:
             return
-        if self.rf.raft_state_size() >= 0.8 * self.maxraftstate:
+        if self.rf.raft_state_size() >= (
+            _settings().service.snapshot_threshold * self.maxraftstate
+        ):
             blob = codec.encode(
                 {
                     "cur": self.cur,

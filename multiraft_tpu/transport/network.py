@@ -34,6 +34,8 @@ from collections import defaultdict
 from typing import Any, Callable, Dict, Optional
 
 from ..sim.scheduler import Future, Scheduler
+from ..utils.config import FaultModel, settings
+from ..utils.metrics import Metrics
 from . import codec
 
 __all__ = ["Network", "ClientEnd", "Server", "Service"]
@@ -98,19 +100,31 @@ class ClientEnd:
 
 
 class Network:
-    def __init__(self, sched: Scheduler, seed: int = 0) -> None:
+    def __init__(
+        self,
+        sched: Scheduler,
+        seed: int = 0,
+        faults: Optional["FaultModel"] = None,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
         self.sched = sched
         self.rng = random.Random(seed)
         self.reliable = True
         self.long_delays = False
         self.long_reordering = False
+        # All fault constants come from the config system's FaultModel
+        # (utils/config.py) — one authoritative copy of the labrpc
+        # numbers instead of literals scattered through this file.
+        self.faults = faults or settings().faults
+        # RPC/byte accounting lives in a Metrics registry (shared with
+        # the harness, utils/metrics.py); get_total_count()/get_count()
+        # read through it.
+        self.metrics = metrics or Metrics()
         self._ends: Dict[Any, ClientEnd] = {}
         self._servers: Dict[Any, Optional[Server]] = {}
         self._connections: Dict[Any, Any] = {}  # endname -> servername
         self._enabled: Dict[Any, bool] = {}
         self._count: Dict[Any, int] = defaultdict(int)  # delivered per server
-        self._total_count = 0
-        self._total_bytes = 0
         self._done = False
         # Optional utils.trace.Tracer: every RPC becomes a span
         # (send→resolve) tagged with its outcome; None = zero overhead.
@@ -171,10 +185,10 @@ class Network:
         return self._count[servername]
 
     def get_total_count(self) -> int:
-        return self._total_count
+        return self.metrics.counters["rpcs_total"]
 
     def get_total_bytes(self) -> int:
-        return self._total_bytes
+        return self.metrics.counters["bytes_total"]
 
     # -- the fault model --------------------------------------------------
 
@@ -182,7 +196,7 @@ class Network:
         fut: Future = Future()
         if self._done:
             return fut  # never resolves after Cleanup, like a closed network
-        self._total_count += 1
+        self.metrics.inc("rpcs_total")
         req_bytes = codec.encode(args)
         t0 = self.sched.now
 
@@ -194,9 +208,9 @@ class Network:
             # Simulate no reply and an eventual timeout
             # (reference: labrpc/labrpc.go:296-310).
             if self.long_delays:
-                delay = self.rng.uniform(0, 7.0)
+                delay = self.rng.uniform(0, self.faults.long_dead_timeout)
             else:
-                delay = self.rng.uniform(0, 0.1)
+                delay = self.rng.uniform(0, self.faults.dead_timeout)
             self.sched.call_after(delay, fut.resolve, None)
             self._trace_rpc(endname, svc_meth, t0, t0 + delay, "timeout")
             return fut
@@ -205,8 +219,8 @@ class Network:
         if not self.reliable:
             # Short delay before the request arrives
             # (reference: labrpc/labrpc.go:228-231).
-            delay += self.rng.uniform(0, 0.026)
-            if self.rng.random() < 0.1:
+            delay += self.rng.uniform(0, self.faults.unreliable_delay)
+            if self.rng.random() < self.faults.drop_request:
                 # Drop the request: caller sees a failure quickly
                 # (reference: labrpc/labrpc.go:233-239).
                 self.sched.call_after(delay, fut.resolve, None)
@@ -238,7 +252,7 @@ class Network:
             return
         args = codec.decode(req_bytes)
         self._count[servername] += 1
-        self._total_bytes += len(req_bytes)
+        self.metrics.inc("bytes_total", len(req_bytes))
         result = server.dispatch(svc_meth, args)
         done = self.sched.spawn(result) if _is_gen(result) else None
         if done is None:
@@ -269,7 +283,7 @@ class Network:
             )
             return
         reply_bytes = codec.encode(reply)
-        if not self.reliable and self.rng.random() < 0.1:
+        if not self.reliable and self.rng.random() < self.faults.drop_reply:
             # Drop the reply (reference: labrpc/labrpc.go:279-284).
             self.sched.call_after(RELIABLE_HOP_DELAY, fut.resolve, None)
             self._trace_rpc(
@@ -278,11 +292,12 @@ class Network:
             )
             return
         delay = RELIABLE_HOP_DELAY
-        if self.long_reordering and self.rng.random() < (2.0 / 3.0):
+        if self.long_reordering and self.rng.random() < self.faults.reorder_fraction:
             # Delay the response for a while
             # (reference: labrpc/labrpc.go:285-294).
-            delay += 0.2 + self.rng.uniform(0, 2.4)
-        self._total_bytes += len(reply_bytes)
+            lo, hi = self.faults.reorder_delay
+            delay += lo + self.rng.uniform(0, hi - lo)
+        self.metrics.inc("bytes_total", len(reply_bytes))
         self.sched.call_after(delay, fut.resolve, codec.decode(reply_bytes))
         self._trace_rpc(endname, svc_meth, t0, self.sched.now + delay, "ok")
 
@@ -294,7 +309,7 @@ class Network:
         t0: float = 0.0,
         status: str = "dead_server",
     ) -> None:
-        delay = self.rng.uniform(0, 0.1)
+        delay = self.rng.uniform(0, self.faults.dead_timeout)
         self.sched.call_after(delay, fut.resolve, None)
         if svc_meth:
             self._trace_rpc(endname, svc_meth, t0, self.sched.now + delay, status)

@@ -6,17 +6,31 @@ kvraft/server.go:80 + kvraft/client.go:57, SnapShotInterval=10
 raft/config.go:215, with ``maxraftstate`` the only runtime knob).
 
 Everything is a frozen dataclass; ``Settings.default()`` reproduces the
-reference's timing exactly, and the engine's tick-domain equivalents
-live in :class:`multiraft_tpu.engine.core.EngineConfig`.
+reference's timing exactly.  The process-wide instance is
+:func:`settings` (parsed once from ``MULTIRAFT_*`` environment
+variables) — it is what the consumers actually read:
+
+* ``raft.node`` takes its heartbeat/election timing from it,
+* ``services.kvraft`` / ``shardctrler`` / ``shardkv`` take their
+  server-wait, clerk-retry, config-poll, and snapshot thresholds,
+* ``transport.network`` takes the whole labrpc fault model,
+* :meth:`Settings.engine_config` derives the tick-domain
+  :class:`~multiraft_tpu.engine.core.EngineConfig` timing from the
+  same wall-clock knobs (10 ms/tick).
+
+Tests that need custom timing should pass explicit values or set the
+environment before import; the cached instance keeps every layer's view
+consistent within a process.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Tuple
 
-__all__ = ["RaftTiming", "ServiceTiming", "FaultModel", "Settings"]
+__all__ = ["RaftTiming", "ServiceTiming", "FaultModel", "Settings", "settings"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,9 +74,67 @@ class Settings:
 
     @staticmethod
     def from_env(prefix: str = "MULTIRAFT_") -> "Settings":
-        """Override timing via environment, e.g. MULTIRAFT_HEARTBEAT=0.05."""
+        """Build Settings with every wall-clock/topology knob
+        overridable from the environment:
+
+        ========================  =================================
+        MULTIRAFT_HEARTBEAT       raft heartbeat seconds
+        MULTIRAFT_ELECTION_MIN    election timeout lower bound
+        MULTIRAFT_ELECTION_MAX    election timeout upper bound
+        MULTIRAFT_SERVER_WAIT     service wait-channel timeout
+        MULTIRAFT_CLERK_RETRY     clerk per-RPC retry timeout
+        MULTIRAFT_CONFIG_POLL     shardkv config poll cadence
+        MULTIRAFT_SNAP_THRESHOLD  snapshot trigger fraction
+        MULTIRAFT_NSHARDS         shard count
+        ========================  =================================
+        """
+
+        def f(name: str, cur: float) -> float:
+            v = os.environ.get(prefix + name)
+            return float(v) if v else cur
+
         s = Settings()
-        hb = os.environ.get(prefix + "HEARTBEAT")
-        if hb:
-            s = dataclasses.replace(s, raft=dataclasses.replace(s.raft, heartbeat=float(hb)))
-        return s
+        raft = RaftTiming(
+            heartbeat=f("HEARTBEAT", s.raft.heartbeat),
+            election=(
+                f("ELECTION_MIN", s.raft.election[0]),
+                f("ELECTION_MAX", s.raft.election[1]),
+            ),
+        )
+        service = ServiceTiming(
+            server_wait=f("SERVER_WAIT", s.service.server_wait),
+            clerk_retry=f("CLERK_RETRY", s.service.clerk_retry),
+            config_poll=f("CONFIG_POLL", s.service.config_poll),
+            snapshot_threshold=f(
+                "SNAP_THRESHOLD", s.service.snapshot_threshold
+            ),
+        )
+        return dataclasses.replace(
+            s,
+            raft=raft,
+            service=service,
+            nshards=int(f("NSHARDS", s.nshards)),
+        )
+
+    def engine_config(self, tick_s: float = 0.01, **overrides):
+        """Derive the batched engine's tick-domain timing from these
+        wall-clock knobs (SURVEY §2.2's 10 ms/tick mapping), keeping
+        the two backends' timing in one place.  ``overrides`` pass
+        through to :class:`~multiraft_tpu.engine.core.EngineConfig`
+        (shapes, pallas flags, prevote, ...)."""
+        from ..engine.core import EngineConfig
+
+        timing = dict(
+            HB_TICKS=max(1, round(self.raft.heartbeat / tick_s)),
+            ELECT_MIN=max(2, round(self.raft.election[0] / tick_s)),
+            ELECT_MAX=max(3, round(self.raft.election[1] / tick_s)),
+        )
+        timing.update(overrides)
+        return EngineConfig(**timing)
+
+
+@functools.lru_cache(maxsize=None)
+def settings() -> Settings:
+    """The process-wide Settings instance (parsed from the environment
+    once; every consumer layer reads this so their views agree)."""
+    return Settings.from_env()

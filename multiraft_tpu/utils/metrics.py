@@ -4,9 +4,19 @@ The reference's observability is wall-clock begin/end printers plus
 RPC/byte counters and two debug-printf gates (SURVEY §5.1/§5.5:
 raft/config.go:624-651, labrpc/labrpc.go:375-383, raft/utility.go:55-72).
 This module gives the framework a real registry: named counters,
-gauges, and histogram-ish timers that the harnesses, services, and the
-engine driver all share, plus a ``trace`` printf gated by
-``MULTIRAFT_DEBUG``.
+gauges, and histogram-ish timers.  Live consumers:
+
+* ``transport.network.Network`` — its RPC/byte accounting IS a Metrics
+  registry (``get_total_count``/``get_total_bytes`` read through it);
+* ``harness.raft_harness.RaftHarness`` — shares the network's registry
+  and records ``one()`` agreement counts + virtual-time latency;
+* ``engine.host.EngineDriver`` — tick counter, plus wall-clock per-tick
+  latency samples under the tracer;
+* ``bench.py`` — percentile computation over run samples.
+
+``trace`` is the DPrintf equivalent (reference: raft/utility.go:55-72),
+gated by ``MULTIRAFT_DEBUG`` and wired into RaftNode's leadership
+transitions.
 """
 
 from __future__ import annotations

@@ -535,3 +535,149 @@ class TestShardProcessCluster:
             clerk.close()
         finally:
             cluster.shutdown()
+
+
+def test_check_ready_times_out_on_hung_child():
+    """A child that starts but never prints 'ready' (hung import) must
+    not wedge the launcher: _check_ready kills it and raises."""
+    import subprocess
+    import sys
+    import time
+
+    from multiraft_tpu.distributed.cluster import _check_ready
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        t0 = time.monotonic()
+        try:
+            _check_ready(proc, "hung", timeout=0.5)
+            raise AssertionError("expected RuntimeError")
+        except RuntimeError as e:
+            assert "no readiness line" in str(e)
+        assert time.monotonic() - t0 < 5.0
+        assert proc.wait(timeout=5.0) is not None  # killed
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+
+
+def test_engine_kv_served_over_real_sockets_linearizable(tmp_path):
+    """The batched engine behind TCP (SURVEY §2.2 sidecar, step 1): a
+    chip-owning server process coalesces concurrent clerk RPCs into
+    device ticks; client-side wall-clock histories must be linearizable
+    under porcupine across real sockets, and session dedup must keep
+    at-least-once retries exactly-once."""
+    import threading
+    import time
+
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.porcupine.kv import (
+        OP_APPEND,
+        OP_GET,
+        KvInput,
+        KvOutput,
+        kv_model,
+    )
+    from multiraft_tpu.porcupine.model import Operation
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=16, seed=3)
+    try:
+        cluster.start()
+        history = []
+        hist_lock = threading.Lock()
+        keys = ["ha", "hb"]
+
+        def worker(wid):
+            ck = cluster.clerk()
+            try:
+                for j in range(8):
+                    key = keys[(wid + j) % len(keys)]
+                    t0 = time.monotonic()
+                    if j % 3 == 2:
+                        v = ck.get(key)
+                        inp = KvInput(op=OP_GET, key=key)
+                        out = KvOutput(value=v)
+                    else:
+                        tag = f"({wid}.{j})"
+                        ck.append(key, tag)
+                        inp = KvInput(op=OP_APPEND, key=key, value=tag)
+                        out = KvOutput(value="")
+                    with hist_lock:
+                        history.append(
+                            Operation(
+                                client_id=ck.client_id,
+                                input=inp,
+                                call=t0,
+                                output=out,
+                                ret=time.monotonic(),
+                            )
+                        )
+            finally:
+                ck.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # Every append appears exactly once, and the full history is
+        # linearizable (real sockets, real concurrency, wall-clock).
+        ck = cluster.clerk()
+        try:
+            for key in keys:
+                v = ck.get(key)
+                for wid in range(3):
+                    for j in range(8):
+                        tag = f"({wid}.{j})"
+                        expected = keys[(wid + j) % len(keys)] == key and j % 3 != 2
+                        assert v.count(tag) == (1 if expected else 0), (
+                            f"{tag} appears {v.count(tag)}x in {key}={v!r}"
+                        )
+        finally:
+            ck.close()
+        assert len(history) == 24
+        assert_linearizable(
+            kv_model, history, timeout=30.0, name="engine-over-tcp"
+        )
+    finally:
+        cluster.shutdown()
+
+
+def test_engine_shardkv_served_over_real_sockets(tmp_path):
+    """The sharded engine form behind the same front door: traffic
+    continues across a live join-triggered migration."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+
+    cluster = EngineProcessCluster(
+        kind="engine_shardkv", groups=4, seed=4, join_gids=[1]
+    )
+    try:
+        cluster.start()
+        ck = cluster.clerk()
+        try:
+            for i in range(6):
+                ck.put(chr(97 + i), f"v{i}")
+            # Live migration under traffic: join another group via the
+            # admin RPC while appends flow.
+            fut = ck.node.client_end(cluster.host, cluster.port).call(
+                "EngineShardKV.admin", ("join", [2])
+            )
+            for i in range(6):
+                ck.append(chr(97 + i), "!")
+            assert ck.sched.wait(fut, 30.0).err == "OK"
+            for i in range(6):
+                assert ck.get(chr(97 + i)) == f"v{i}!"
+        finally:
+            ck.close()
+    finally:
+        cluster.shutdown()

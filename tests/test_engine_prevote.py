@@ -132,3 +132,33 @@ def test_prevote_oneway_partition_no_disruption():
     for _ in range(60):
         d.step()
     d.check_log_matching(0)
+
+
+def test_prevote_refusal_teaches_higher_term():
+    """A refused pre reply carries the voter's actual term, and the
+    prober adopts it — sim parity (node.py _on_prevote_reply steps down
+    on reply.term > current_term; etcd likewise).  Without this, a
+    rejoining replica only learns the cluster's term from a later
+    append."""
+    import jax.numpy as jnp
+
+    cfg = EngineConfig(G=1, P=3, L=32, E=4, INGEST=4, prevote=True)
+    d = EngineDriver(cfg, seed=3)
+    # Replicas 1 and 2 sit at a much higher term; replica 0 lags and
+    # will fire a prevote probe at term+1=1, which both refuse (their
+    # term is higher).
+    st = d.state
+    high = jnp.asarray([[0, 50, 50]], jnp.int32)
+    d.state = st._replace(
+        term=high,
+        # Make 1 and 2 lease-expired followers that won't probe first,
+        # and force 0 to probe immediately.
+        elect_dl=jnp.asarray([[1, 10_000, 10_000]], jnp.int32),
+        last_heard=jnp.asarray([[0, 0, 0]], jnp.int32),
+    )
+    for _ in range(6):
+        d.step()
+    term0 = int(d.np_state()["term"][0, 0])
+    assert term0 >= 50, (
+        f"prober never adopted the voters' higher term (term={term0})"
+    )
