@@ -32,13 +32,15 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _cfg(G=None, L=80, E=20, ingest=20):
+def _cfg(G=None, P=None, L=80, E=20, ingest=20):
     """Defaults match bench.py's measured sweet spot (E=INGEST=20,
-    L=80 — see the operating-point note there)."""
+    L=80 — see the operating-point note there).  P comes from
+    MULTIRAFT_BENCH_P so every scenario is peer-count-generic."""
     from multiraft_tpu.engine.core import EngineConfig
 
     G = G or int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
-    return EngineConfig(G=G, P=3, L=L, E=E, INGEST=ingest, HB_TICKS=9)
+    P = P or int(os.environ.get("MULTIRAFT_BENCH_P", "3"))
+    return EngineConfig(G=G, P=P, L=L, E=E, INGEST=ingest, HB_TICKS=9)
 
 
 def _chunk() -> int:
@@ -169,9 +171,12 @@ def bench_snapstorm() -> Dict:
     cfg = _cfg(L=32, E=8, ingest=8)
     state, inbox, key = _boot(cfg)
     CHUNK = _chunk()
-    # Kill follower 2 of every group (or the first non-leader).
+    # Kill one non-leader per group (P-generic: pick the highest
+    # replica id that is not the leader).
     role = np.asarray(state.role)
-    victim = np.where(role[:, 2] == 2, 1, 2)
+    alive = np.asarray(state.alive)
+    leaders = ((role == 2) & alive).argmax(axis=1)
+    victim = np.where(leaders != cfg.P - 1, cfg.P - 1, cfg.P - 2)
     state = state._replace(
         alive=state.alive.at[np.arange(cfg.G), victim].set(False)
     )
@@ -262,8 +267,10 @@ def bench_skew() -> Dict:
 
 
 def bench_sweep() -> Dict:
-    """Group-count scaling: commits/sec at G = 1k, 10k, (100k with
-    MULTIRAFT_BENCH_SWEEP_MAX=100000) on one chip."""
+    """(G, P) scaling sweep: commits/sec at G = 1k/10k (and 100k with
+    MULTIRAFT_BENCH_SWEEP_MAX=100000) for every peer count in
+    MULTIRAFT_BENCH_SWEEP_P (default "3"; "3,5" reproduces
+    BENCHMARKS.md's full table incl. config #5 100k x 5) on one chip."""
     import jax
 
     from multiraft_tpu.engine.core import run_ticks
@@ -271,26 +278,37 @@ def bench_sweep() -> Dict:
     CHUNK = _chunk()
     ROUNDS = int(os.environ.get("MULTIRAFT_BENCH_CHUNKS", "3"))
     gmax = int(os.environ.get("MULTIRAFT_BENCH_SWEEP_MAX", "10000"))
+    peer_counts = [
+        int(p)
+        for p in os.environ.get("MULTIRAFT_BENCH_SWEEP_P", "3").split(",")
+    ]
     points = {}
-    for G in [g for g in (1000, 10000, 100000) if g <= gmax]:
-        # Per-scale operating point: at 100k groups the working set is
-        # HBM-bandwidth-bound and the leaner 16/64 ring wins (174M vs
-        # 146M measured); at <=10k the 20/80 point wins (~15%).
-        cfg = _cfg(G=G, L=64, E=16, ingest=16) if G >= 100000 else _cfg(G=G)
-        state, inbox, key = _boot(cfg)
-        state, inbox = run_ticks(cfg, state, inbox, CHUNK, cfg.INGEST,
-                                 jax.random.fold_in(key, 1))
-        jax.block_until_ready(state.term)
-        c0 = _commits(state)
-        t0 = time.perf_counter()
-        for r in range(ROUNDS):
+    for P in peer_counts:
+        for G in [g for g in (1000, 10000, 100000) if g <= gmax]:
+            # Per-scale operating point: at 100k groups the working set
+            # is HBM-bandwidth-bound and the leaner 16/64 ring wins
+            # (174M vs 146M measured); at <=10k the 20/80 point wins
+            # (~15%).
+            cfg = (
+                _cfg(G=G, P=P, L=64, E=16, ingest=16)
+                if G >= 100000
+                else _cfg(G=G, P=P)
+            )
+            state, inbox, key = _boot(cfg)
             state, inbox = run_ticks(cfg, state, inbox, CHUNK, cfg.INGEST,
-                                     jax.random.fold_in(key, 500 + r))
+                                     jax.random.fold_in(key, 1))
             jax.block_until_ready(state.term)
-        elapsed = time.perf_counter() - t0
-        rate = int((_commits(state) - c0).sum()) / elapsed
-        points[str(G)] = round(rate, 1)
-        log(f"sweep G={G}: {rate:,.0f} commits/s")
+            c0 = _commits(state)
+            t0 = time.perf_counter()
+            for r in range(ROUNDS):
+                state, inbox = run_ticks(cfg, state, inbox, CHUNK,
+                                         cfg.INGEST,
+                                         jax.random.fold_in(key, 500 + r))
+                jax.block_until_ready(state.term)
+            elapsed = time.perf_counter() - t0
+            rate = int((_commits(state) - c0).sum()) / elapsed
+            points[f"G={G},P={P}"] = round(rate, 1)
+            log(f"sweep G={G} P={P}: {rate:,.0f} commits/s")
     best = max(points.values())
     return _emit(
         "commits_per_sec_scaling_sweep",

@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.metrics import Metrics
 from .core import (
+    SCALAR_METRIC_KEYS,
     CANDIDATE,
     FOLLOWER,
     LEADER,
@@ -82,10 +84,39 @@ def apply_faults(
 
 
 class EngineDriver:
-    def __init__(self, cfg: EngineConfig, seed: int = 0) -> None:
+    def __init__(
+        self, cfg: EngineConfig, seed: int = 0, mesh=None,
+        check_zero_collectives: bool = True,
+    ) -> None:
+        """``mesh``: an optional 1-D ``jax.sharding.Mesh`` (axis
+        ``"groups"``) — the driver then runs the production multi-chip
+        recipe (engine/mesh.py): state/mailbox sharded on the groups
+        axis, the tick under shard_map, and (by default) a compile-time
+        assert that the step contains zero collectives."""
         self._init_host(cfg, seed)
         self.state: EngineState = init_state(cfg, jax.random.fold_in(self.key, 0))
         self.inbox: Mailbox = empty_mailbox(cfg)
+        if mesh is not None:
+            from .mesh import (
+                assert_zero_collectives,
+                make_sharded_tick,
+                shard_arrays,
+            )
+
+            self.mesh = mesh
+            self.state = shard_arrays(cfg, mesh, self.state)
+            self.inbox = shard_arrays(cfg, mesh, self.inbox)
+            self._mesh_tick = make_sharded_tick(cfg, mesh)
+            if check_zero_collectives:
+                import jax.numpy as _jnp
+
+                assert_zero_collectives(
+                    self._mesh_tick,
+                    self.state,
+                    self.inbox,
+                    _jnp.zeros(cfg.G, _jnp.int32),
+                    self.key,
+                )
 
     def _init_host(self, cfg: EngineConfig, seed: int) -> None:
         """Host-side bookkeeping shared by __init__ and restore() —
@@ -123,6 +154,11 @@ class EngineDriver:
         self.payloads: Dict[tuple, Any] = {}
         self._pending_payloads: Dict[int, list] = defaultdict(list)
         self.last_metrics: Dict[str, Any] = {}
+        self.mesh = None
+        self._mesh_tick = None
+        # Structured counters (utils/metrics.py): ticks always; per-tick
+        # wall latency samples when the tracer (diagnostic mode) is on.
+        self.metrics = Metrics()
         self.tick = 0  # host mirror of the device tick counter
         # Called with the old payload when a (group, index) binding is
         # overwritten — i.e. the old command lost its slot to a leader
@@ -280,6 +316,7 @@ class EngineDriver:
 
     def step(self, n: int = 1) -> Dict[str, Any]:
         cfg = self.cfg
+        self.metrics.inc("ticks", n)
         for _ in range(n):
             self.tick += 1
             t_wall = time.perf_counter() if self.tracer else 0.0
@@ -288,9 +325,21 @@ class EngineDriver:
             new_cmds = jnp.asarray(
                 np.minimum(self.backlog, cfg.INGEST), jnp.int32
             ) if have_backlog else jnp.zeros(cfg.G, jnp.int32)
-            state, outbox, metrics = tick(
-                cfg, self.state, self.inbox, new_cmds, tick_key
-            )
+            if self._mesh_tick is not None:
+                state, outbox, metrics = self._mesh_tick(
+                    self.state, self.inbox, new_cmds, tick_key
+                )
+                # Scalar metrics arrive as per-device lanes (the
+                # zero-collective contract, engine/mesh.py): sum to the
+                # scalars the host-side consumers expect.
+                metrics = dict(metrics)
+                for k in SCALAR_METRIC_KEYS:
+                    red = jnp.max if k == "max_term" else jnp.sum
+                    metrics[k] = red(metrics[k])
+            else:
+                state, outbox, metrics = tick(
+                    cfg, self.state, self.inbox, new_cmds, tick_key
+                )
             if self.drop_prob > 0.0:
                 outbox = apply_faults(
                     outbox,
@@ -326,6 +375,9 @@ class EngineDriver:
             self.last_metrics = metrics
             if self.tracer:
                 commits = int(metrics["commits"])  # forces the sync
+                self.metrics.observe(
+                    "tick_wall_s", time.perf_counter() - t_wall
+                )
                 now_us = time.perf_counter() * 1e6
                 self.tracer.span(
                     "tick",
@@ -378,6 +430,9 @@ class EngineDriver:
         engine and services checkpoint at the same tick boundary."""
         blob = {
             "version": self.CKPT_VERSION,
+            "mesh_devices": (
+                int(self.mesh.devices.size) if self.mesh is not None else 0
+            ),
             "cfg": self.cfg,
             "state": {
                 k: np.asarray(v) for k, v in self.state._asdict().items()
@@ -411,15 +466,26 @@ class EngineDriver:
         return path
 
     @classmethod
-    def restore(cls, path: str) -> "EngineDriver":
+    def restore(cls, path: str, mesh=None) -> "EngineDriver":
         """Rebuild a driver from :meth:`save`.  The returned driver
         continues from the exact saved tick; the checkpoint's ``extra``
-        dict is available as ``driver.restored_extra``."""
+        dict is available as ``driver.restored_extra``.
+
+        A checkpoint taken from a mesh driver must be restored with a
+        ``mesh`` (same device count) — silently coming back
+        single-device would drop the sharding/zero-collective
+        guarantees and concentrate the full state on one chip."""
         with open(path, "rb") as f:
             blob = pickle.load(f)
         if blob.get("version") != cls.CKPT_VERSION:
             raise ValueError(
                 f"checkpoint version {blob.get('version')} != {cls.CKPT_VERSION}"
+            )
+        saved_mesh = blob.get("mesh_devices", 0)
+        if saved_mesh and mesh is None:
+            raise ValueError(
+                f"checkpoint was taken from a {saved_mesh}-device mesh "
+                f"driver; pass restore(..., mesh=) to re-shard it"
             )
         d = object.__new__(cls)  # skip __init__: no throwaway device state
         d._init_host(blob["cfg"], seed=0)
@@ -429,6 +495,13 @@ class EngineDriver:
         d.inbox = Mailbox(
             **{k: jnp.asarray(v) for k, v in blob["inbox"].items()}
         )
+        if mesh is not None:
+            from .mesh import make_sharded_tick, shard_arrays
+
+            d.mesh = mesh
+            d.state = shard_arrays(d.cfg, mesh, d.state)
+            d.inbox = shard_arrays(d.cfg, mesh, d.inbox)
+            d._mesh_tick = make_sharded_tick(d.cfg, mesh)
         d.tick = blob["tick"]
         d.key = jnp.asarray(blob["key"])
         d.backlog = blob["backlog"]
