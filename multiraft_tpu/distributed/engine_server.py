@@ -1,0 +1,356 @@
+"""The batched engine served over the real network — one process owns
+the chip; clerk RPCs come in over TCP and are coalesced into engine
+ticks (the first step of SURVEY §2.2's sidecar story: "clients talk to
+a thin RPC front; commands coalesce into the device firehose").
+
+Architecture (vs the per-replica sim/process stack in ``cluster.py``):
+
+* ``EngineKVService`` wraps a :class:`BatchedKV` on an
+  :class:`EngineDriver`.  A pump timer on the process's
+  ``RealtimeScheduler`` advances the device tick loop every couple of
+  milliseconds; every RPC that arrived since the last pump has already
+  queued its command into the per-group backlog, so one device step
+  carries *all* concurrent client traffic — the batching that makes a
+  single chip serve thousands of groups.
+* Writes ride the log with kvraft session dedup (``KVOp.client_id`` /
+  ``command_id``) so the at-least-once transport (client retries on
+  timeout) stays exactly-once.  Reads use the ReadIndex fast path
+  (zero device work, linearizable at the applied frontier).
+* ``EngineShardKVService`` is the sharded form: a
+  :class:`BatchedShardKV` behind the same front door, with server-side
+  key→shard routing against its replicated config and the clerk retry
+  semantics of the reference (ErrWrongGroup → re-route).
+
+Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
+:class:`~multiraft_tpu.distributed.tcp.RpcNode` frames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import zlib
+from typing import Any, Optional, Sequence
+
+from ..engine.core import EngineConfig
+from ..engine.host import EngineDriver
+from ..engine.kv import BatchedKV, KVOp
+from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+from ..sim.scheduler import TIMEOUT, Future
+from ..transport import codec
+from ..utils.ids import unique_client_id
+from .realtime import RealtimeScheduler
+from .tcp import RpcNode
+
+__all__ = [
+    "EngineCmdArgs",
+    "EngineCmdReply",
+    "EngineKVService",
+    "EngineShardKVService",
+    "EngineClerk",
+    "EngineShardNetClerk",
+    "serve_engine_kv",
+    "serve_engine_shardkv",
+]
+
+OK = "OK"
+ERR_TIMEOUT = "ErrTimeout"
+
+_OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
+
+
+@codec.registered
+@dataclasses.dataclass
+class EngineCmdArgs:
+    op: str = "Get"
+    key: str = ""
+    value: str = ""
+    client_id: int = 0
+    command_id: int = 0
+
+
+@codec.registered
+@dataclasses.dataclass
+class EngineCmdReply:
+    err: str = OK
+    value: str = ""
+
+
+def route_group(key: str, G: int) -> int:
+    """Deterministic key→group routing shared by every process (a
+    stable hash — Python's builtin is salted per process)."""
+    return zlib.crc32(key.encode()) % G
+
+
+class EngineKVService:
+    """``EngineKV.command`` RPC front for a :class:`BatchedKV`.
+
+    All device work happens on the scheduler loop: the pump timer and
+    the RPC handlers interleave there, so commands queued by handlers
+    between pumps coalesce into the next device step."""
+
+    # Handler-side patience before giving up on one submission and
+    # resubmitting (dedup makes the duplicate harmless) — covers
+    # tickets lost to leader changes.
+    RESUBMIT_S = 0.25
+    # Total per-RPC budget; the client retries after its own timeout.
+    DEADLINE_S = 3.0
+
+    def __init__(
+        self,
+        sched: RealtimeScheduler,
+        kv: BatchedKV,
+        pump_interval: float = 0.002,
+        ticks_per_pump: int = 2,
+    ) -> None:
+        self.sched = sched
+        self.kv = kv
+        self.G = kv.driver.cfg.G
+        self._interval = pump_interval
+        self._ticks = ticks_per_pump
+        self._stopped = False
+        sched.call_soon(self._pump_loop)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _pump_loop(self) -> None:
+        if self._stopped:
+            return
+        self.kv.pump(self._ticks)
+        self.sched.call_after(self._interval, self._pump_loop)
+
+    def command(self, args: EngineCmdArgs):
+        g = route_group(args.key, self.G)
+        if args.op == "Get":
+            # ReadIndex fast read: linearizable at the applied
+            # frontier, no log entry, immediate reply.
+            t = self.kv.get(g, args.key)
+            return EngineCmdReply(err=OK, value=t.value)
+
+        # Write path: generator handler — yields let the pump advance.
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                t = self.kv.submit(
+                    g,
+                    KVOp(
+                        op=_OPCODE[args.op],
+                        key=args.key,
+                        value=args.value,
+                        client_id=args.client_id,
+                        command_id=args.command_id,
+                    ),
+                )
+                sub_deadline = min(
+                    self.sched.now + self.RESUBMIT_S, deadline
+                )
+                while not t.done and self.sched.now < sub_deadline:
+                    yield 0.002
+                if t.done and not t.failed:
+                    return EngineCmdReply(err=OK, value=t.value)
+                # failed (evicted/orphaned) or wedged: resubmit under
+                # the same (client_id, command_id) — dedup-safe.
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+
+class EngineShardKVService:
+    """``EngineShardKV.command``: the sharded engine service behind the
+    same TCP front door.  Key→shard routing happens server-side against
+    the replicated config; WRONG_GROUP during migration re-routes like
+    the reference clerk (shardkv/client.go:68-129)."""
+
+    RESUBMIT_S = 0.25
+    DEADLINE_S = 5.0
+
+    def __init__(
+        self,
+        sched: RealtimeScheduler,
+        skv,  # BatchedShardKV
+        pump_interval: float = 0.002,
+        ticks_per_pump: int = 2,
+    ) -> None:
+        self.sched = sched
+        self.skv = skv
+        self._interval = pump_interval
+        self._ticks = ticks_per_pump
+        self._stopped = False
+        sched.call_soon(self._pump_loop)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _pump_loop(self) -> None:
+        if self._stopped:
+            return
+        self.skv.pump(self._ticks)
+        self.sched.call_after(self._interval, self._pump_loop)
+
+    def command(self, args: EngineCmdArgs):
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                cfg = self.skv.query_latest()
+                gid = cfg.shards[key2shard(args.key)]
+                if gid not in self.skv.reps:
+                    yield 0.01  # shard unassigned; config still moving
+                    continue
+                t = self.skv.submit(
+                    gid, args.op, args.key, args.value,
+                    client_id=args.client_id, command_id=args.command_id,
+                )
+                sub_deadline = min(
+                    self.sched.now + self.RESUBMIT_S, deadline
+                )
+                while not t.done and self.sched.now < sub_deadline:
+                    yield 0.002
+                if not t.done or t.failed or t.err == ERR_WRONG_GROUP:
+                    continue  # resubmit / re-route; dedup-safe
+                return EngineCmdReply(err=OK, value=t.value)
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+    ADMIN_OPS = ("join", "leave", "move")
+
+    def admin(self, args):
+        """Config administration: args = (kind, payload) with kind in
+        ADMIN_OPS — a network-supplied string must never getattr into
+        arbitrary methods."""
+        kind, payload = args
+        if kind not in self.ADMIN_OPS:
+            return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
+
+        def run():
+            t = getattr(self.skv, kind)(payload)
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if t.done:
+                    return EngineCmdReply(err=OK if not t.failed else ERR_TIMEOUT)
+                yield 0.005
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+
+class EngineClerk:
+    """Generator-coroutine client of an engine KV/shard server —
+    retry-until-answer with session dedup, mirroring the reference
+    clerk loop (kvraft/client.go:47-71) against the single front door."""
+
+    # Clerks are created from concurrent threads (one per blocking
+    # client); the counter allocation must be atomic or two clerks
+    # share a client_id and dedup silently drops one's writes.
+    _next = itertools.count(1)
+
+    def __init__(self, sched, end, service: str = "EngineKV") -> None:
+        self.sched = sched
+        self.end = end
+        self.service = service
+        self.client_id = unique_client_id(next(EngineClerk._next))
+        self.command_id = 0
+
+    def _command(self, op: str, key: str, value: str = ""):
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        while True:
+            fut: Future = self.end.call(f"{self.service}.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if (
+                reply is None
+                or reply is TIMEOUT
+                or reply.err != OK
+            ):
+                continue  # lost/timed out/old leader: retry (dedup-safe)
+            return reply.value
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+
+class EngineShardNetClerk(EngineClerk):
+    def __init__(self, sched, end) -> None:
+        super().__init__(sched, end, service="EngineShardKV")
+
+
+def serve_engine_kv(
+    port: int,
+    G: int = 64,
+    host: str = "127.0.0.1",
+    seed: int = 0,
+    record_groups: Optional[Sequence[int]] = None,
+) -> RpcNode:
+    """Bring up the chip-owning engine KV server process: one
+    EngineDriver (G groups), a BatchedKV, the pump loop, and a
+    listening RpcNode.  Returns the node (caller keeps the process
+    alive)."""
+    sched = RealtimeScheduler()
+    node = RpcNode(sched, listen=True, host=host, port=port)
+
+    def build():
+        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
+        driver = EngineDriver(cfg, seed=seed)
+        kv = BatchedKV(driver, record_groups=list(record_groups or []))
+        # Warm-up BEFORE the readiness line: elect leaders and compile
+        # both tick variants (quiet + loaded).  The first jit compile
+        # takes tens of seconds and runs on the scheduler loop — doing
+        # it lazily would starve RPC dispatch and time out every early
+        # client (observed: all first ops stall ~10s on CPU).
+        driver.run_until_quiet_leaders(2000)
+        driver.start(0, (KVOp(op=OP_GET, key=""), None))
+        for _ in range(8):
+            kv.pump(1)
+        return EngineKVService(sched, kv)
+
+    svc = sched.run_call(build, timeout=600.0)
+    node.add_service("EngineKV", svc)
+    node.engine_service = svc  # keep reachable for introspection
+    return node
+
+
+def serve_engine_shardkv(
+    port: int,
+    G: int = 4,
+    host: str = "127.0.0.1",
+    seed: int = 0,
+    join_gids: Optional[Sequence[int]] = None,
+) -> RpcNode:
+    """The sharded engine behind TCP: BatchedShardKV (replicated config
+    + per-shard migration pipeline) on one chip-owning process."""
+    from ..engine.shardkv import BatchedShardKV
+
+    sched = RealtimeScheduler()
+    node = RpcNode(sched, listen=True, host=host, port=port)
+
+    def build():
+        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
+        driver = EngineDriver(cfg, seed=seed)
+        # Warm-up before readiness (see serve_engine_kv): elections +
+        # both tick compiles happen here, not under client traffic —
+        # the admin_sync join exercises the loaded variant.
+        ok = driver.run_until_quiet_leaders(2000)
+        assert ok, "engine groups failed to elect"
+        skv = BatchedShardKV(driver)
+        for gid in join_gids or []:
+            skv.admin_sync("join", [gid])
+        return EngineShardKVService(sched, skv)
+
+    svc = sched.run_call(build, timeout=600.0)
+    node.add_service("EngineShardKV", svc)
+    node.engine_service = svc
+    return node

@@ -32,6 +32,14 @@ class KVOp:
     op: int = OP_GET  # porcupine op codes
     key: str = ""
     value: str = ""
+    # Session dedup (kvraft semantics, reference: kvraft/server.go
+    # lastApplied map): command_id > 0 makes a Put/Append exactly-once
+    # per client even when the caller resubmits after a lost leader —
+    # required by any at-least-once transport (the TCP serving path).
+    # 0 = no dedup (trusted single-submit callers, e.g. the bench
+    # firehose and in-process tests).
+    client_id: int = 0
+    command_id: int = 0
 
 
 @dataclasses.dataclass
@@ -56,6 +64,9 @@ class BatchedKV(FrontierService):
         super().__init__(driver)
         G = driver.cfg.G
         self.data: List[Dict[str, str]] = [dict() for _ in range(G)]
+        # Per-group client sessions: client_id -> (last command_id).
+        # Writes at or below it are duplicates and must not re-apply.
+        self.sessions: List[Dict[int, int]] = [dict() for _ in range(G)]
         self._record = set(record_groups or [])
         self.histories: Dict[int, List[Operation]] = {
             g: [] for g in self._record
@@ -135,14 +146,23 @@ class BatchedKV(FrontierService):
             return  # command lost to a leader change before binding
         op, ticket = payload
         kv = self.data[g]
+        dup = (
+            op.op != OP_GET
+            and op.command_id > 0
+            and self.sessions[g].get(op.client_id, 0) >= op.command_id
+        )
         if op.op == OP_GET:
             out = kv.get(op.key, "")
+        elif dup:
+            out = ""  # duplicate write: resolve the ticket, skip the apply
         elif op.op == OP_PUT:
             kv[op.key] = op.value
             out = ""
         else:
             kv[op.key] = kv.get(op.key, "") + op.value
             out = ""
+        if op.op != OP_GET and op.command_id > 0 and not dup:
+            self.sessions[g][op.client_id] = op.command_id
         if ticket is not None and not ticket.done:
             ticket.done = True
             ticket.value = out
@@ -162,12 +182,16 @@ class BatchedKV(FrontierService):
     def state_dict(self) -> Dict[str, Any]:
         blob = super().state_dict()
         blob["data"] = [dict(m) for m in self.data]
+        blob["sessions"] = [dict(m) for m in self.sessions]
         blob["histories"] = {g: list(h) for g, h in self.histories.items()}
         return blob
 
     def load_state_dict(self, blob: Dict[str, Any]) -> None:
         super().load_state_dict(blob)
         self.data = [dict(m) for m in blob["data"]]
+        self.sessions = [dict(m) for m in blob.get("sessions", [])] or [
+            dict() for _ in self.data
+        ]
         self.histories = {g: list(h) for g, h in blob["histories"].items()}
         self._record = set(self.histories.keys())
 
