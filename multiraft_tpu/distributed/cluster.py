@@ -127,8 +127,8 @@ def serve_kv(
     the RpcNode (caller keeps the process alive)."""
     from ..services.kvraft import KVServer
 
-    sched = RealtimeScheduler()
-    node = RpcNode(sched, listen=True, host=host, port=ports[me])
+    node = RpcNode(listen=True, host=host, port=ports[me])
+    sched = node.sched
     ends = [node.client_end(host, p) for p in ports]
     persister = DiskPersister(os.path.join(data_dir, f"server-{me}"))
 
@@ -156,8 +156,8 @@ def serve_ctrler(
     reference: shardctrler/server.go:164-182 — over real sockets)."""
     from ..services.shardctrler import ShardCtrler
 
-    sched = RealtimeScheduler()
-    node = RpcNode(sched, listen=True, host=host, port=ports[me])
+    node = RpcNode(listen=True, host=host, port=ports[me])
+    sched = node.sched
     ends = [node.client_end(host, p) for p in ports]
     persister = DiskPersister(os.path.join(data_dir, f"ctrler-{me}"))
     srv = sched.run_call(
@@ -183,8 +183,8 @@ def serve_shardkv(
     TCP ends so groups pull shards from each other across processes)."""
     from ..services.shardkv import ShardKVServer
 
-    sched = RealtimeScheduler()
-    node = RpcNode(sched, listen=True, host=host, port=group_ports[me])
+    node = RpcNode(listen=True, host=host, port=group_ports[me])
+    sched = node.sched
     ends = [node.client_end(host, p) for p in group_ports]
     ctrler_ends = [node.client_end(host, p) for p in ctrler_ports]
     persister = DiskPersister(os.path.join(data_dir, f"g{gid}-{me}"))
@@ -283,7 +283,6 @@ class _BlockingClerkBase:
     sched: RealtimeScheduler
     node: RpcNode
     _clerk: Any
-    _owns_sched: bool = True
 
     def _run(self, gen, timeout: float) -> Any:
         fut = self.sched.spawn(gen)
@@ -307,11 +306,8 @@ class _BlockingClerkBase:
         self._run(self._clerk.append(key, value), timeout)
 
     def close(self) -> None:
-        """Close the RPC node and, when this clerk created its own
-        scheduler, stop its loop thread too (one call cleans up)."""
+        """Close the RPC node (its scheduler loop stops with it)."""
         self.node.close()
-        if self._owns_sched:
-            self.sched.stop()
 
 
 class BlockingClerk(_BlockingClerkBase):
@@ -319,14 +315,12 @@ class BlockingClerk(_BlockingClerkBase):
 
     def __init__(
         self, ports: Sequence[int], host: str = "127.0.0.1",
-        sched: Optional[RealtimeScheduler] = None,
         node: Optional[RpcNode] = None,
     ) -> None:
         from ..services.kvraft import Clerk
 
-        self._owns_sched = sched is None
-        self.sched = sched or RealtimeScheduler()
-        self.node = node or RpcNode(self.sched)
+        self.node = node or RpcNode()
+        self.sched = self.node.sched
         ends = [self.node.client_end(host, p) for p in ports]
         self._clerk = Clerk(self.sched, ends)
 
@@ -341,8 +335,8 @@ class BlockingShardClerk(_BlockingClerkBase):
     ) -> None:
         from ..services.shardkv import ShardClerk
 
-        self.sched = RealtimeScheduler()
-        self.node = RpcNode(self.sched)
+        self.node = RpcNode()
+        self.sched = self.node.sched
         ctrler_ends = [self.node.client_end(host, p) for p in ctrler_ports]
         self._clerk = ShardClerk(
             self.sched, ctrler_ends, lambda name: _addr_end(self.node, name)
@@ -466,8 +460,8 @@ class BlockingEngineClerk(_BlockingClerkBase):
     ) -> None:
         from .engine_server import EngineClerk
 
-        self.sched = RealtimeScheduler()
-        self.node = RpcNode(self.sched)
+        self.node = RpcNode()
+        self.sched = self.node.sched
         end = self.node.client_end(host, port)
         self._clerk = EngineClerk(self.sched, end, service=service)
 
@@ -578,8 +572,8 @@ class ShardKVProcessCluster:
         from ..services.shardctrler import CtrlerClerk
 
         if self._admin_sched is None:
-            self._admin_sched = RealtimeScheduler()
-            self._admin_node = RpcNode(self._admin_sched)
+            self._admin_node = RpcNode()
+            self._admin_sched = self._admin_node.sched
             self._admin_ck = CtrlerClerk(
                 self._admin_sched,
                 [self._admin_node.client_end(self.host, p)

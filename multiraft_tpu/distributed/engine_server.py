@@ -192,6 +192,24 @@ class EngineShardKVService:
         from ..engine.shardkv import ERR_WRONG_GROUP
         from ..services.shardkv import key2shard
 
+        if args.op == "Get":
+            # ReadIndex fast read (BatchedShardKV.get_fast): no log
+            # entry, gated on serving-shard ownership exactly like the
+            # logged path; ErrWrongGroup during migration pumps and
+            # retries like any clerk op.
+            def run_get():
+                deadline = self.sched.now + self.DEADLINE_S
+                while self.sched.now < deadline:
+                    t = self.skv.get_fast(args.key)
+                    if t.err == ERR_WRONG_GROUP:
+                        yield 0.01  # config moving; shard not serving here
+                        continue
+                    value = t.value if t.err == OK else ""
+                    return EngineCmdReply(err=OK, value=value)
+                return EngineCmdReply(err=ERR_TIMEOUT)
+
+            return run_get()
+
         def run():
             deadline = self.sched.now + self.DEADLINE_S
             while self.sched.now < deadline:
@@ -227,7 +245,12 @@ class EngineShardKVService:
             return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
 
         def run():
-            t = getattr(self.skv, kind)(payload)
+            # join/leave take their payload whole (a gid list / mapping);
+            # move takes (shard, gid) as two positionals.
+            if kind == "move":
+                t = self.skv.move(*payload)
+            else:
+                t = getattr(self.skv, kind)(payload)
             deadline = self.sched.now + self.DEADLINE_S
             while self.sched.now < deadline:
                 if t.done:
@@ -299,8 +322,8 @@ def serve_engine_kv(
     EngineDriver (G groups), a BatchedKV, the pump loop, and a
     listening RpcNode.  Returns the node (caller keeps the process
     alive)."""
-    sched = RealtimeScheduler()
-    node = RpcNode(sched, listen=True, host=host, port=port)
+    node = RpcNode(listen=True, host=host, port=port)
+    sched = node.sched
 
     def build():
         cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
@@ -334,8 +357,8 @@ def serve_engine_shardkv(
     + per-shard migration pipeline) on one chip-owning process."""
     from ..engine.shardkv import BatchedShardKV
 
-    sched = RealtimeScheduler()
-    node = RpcNode(sched, listen=True, host=host, port=port)
+    node = RpcNode(listen=True, host=host, port=port)
+    sched = node.sched
 
     def build():
         cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)

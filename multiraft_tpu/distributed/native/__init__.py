@@ -46,11 +46,15 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.mrt_connect.restype = ctypes.c_int64
             lib.mrt_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
             lib.mrt_send.restype = ctypes.c_int
+            # c_char_p: bytes pass their buffer pointer straight through
+            # (no copy) — safe because mrt_send consumes synchronously.
             lib.mrt_send.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
             ]
             lib.mrt_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.mrt_wake.argtypes = [ctypes.c_void_p]
+            lib.mrt_set_spin.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.mrt_poll.restype = ctypes.c_int64
             lib.mrt_poll.argtypes = [
                 ctypes.c_void_p,
@@ -72,12 +76,19 @@ def native_available() -> bool:
 
 
 class NativeTransport:
-    """One epoll IO loop: listener + outbound connections + event queue.
+    """One framed-TCP endpoint: listener + outbound connections.
 
-    Thread contract: ``send``/``connect``/``close_conn`` are safe from
-    any thread (serialized against ``close`` by a lock).  ``poll`` is
-    owned by one dispatcher thread, and the owner must stop polling
-    before calling ``close`` — ``RpcNode`` joins its poller first.
+    The calling thread of :meth:`poll` IS the read reactor — epoll_wait
+    and frame parsing run inline, and idle-connection sends write
+    inline on the sender's thread, so a serial RPC crosses zero futex
+    handoffs inside the transport (see transport.cpp's header).
+
+    Thread contract: ``send``/``connect``/``close_conn``/``wake`` are
+    safe from any thread (serialized against ``close`` by a lock).
+    ``poll`` is owned by one dispatcher thread, and the owner must stop
+    polling before calling ``close``.  :meth:`wake` interrupts a
+    blocked :meth:`poll` (it returns ``None`` early) — the hook that
+    lets a scheduler loop double as the IO dispatcher.
     """
 
     def __init__(self, buf_size: int = 1 << 20) -> None:
@@ -89,6 +100,9 @@ class NativeTransport:
         self._lock = threading.Lock()
         self._buf = (ctypes.c_uint8 * buf_size)()
         self._cap = buf_size
+        # poll() is single-threaded by contract — reuse the out-params.
+        self._pconn = ctypes.c_int64()
+        self._ptyp = ctypes.c_int()
 
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Bind+listen; returns the bound port (ephemeral for port 0)."""
@@ -112,25 +126,43 @@ class NativeTransport:
         return cid
 
     def send(self, conn: int, data: bytes) -> bool:
-        arr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        # Senders may be any thread, so close() must not free the C++
+        # Transport under a concurrent send — the lock stays (it is an
+        # uncontended ~0.1 µs on the hot path; the big costs were the
+        # frame copy and the thread handoffs, both gone).
         with self._lock:
             if self._h is None:
                 return False
-            return self._lib.mrt_send(self._h, conn, arr, len(data)) == 0
+            return self._lib.mrt_send(self._h, conn, data, len(data)) == 0
+
+    def set_spin(self, us: int) -> None:
+        """Busy-poll budget (µs) before :meth:`poll` blocks — trades a
+        sliver of CPU for removing both futex wakes from an active
+        round trip.  0 disables (the default)."""
+        with self._lock:
+            if self._h is not None:
+                self._lib.mrt_set_spin(self._h, int(us))
 
     def close_conn(self, conn: int) -> None:
         with self._lock:
             if self._h is not None:
                 self._lib.mrt_close(self._h, conn)
 
+    def wake(self) -> None:
+        """Interrupt a blocked :meth:`poll` (it returns ``None``)."""
+        with self._lock:
+            if self._h is not None:
+                self._lib.mrt_wake(self._h)
+
     def poll(self, timeout: float) -> Optional[Tuple[int, int, bytes]]:
-        """Next event as ``(conn_id, type, payload)`` or None on timeout."""
-        if self._h is None:
+        """Next event as ``(conn_id, type, payload)``, or None on
+        timeout or :meth:`wake`."""
+        h = self._h
+        if h is None:
             return None
-        conn = ctypes.c_int64()
-        typ = ctypes.c_int()
+        conn, typ = self._pconn, self._ptyp
         n = self._lib.mrt_poll(
-            self._h, ctypes.byref(conn), ctypes.byref(typ),
+            h, ctypes.byref(conn), ctypes.byref(typ),
             self._buf, self._cap, int(timeout * 1000),
         )
         if n < 0:
@@ -139,7 +171,7 @@ class NativeTransport:
             self._cap = int(n)
             self._buf = (ctypes.c_uint8 * self._cap)()
             return self.poll(timeout)
-        return conn.value, typ.value, bytes(self._buf[: int(n)])
+        return conn.value, typ.value, ctypes.string_at(self._buf, int(n))
 
     def close(self) -> None:
         with self._lock:

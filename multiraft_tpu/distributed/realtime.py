@@ -26,7 +26,7 @@ from typing import Any, Callable, Generator, Optional
 
 from ..sim.scheduler import TIMEOUT, Future, Timer
 
-__all__ = ["RealtimeScheduler"]
+__all__ = ["RealtimeScheduler", "IoScheduler"]
 
 
 class RealtimeScheduler:
@@ -112,7 +112,11 @@ class RealtimeScheduler:
                 result.resolve(stop.value)
                 return
             if isinstance(waited, Future):
-                waited.add_done_callback(lambda f: self.post(step, f.value))
+                # Step inline on resolution — the sim Scheduler's exact
+                # semantics (sim/scheduler.py spawn).  Safe because every
+                # resolve already runs on the loop thread; posting would
+                # add a heap round trip per coroutine step.
+                waited.add_done_callback(lambda f: step(f.value))
             elif isinstance(waited, (int, float)):
                 self.call_after(float(waited), step, None)
             else:  # pragma: no cover - defensive
@@ -209,3 +213,99 @@ class RealtimeScheduler:
                 import traceback
 
                 traceback.print_exc()
+
+
+class IoScheduler(RealtimeScheduler):
+    """A :class:`RealtimeScheduler` whose loop thread is ALSO the IO
+    dispatcher: instead of sleeping on a condition variable between
+    timers, it blocks in ``io_poll`` (the native transport's inline
+    epoll reactor) and handles each event with ``io_handle`` right on
+    the loop thread.
+
+    This erases the sim-era thread topology's latency tax.  With a
+    separate poller thread, every inbound frame costs two futex
+    handoffs (transport → poller condvar, poller → loop ``post``);
+    here a frame goes kernel → loop thread → handler inline, so a
+    serial RPC round trip crosses exactly one wakeup per process.
+
+    ``io_wake`` must interrupt a blocked ``io_poll`` (it returns
+    ``None``); cross-thread ``call_at``/``post``/``stop`` use it in
+    place of the condvar notify.  Wakes are level-triggered in the
+    transport (an eventfd counter), so a wake that lands before the
+    poll starts is not lost.
+    """
+
+    def __init__(
+        self,
+        io_poll: Callable[[float], Any],
+        io_handle: Callable[[Any], None],
+        io_wake: Callable[[], None],
+        idle_max: float = 0.2,
+    ) -> None:
+        self._io_poll = io_poll
+        self._io_handle = io_handle
+        self._io_wake = io_wake
+        self._idle_max = idle_max
+        super().__init__()
+
+    def call_at(self, when: float, fn: Callable, *args: Any) -> Timer:
+        timer = Timer(when, fn, args)
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._heap, (when, self._seq, timer))
+        # The loop blocks in io_poll, not on the condvar — interrupt it
+        # unless we ARE the loop (it re-checks the heap after every
+        # callback and IO event anyway, so a self-wake is pure syscall
+        # overhead on the hot path).
+        if threading.current_thread() is not self._thread:
+            self._io_wake()
+        return timer
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+        self._io_wake()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            fn = args = None
+            popped = False
+            with self._lock:
+                if self._stopped:
+                    return
+                delay = self._idle_max
+                while self._heap:
+                    when, _, timer = self._heap[0]
+                    if timer.cancelled:
+                        heapq.heappop(self._heap)
+                        continue
+                    d = when - self.now
+                    if d <= 0:
+                        heapq.heappop(self._heap)
+                        fn, args = timer._fn, timer._args
+                        timer._fn, timer._args = None, ()
+                        popped = True
+                    else:
+                        delay = min(d, self._idle_max)
+                    break
+            if popped:
+                if fn is not None:  # else cancelled between push and pop
+                    self.fired_events += 1
+                    try:
+                        fn(*args)
+                    except Exception:  # pragma: no cover - keep loop alive
+                        import traceback
+
+                        traceback.print_exc()
+                continue
+            ev = self._io_poll(delay)
+            if ev is not None:
+                self.fired_events += 1
+                try:
+                    self._io_handle(ev)
+                except Exception:  # pragma: no cover - keep the loop alive
+                    import traceback
+
+                    traceback.print_exc()

@@ -4,10 +4,15 @@ Exposes the same contract the simulated network gives the framework —
 ``ClientEnd.call(svc_meth, args) → Future`` with ``None`` meaning "RPC
 failed" (labrpc's boolean ``ok``, reference: labrpc/labrpc.go:87-126) —
 but across real processes.  One :class:`RpcNode` per process owns one
-epoll transport, one dispatcher thread, and the process's
-``RealtimeScheduler``; every handler and future resolution runs on the
-scheduler loop, so RaftNode/KVServer/clerk code is byte-identical
-between sim and deployment.
+epoll transport and one :class:`IoScheduler` whose loop thread IS the
+IO dispatcher: the transport's read reactor runs inline as the loop's
+idle wait, and every handler and future resolution runs on that same
+thread — so RaftNode/KVServer/clerk code is byte-identical between sim
+and deployment, and an inbound frame reaches its handler with zero
+futex handoffs (kernel wakes the loop, the loop decodes and
+dispatches).  Replies write inline from the loop thread (the
+transport's idle-connection fast path), so a serial RPC round trip
+costs two socket wakeups total.
 
 Frames are codec-encoded tuples:
 
@@ -32,7 +37,7 @@ from typing import Any, Dict, Optional, Tuple
 from ..sim.scheduler import Future
 from ..transport import codec
 from .native import EV_CLOSED, EV_FRAME, NativeTransport
-from .realtime import RealtimeScheduler
+from .realtime import IoScheduler
 
 __all__ = ["RpcNode", "TcpClientEnd"]
 
@@ -53,26 +58,33 @@ class RpcNode:
 
     def __init__(
         self,
-        sched: Optional[RealtimeScheduler] = None,
         listen: bool = False,
         host: str = "127.0.0.1",
         port: int = 0,
     ) -> None:
-        self.sched = sched or RealtimeScheduler()
         self._tr = NativeTransport()
         self.host, self.port = host, 0
         if listen:
             self.port = self._tr.listen(host, port)
         self._services: Dict[str, Any] = {}
+        self._handlers: Dict[str, Any] = {}  # "Svc.Meth" → bound method
         self._req_ids = itertools.count(1)
         self._lock = threading.Lock()
         self._pending: Dict[int, Tuple[int, Future]] = {}  # req_id → (conn, fut)
         self._conns: Dict[Tuple[str, int], int] = {}  # addr → conn id
         self._closed = False
-        self._poller = threading.Thread(
-            target=self._poll_loop, name="mrt-rpc-poll", daemon=True
-        )
-        self._poller.start()
+        # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
+        self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
+        # Adaptive busy-poll: a serial RPC's next event lands tens of
+        # µs out, so spinning that long before blocking removes the
+        # futex wake from the round trip.  Pointless (and harmful —
+        # the spinner starves the peer) on a single-CPU box, so the
+        # default is gated on core count.  MRT_SPIN_US overrides.
+        default_spin = "40" if (os.cpu_count() or 1) > 1 else "0"
+        self._tr.set_spin(int(os.environ.get("MRT_SPIN_US", default_spin)))
+        # The loop thread doubles as the transport's read reactor; it
+        # owns all handler execution and future resolution.
+        self.sched = IoScheduler(self._tr.poll, self._on_event, self._tr.wake)
 
     # -- service side ------------------------------------------------------
 
@@ -81,6 +93,11 @@ class RpcNode:
         ``obj.method`` (CamelCase RPC names map via lowercase_underscore,
         mirroring the sim network's Service dispatch)."""
         self._services[name] = obj
+        # Drop cached handlers bound to a previously registered object.
+        self._handlers = {
+            k: v for k, v in self._handlers.items()
+            if not k.startswith(name + ".")
+        }
 
     def client_end(self, host: str, port: int) -> TcpClientEnd:
         return TcpClientEnd(self, host, port)
@@ -127,47 +144,41 @@ class RpcNode:
             self.sched.call_soon(fut.resolve, None)
         return fut
 
-    def _poll_loop(self) -> None:
-        # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
-        dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
-        while not self._closed:
-            ev = self._tr.poll(0.2)
-            if ev is None:
-                continue
-            conn, typ, payload = ev
-            if typ == EV_FRAME:
-                # One malformed frame must never kill the poller thread —
-                # the node would go permanently dark.  Shape errors
-                # (IndexError on msg[...]) are as fatal as decode errors.
-                try:
-                    msg = codec.decode(payload)
-                    if dbg:
-                        # Tracing must never affect delivery: a repr or
-                        # stderr failure here is swallowed, not treated
-                        # as a bad frame.
-                        try:
-                            head = f"{msg[0]} conn={conn} " + (
-                                f"{msg[2]} {msg[3]!r}" if msg[0] == "req" else f"{msg[2]!r}"
-                            )
-                            print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
-                        except Exception:
-                            pass
-                    if msg[0] == "req":
-                        _, req_id, svc_meth, args = msg
-                        self.sched.post(self._dispatch, conn, req_id, svc_meth, args)
-                    elif msg[0] == "rep":
-                        _, req_id, value = msg
-                        with self._lock:
-                            entry = self._pending.pop(req_id, None)
-                        if entry is not None:
-                            self.sched.post(entry[1].resolve, value)
-                except Exception as exc:
-                    if dbg:
-                        print(f"[rpc] bad frame dropped: {exc!r}",
-                              file=sys.stderr, flush=True)
-                    continue
-            elif typ == EV_CLOSED:
-                self._on_closed(conn)
+    def _on_event(self, ev: Tuple[int, int, bytes]) -> None:
+        # Runs on the scheduler loop (the IO reactor thread).
+        conn, typ, payload = ev
+        if typ == EV_FRAME:
+            # One malformed frame must never kill the loop — the node
+            # would go permanently dark.  Shape errors (IndexError on
+            # msg[...]) are as fatal as decode errors.
+            try:
+                msg = codec.decode(payload)
+                if self._dbg:
+                    # Tracing must never affect delivery: a repr or
+                    # stderr failure here is swallowed, not treated
+                    # as a bad frame.
+                    try:
+                        head = f"{msg[0]} conn={conn} " + (
+                            f"{msg[2]} {msg[3]!r}" if msg[0] == "req" else f"{msg[2]!r}"
+                        )
+                        print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
+                    except Exception:
+                        pass
+                if msg[0] == "req":
+                    _, req_id, svc_meth, args = msg
+                    self._dispatch(conn, req_id, svc_meth, args)
+                elif msg[0] == "rep":
+                    _, req_id, value = msg
+                    with self._lock:
+                        entry = self._pending.pop(req_id, None)
+                    if entry is not None:
+                        entry[1].resolve(value)
+            except Exception as exc:
+                if self._dbg:
+                    print(f"[rpc] bad frame dropped: {exc!r}",
+                          file=sys.stderr, flush=True)
+        elif typ == EV_CLOSED:
+            self._on_closed(conn)
 
     def _on_closed(self, conn: int) -> None:
         with self._lock:
@@ -182,15 +193,17 @@ class RpcNode:
             for rid, _ in dead:
                 del self._pending[rid]
         for _, fut in dead:
-            self.sched.post(fut.resolve, None)
+            fut.resolve(None)
 
     def _dispatch(self, conn: int, req_id: int, svc_meth: str, args: Any) -> None:
         # Runs on the scheduler loop.
         try:
-            svc_name, meth = svc_meth.split(".", 1)
-            obj = self._services[svc_name]
-            py_name = _snake(meth)
-            handler = getattr(obj, py_name)
+            handler = self._handlers.get(svc_meth)
+            if handler is None:
+                svc_name, meth = svc_meth.split(".", 1)
+                obj = self._services[svc_name]
+                handler = getattr(obj, _snake(meth))
+                self._handlers[svc_meth] = handler
             result = handler(args)
         except Exception:
             result = None
@@ -212,8 +225,12 @@ class RpcNode:
             pass
 
     def close(self) -> None:
+        """Stop the scheduler loop (joining the reactor thread), then
+        tear down the transport.  Idempotent."""
+        if self._closed:
+            return
         self._closed = True
-        self._poller.join(timeout=2.0)
+        self.sched.stop()
         self._tr.close()
 
 

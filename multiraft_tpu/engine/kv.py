@@ -168,14 +168,19 @@ class BatchedKV(FrontierService):
             ticket.value = out
             ticket.index = idx
             ticket.done_tick = now
-            # Tickets resolve at the apply readback.
-            self._record_op(
-                g,
-                KvInput(op=op.op, key=op.key, value=op.value),
-                out,
-                ticket.submit_tick,
-                now,
-            )
+            # Tickets resolve at the apply readback.  A dup-suppressed
+            # write is NOT recorded: the logical op was already recorded
+            # when its first incarnation applied, and a second Operation
+            # for one state change would make porcupine reject a correct
+            # history (resubmit-under-same-command_id path).
+            if not dup:
+                self._record_op(
+                    g,
+                    KvInput(op=op.op, key=op.key, value=op.value),
+                    out,
+                    ticket.submit_tick,
+                    now,
+                )
 
     # -- checkpoint -------------------------------------------------------
 
