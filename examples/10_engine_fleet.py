@@ -1,0 +1,57 @@
+"""An engine FLEET: several chip-owning processes, one global keyspace.
+
+Each process runs its own batched engine (consensus on device across
+its (G, P) lanes) and hosts a subset of the global replica-group space;
+a replicated config — mirrored admin ops through every process's
+config RSM — routes each shard to its owning process.  Shard migration
+crosses the real network: the new owner pulls the shard blob with a
+``pull_shard`` RPC and the old owner deletes it through its own log
+(``delete_shard`` — Challenge 1 across processes).  Clerks route
+key→shard→gid→process and re-route on ErrWrongGroup, the reference's
+clerk loop (shardkv/client.go:68-129) where each "group" is a chip.
+
+This is SURVEY §2.2's end state at the process level: chip↔chip work
+stays on each device, node↔node traffic (client ops, shard blobs,
+config admin) rides TCP.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multiraft_tpu.distributed.cluster import EngineFleetCluster
+
+
+def main() -> None:
+    fleet = EngineFleetCluster([[1], [2]], seed=11)
+    print("starting 2 chip-owning engine processes (gid 1 | gid 2)...")
+    fleet.start_all()
+    try:
+        print("joining gid 1 (all shards land on process 0)")
+        fleet.admin("join", [1])
+        clerk = fleet.clerk()
+        data = {chr(97 + i): f"value-{i}" for i in range(10)}
+        for k, v in data.items():
+            clerk.put(k, v)
+        print(f"  wrote {len(data)} keys through the fleet clerk")
+
+        print("joining gid 2 — ~half the shards now MIGRATE to process 1")
+        fleet.admin("join", [2])
+        survived = sum(1 for k, v in data.items() if clerk.get(k) == v)
+        print(f"  {survived}/{len(data)} keys intact across the "
+              "cross-process migration")
+        assert survived == len(data)
+
+        for k in data:
+            clerk.append(k, "+fleet")
+        assert all(clerk.get(k) == v + "+fleet" for k, v in data.items())
+        print("  appends after migration land at the new owners: OK")
+        clerk.close()
+    finally:
+        fleet.shutdown()
+    print("fleet example complete")
+
+
+if __name__ == "__main__":
+    main()

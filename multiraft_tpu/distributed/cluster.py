@@ -31,7 +31,9 @@ __all__ = [
     "serve_ctrler",
     "serve_shardkv",
     "EngineProcessCluster",
+    "EngineFleetCluster",
     "BlockingEngineClerk",
+    "BlockingFleetClerk",
     "KVProcessCluster",
     "ShardKVProcessCluster",
     "BlockingClerk",
@@ -267,6 +269,20 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             seed=spec.get("seed", 0),
             join_gids=spec.get("join_gids"),
         )
+    elif kind == "engine_fleet":
+        _pin_platform(spec)
+        from .engine_server import serve_engine_shardkv
+
+        node = serve_engine_shardkv(
+            port=spec["ports"][0],
+            seed=spec.get("seed", 0),
+            gids=spec["gids"],
+            # JSON round trip stringifies gid keys and listifies tuples.
+            peer_addrs={
+                int(g): (a[0], int(a[1]))
+                for g, a in spec["peer_addrs"].items()
+            },
+        )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
     print(f"ready {node.port}", flush=True)
@@ -449,6 +465,130 @@ class EngineProcessCluster:
             self.proc.kill()
             self.proc.wait()
         self.proc = None
+
+
+class EngineFleetCluster:
+    """Several chip-owning engine shard processes splitting one global
+    gid space — SURVEY §2.2's end state at the process level: clerk
+    traffic and shard migration ride the real network BETWEEN engines,
+    consensus stays on each process's device.
+
+    ``assignment[i]`` is the gid list process ``i`` hosts.  Admin ops
+    are mirrored to every process in issue order with an explicit
+    command id, so retries cannot fork the fleet's config histories.
+    """
+
+    def __init__(
+        self,
+        assignment: Sequence[Sequence[int]],
+        host: str = "127.0.0.1",
+        seed: int = 0,
+    ) -> None:
+        # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
+        # codec — admin replies are refused as unregistered otherwise.
+        from . import engine_server  # noqa: F401
+
+        self.host = host
+        self.assignment = [list(g) for g in assignment]
+        self.ports = _reserve_ports(len(self.assignment), host)
+        self.owner_addrs = {}
+        for i, gl in enumerate(self.assignment):
+            for g in gl:
+                self.owner_addrs[g] = (host, self.ports[i])
+        self.specs = []
+        for i, gl in enumerate(self.assignment):
+            self.specs.append({
+                "kind": "engine_fleet",
+                "ports": [self.ports[i]],
+                "gids": gl,
+                "peer_addrs": {
+                    str(g): list(a) for g, a in self.owner_addrs.items()
+                    if g not in gl
+                },
+                "seed": seed + i,
+                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+            })
+        self.procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
+        self._admin_node: Optional[RpcNode] = None
+        self._admin_cmd = 0
+        self._admin_inflight = None  # ((kind, repr(arg)), cmd) being retried
+
+    def start_all(self) -> None:
+        # Launch all processes first (jit warm-up dominates and runs in
+        # parallel), then collect readiness lines.
+        for i, spec in enumerate(self.specs):
+            self.procs[i] = _launch_server(spec, f"fleet-{i}")
+        for i, p in enumerate(self.procs):
+            _check_ready(p, f"fleet-{i}", timeout=300.0)
+
+    def admin(self, kind: str, arg: Any, timeout: float = 60.0) -> None:
+        """Mirror one config op to every process (same order, same
+        command id → identical config histories; see the service's
+        ``admin`` docstring for why the id is mandatory here).
+
+        Retryable after a TimeoutError: re-issuing the SAME (kind, arg)
+        reuses the interrupted attempt's command id, so processes that
+        already applied it dedup instead of applying twice (a fresh id
+        on retry would fork the fleet's config numbering)."""
+        if self._admin_node is None:
+            self._admin_node = RpcNode()
+        op_key = (kind, repr(arg))
+        if self._admin_inflight and self._admin_inflight[0] == op_key:
+            cmd = self._admin_inflight[1]  # resume the interrupted op
+        else:
+            self._admin_cmd += 1
+            cmd = self._admin_cmd
+            self._admin_inflight = (op_key, cmd)
+        sched = self._admin_node.sched
+        deadline = time.monotonic() + timeout
+        for port in self.ports:
+            end = self._admin_node.client_end(self.host, port)
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"fleet admin {kind} timed out")
+                reply = sched.wait(
+                    end.call("EngineShardKV.admin", (kind, arg, cmd)),
+                    6.0,
+                )
+                if (
+                    reply is not None
+                    and reply is not TIMEOUT
+                    and getattr(reply, "err", None) == "OK"
+                ):
+                    break  # committed on this process; next one
+        self._admin_inflight = None
+
+    def clerk(self) -> "BlockingFleetClerk":
+        return BlockingFleetClerk(self.owner_addrs)
+
+    def shutdown(self) -> None:
+        if self._admin_node is not None:
+            self._admin_node.close()
+            self._admin_node = None
+        for i, p in enumerate(self.procs):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            self.procs[i] = None
+
+
+class BlockingFleetClerk(_BlockingClerkBase):
+    """Blocking client of an :class:`EngineFleetCluster`."""
+
+    def __init__(self, owner_addrs: dict) -> None:
+        from .engine_server import EngineFleetClerk
+
+        self.node = RpcNode()
+        self.sched = self.node.sched
+        ends = {
+            g: self.node.client_end(h, p)
+            for g, (h, p) in owner_addrs.items()
+        }
+        self._clerk = EngineFleetClerk(self.sched, ends)
+
+    @property
+    def client_id(self) -> int:
+        return self._clerk.client_id
 
 
 class BlockingEngineClerk(_BlockingClerkBase):

@@ -49,6 +49,7 @@ __all__ = [
     "EngineShardKVService",
     "EngineClerk",
     "EngineShardNetClerk",
+    "EngineFleetClerk",
     "serve_engine_kv",
     "serve_engine_shardkv",
 ]
@@ -160,10 +161,22 @@ class EngineShardKVService:
     """``EngineShardKV.command``: the sharded engine service behind the
     same TCP front door.  Key→shard routing happens server-side against
     the replicated config; WRONG_GROUP during migration re-routes like
-    the reference clerk (shardkv/client.go:68-129)."""
+    the reference clerk (shardkv/client.go:68-129).
+
+    **Fleet mode** (``peers`` given): this process hosts a subset of
+    the global gid space and its ``BatchedShardKV`` migrates shards
+    to/from peer processes over the network — ``remote_fetch`` becomes
+    a ``pull_shard`` RPC to the owning peer, ``remote_delete`` a
+    ``delete_shard`` RPC riding the peer's log (Challenge 1 across
+    processes).  Ops for a gid hosted elsewhere answer ErrWrongGroup so
+    the fleet clerk re-routes, exactly like a reference group answering
+    for a shard it no longer owns."""
 
     RESUBMIT_S = 0.25
     DEADLINE_S = 5.0
+    # Per-RPC bound on one migration fetch/delete attempt; the
+    # orchestration sweep re-issues after a timeout.
+    MIGRATE_RPC_S = 2.0
 
     def __init__(
         self,
@@ -171,13 +184,130 @@ class EngineShardKVService:
         skv,  # BatchedShardKV
         pump_interval: float = 0.002,
         ticks_per_pump: int = 2,
+        peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
     ) -> None:
         self.sched = sched
         self.skv = skv
         self._interval = pump_interval
         self._ticks = ticks_per_pump
         self._stopped = False
+        self.peers = dict(peers or {})
+        self._fleet = bool(self.peers)
+        if self._fleet:
+            self._fetches: dict = {}  # (gid, shard, num) -> Future
+            self._deletes: dict = {}
+            skv.remote_fetch = self._remote_fetch
+            skv.remote_delete = self._remote_delete
         sched.call_soon(self._pump_loop)
+
+    # -- fleet migration hooks (run on the loop thread, inside pump) ------
+
+    def _remote_fetch(self, src_gid: int, shard: int, num: int):
+        from ..engine.shardkv import OK as SK_OK
+
+        key = (src_gid, shard, num)
+        fut = self._fetches.get(key)
+        if fut is None:
+            end = self.peers.get(src_gid)
+            if end is None:
+                return None  # unroutable: keep retrying (config may fix)
+            self._fetches[key] = self.sched.with_timeout(
+                end.call("EngineShardKV.pull_shard", (src_gid, shard, num)),
+                self.MIGRATE_RPC_S,
+            )
+            return None
+        if not fut.done:
+            return None
+        del self._fetches[key]  # resolved: consume or retry next sweep
+        reply = fut.value
+        if (
+            reply is None or reply is TIMEOUT
+            or not isinstance(reply, tuple) or reply[0] != SK_OK
+        ):
+            return None  # dropped / not ready: the sweep re-issues
+        return reply[1], reply[2]
+
+    def _remote_delete(self, src_gid: int, shard: int, num: int):
+        from ..engine.shardkv import OK as SK_OK
+
+        key = (src_gid, shard, num)
+        fut = self._deletes.get(key)
+        if fut is None:
+            end = self.peers.get(src_gid)
+            if end is None:
+                return True  # owner unknown everywhere: nothing to delete
+            self._deletes[key] = self.sched.with_timeout(
+                end.call("EngineShardKV.delete_shard", (src_gid, shard, num)),
+                self.MIGRATE_RPC_S,
+            )
+            return None
+        if not fut.done:
+            return None
+        del self._deletes[key]
+        reply = fut.value
+        if reply is None or reply is TIMEOUT or not isinstance(reply, tuple):
+            return None  # dropped: re-issue next sweep
+        return reply[0] == SK_OK  # False = ErrNotReady, re-asked later
+
+    # -- fleet migration RPC handlers (the serving side of the hooks) -----
+
+    def pull_shard(self, args):
+        """Return ``(OK, data, latest)`` for a shard this process's old
+        owner holds, once it has applied the puller's config number —
+        the cross-process form of the in-process applied-state read
+        (engine/shardkv.py _orchestrate step (b))."""
+        from ..engine.shardkv import ERR_NOT_READY, ERR_WRONG_GROUP
+        from ..engine.shardkv import OK as SK_OK
+
+        src_gid, shard, num = args
+        if src_gid not in self.skv.reps:
+            return (ERR_WRONG_GROUP,)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                rep = self.skv.reps[src_gid]
+                if rep.cur.num >= num:
+                    sh = rep.shards[shard]
+                    return (SK_OK, dict(sh.data), dict(sh.latest))
+                yield 0.01  # config catching up (the ErrNotReady gate)
+            return (ERR_NOT_READY,)
+
+        return run()
+
+    def delete_shard(self, args):
+        """Challenge-1 deletion on behalf of a remote puller: ride the
+        local old owner's log (BatchedShardKV.delete_shard) and report
+        the outcome."""
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..engine.shardkv import OK as SK_OK
+
+        src_gid, shard, num = args
+        if src_gid not in self.skv.reps:
+            return (ERR_WRONG_GROUP,)
+
+        def run():
+            t = self.skv.delete_shard(src_gid, shard, num)
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if t.done:
+                    if t.failed:
+                        return (ERR_TIMEOUT,)
+                    return (SK_OK,) if t.err == SK_OK else (t.err,)
+                yield 0.005
+            return (ERR_TIMEOUT,)
+
+        return run()
+
+    def config(self, args):
+        """Latest committed config as ``(num, shards, groups)`` — the
+        fleet clerk's routing source (shardctrler Query analog)."""
+        cfg = self.skv.query_latest()
+        return (
+            cfg.num,
+            list(cfg.shards),
+            {g: list(v) for g, v in cfg.groups.items()},
+        )
 
     def stop(self) -> None:
         self._stopped = True
@@ -202,6 +332,10 @@ class EngineShardKVService:
                 while self.sched.now < deadline:
                     t = self.skv.get_fast(args.key)
                     if t.err == ERR_WRONG_GROUP:
+                        # Fleet: the owner is (probably) another
+                        # process — answer so the clerk re-routes.
+                        if self._fleet:
+                            return EngineCmdReply(err=ERR_WRONG_GROUP)
                         yield 0.01  # config moving; shard not serving here
                         continue
                     value = t.value if t.err == OK else ""
@@ -216,6 +350,9 @@ class EngineShardKVService:
                 cfg = self.skv.query_latest()
                 gid = cfg.shards[key2shard(args.key)]
                 if gid not in self.skv.reps:
+                    if self._fleet:
+                        # Hosted by a peer process: tell the clerk.
+                        return EngineCmdReply(err=ERR_WRONG_GROUP)
                     yield 0.01  # shard unassigned; config still moving
                     continue
                 t = self.skv.submit(
@@ -237,10 +374,14 @@ class EngineShardKVService:
     ADMIN_OPS = ("join", "leave", "move")
 
     def admin(self, args):
-        """Config administration: args = (kind, payload) with kind in
-        ADMIN_OPS — a network-supplied string must never getattr into
-        arbitrary methods."""
-        kind, payload = args
+        """Config administration: args = (kind, payload[, command_id])
+        with kind in ADMIN_OPS — a network-supplied string must never
+        getattr into arbitrary methods.  The optional command_id makes
+        retries exactly-once through the ctrler dedup table; a FLEET
+        admin MUST pass one (a duplicate apply would fork the config
+        histories' numbering across processes and wedge migration)."""
+        kind, payload = args[0], args[1]
+        cmd = args[2] if len(args) > 2 else None
         if kind not in self.ADMIN_OPS:
             return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
 
@@ -248,9 +389,9 @@ class EngineShardKVService:
             # join/leave take their payload whole (a gid list / mapping);
             # move takes (shard, gid) as two positionals.
             if kind == "move":
-                t = self.skv.move(*payload)
+                t = self.skv.move(*payload, command_id=cmd)
             else:
-                t = getattr(self.skv, kind)(payload)
+                t = getattr(self.skv, kind)(payload, command_id=cmd)
             deadline = self.sched.now + self.DEADLINE_S
             while self.sched.now < deadline:
                 if t.done:
@@ -311,6 +452,71 @@ class EngineShardNetClerk(EngineClerk):
         super().__init__(sched, end, service="EngineShardKV")
 
 
+class EngineFleetClerk:
+    """Clerk for a fleet of engine shard servers: route key→shard→gid→
+    process from the replicated config, re-query and re-route on
+    ErrWrongGroup — the reference clerk loop (shardkv/client.go:68-129)
+    where each "group" is a chip-owning process."""
+
+    def __init__(self, sched, ends_by_gid: dict) -> None:
+        self.sched = sched
+        self.ends = dict(ends_by_gid)  # gid -> TcpClientEnd
+        self._all = list(dict.fromkeys(self.ends.values()))
+        self.client_id = unique_client_id(next(EngineClerk._next))
+        self.command_id = 0
+        self._cfg = None  # cached (num, shards, groups)
+
+    def _refresh_config(self):
+        while True:
+            for end in self._all:
+                fut = end.call("EngineShardKV.config", ())
+                reply = yield self.sched.with_timeout(fut, 2.0)
+                if reply is not None and reply is not TIMEOUT:
+                    self._cfg = reply
+                    return reply
+            yield self.sched.sleep(0.05)
+
+    def _command(self, op: str, key: str, value: str = ""):
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        while True:
+            cfg = self._cfg
+            if cfg is None:
+                cfg = yield from self._refresh_config()
+            gid = cfg[1][key2shard(key)]
+            end = self.ends.get(gid)
+            if end is None:  # unassigned shard / unknown gid: re-query
+                yield self.sched.sleep(0.05)
+                self._cfg = None
+                continue
+            fut = end.call("EngineShardKV.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if reply is None or reply is TIMEOUT:
+                self._cfg = None
+                continue  # dropped / wedged: re-route and retry
+            if reply.err == OK:
+                return reply.value
+            if reply.err == ERR_WRONG_GROUP:
+                self._cfg = None  # stale routing: re-query the config
+            yield self.sched.sleep(0.02)
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+
 def serve_engine_kv(
     port: int,
     G: int = 64,
@@ -352,26 +558,48 @@ def serve_engine_shardkv(
     host: str = "127.0.0.1",
     seed: int = 0,
     join_gids: Optional[Sequence[int]] = None,
+    gids: Optional[Sequence[int]] = None,
+    peer_addrs: Optional[dict] = None,  # gid -> (host, port) of the owner
 ) -> RpcNode:
     """The sharded engine behind TCP: BatchedShardKV (replicated config
-    + per-shard migration pipeline) on one chip-owning process."""
+    + per-shard migration pipeline) on one chip-owning process.
+
+    Fleet mode: pass ``gids`` (the global gids THIS process hosts; the
+    local engine is sized ``len(gids)+1``) and ``peer_addrs`` (owner
+    address for every remotely hosted gid) — shard migration then rides
+    ``pull_shard``/``delete_shard`` RPCs between processes."""
     from ..engine.shardkv import BatchedShardKV
 
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
+    local_gids = list(gids) if gids is not None else None
+    G_local = (len(local_gids) + 1) if local_gids is not None else G
+    peers = {
+        g: node.client_end(h, p)
+        for g, (h, p) in (peer_addrs or {}).items()
+        if local_gids is None or g not in local_gids
+    }
 
     def build():
-        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
+        cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
         driver = EngineDriver(cfg, seed=seed)
         # Warm-up before readiness (see serve_engine_kv): elections +
         # both tick compiles happen here, not under client traffic —
         # the admin_sync join exercises the loaded variant.
         ok = driver.run_until_quiet_leaders(2000)
         assert ok, "engine groups failed to elect"
-        skv = BatchedShardKV(driver)
+        skv = BatchedShardKV(driver, gids=local_gids)
+        # Warm the LOADED tick variant before the readiness line (the
+        # jit compile takes tens of seconds on CPU and would otherwise
+        # land under the first admin/client RPC and time it out).  A
+        # None payload is the "binding lost" no-op: it exercises the
+        # ingest path without touching config history — essential in
+        # fleet mode, where every process's history must stay aligned.
+        skv.driver.start(0, None)
+        skv.pump(8)
         for gid in join_gids or []:
             skv.admin_sync("join", [gid])
-        return EngineShardKVService(sched, skv)
+        return EngineShardKVService(sched, skv, peers=peers)
 
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("EngineShardKV", svc)

@@ -487,7 +487,14 @@ class Transport {
           auto it = conns_.find(static_cast<int64_t>(tag));
           if (it == conns_.end()) continue;
           Conn& c = it->second;
-          if (c.closed) continue;
+          if (c.closed) {
+            // Level-triggered EPOLLOUT would re-fire every iteration
+            // until the poll thread sweeps — drop the watch now or
+            // this loop busy-spins while the poller is busy (e.g. a
+            // long jit compile inside pump).
+            UnwatchWrites(c);
+            continue;
+          }
           if (c.connecting) {
             int err = 0;
             socklen_t elen = sizeof(err);

@@ -189,15 +189,49 @@ def route_keys(table: jnp.ndarray, key_hashes: jnp.ndarray) -> jnp.ndarray:
 class BatchedShardKV(FrontierService):
     """The full sharded stack on one batched engine.
 
-    Engine group 0 = config RSM; groups ``1..G-1`` = replica groups.
+    Engine group 0 = config RSM; local engine groups ``1..`` host the
+    replica groups.  By default every global gid lives in this instance
+    (``gid == engine group index``, the single-chip deployment).  In
+    **fleet mode** — several chip-owning processes splitting one global
+    gid space — pass ``gids`` (the subset hosted here, mapped onto local
+    engine groups in order) and wire the two remote-migration hooks:
+
+    * ``remote_fetch(src_gid, shard, config_num) → (data, latest) | None``
+      — called each orchestration sweep while a PULLING shard's source
+      gid is not local.  The hook owns the async RPC: return ``None``
+      while in flight / source not caught up, and the blobs exactly
+      once when ready (the sweep immediately logs the InsertOp).
+    * ``remote_delete(src_gid, shard, config_num) → bool | None`` —
+      Challenge-1 GC at a remote old owner.  ``None`` = in flight,
+      ``True`` = deleted (confirm proceeds), ``False`` = ErrNotReady
+      (re-asked next sweep).
+
+    Config consistency across a fleet is by construction: every process
+    applies the same admin ops in the same order through its own config
+    RSM (``rebalance`` is deterministic), mirroring how every reference
+    shardkv group converges on the same shardctrler history.
     """
 
-    def __init__(self, driver: EngineDriver) -> None:
+    def __init__(
+        self, driver: EngineDriver, gids: Optional[List[int]] = None
+    ) -> None:
         if driver.cfg.G < 2:
             raise ValueError("BatchedShardKV needs G >= 2 (ctrler + >=1 group)")
         super().__init__(driver)
         G = driver.cfg.G
-        self.gids = list(range(1, G))
+        if gids is None:
+            self.gids = list(range(1, G))
+        else:
+            if len(set(gids)) != len(gids) or 0 in gids:
+                raise ValueError("gids must be unique and nonzero")
+            if len(gids) > G - 1:
+                raise ValueError(
+                    f"{len(gids)} gids need G >= {len(gids) + 1} engine groups"
+                )
+            self.gids = list(gids)
+        # Global gid ↔ local engine group (group 0 is the config RSM).
+        self._g2l = {gid: i + 1 for i, gid in enumerate(self.gids)}
+        self._l2g = {i + 1: gid for i, gid in enumerate(self.gids)}
         # Config RSM applied state (group 0).
         self.configs: List[Config] = [
             Config(num=0, shards=[0] * NSHARDS, groups={})
@@ -207,6 +241,9 @@ class BatchedShardKV(FrontierService):
         self._route = jnp.zeros((NSHARDS,), jnp.int32)
         self._ctrl_cmd = 0
         self._orchestrate_enabled = True
+        # Fleet-mode hooks (see class docstring); None = single-instance.
+        self.remote_fetch = None
+        self.remote_delete = None
 
     # -- checkpoint (pairs with EngineDriver.save/restore) ----------------
 
@@ -222,6 +259,7 @@ class BatchedShardKV(FrontierService):
         blob["route"] = np.asarray(self._route)
         blob["ctrl_cmd"] = self._ctrl_cmd
         blob["orchestrate"] = self._orchestrate_enabled
+        blob["gids"] = list(self.gids)
         return blob
 
     def load_state_dict(self, blob: Dict[str, Any]) -> None:
@@ -247,6 +285,11 @@ class BatchedShardKV(FrontierService):
         self._route = jnp.asarray(blob["route"])
         self._ctrl_cmd = blob["ctrl_cmd"]
         self._orchestrate_enabled = blob["orchestrate"]
+        # gid → engine-group mapping travels with the checkpoint (older
+        # blobs predate fleet mode: identity mapping).
+        self.gids = list(blob.get("gids", self.gids))
+        self._g2l = {gid: i + 1 for i, gid in enumerate(self.gids)}
+        self._l2g = {i + 1: gid for i, gid in enumerate(self.gids)}
 
     # -- client/admin surface ---------------------------------------------
 
@@ -254,9 +297,22 @@ class BatchedShardKV(FrontierService):
                client_id: int = 0, command_id: int = 0) -> ShardTicket:
         t = ShardTicket(group=gid)
         self.driver.start(
-            gid,
+            self._g2l[gid],
             _ClientOp(op=op, key=key, value=value, client_id=client_id,
                       command_id=command_id, ticket=t),
+        )
+        return t
+
+    def delete_shard(self, src_gid: int, shard: int,
+                     config_num: int) -> ShardTicket:
+        """Propose Challenge-1 deletion in a *local* old owner's log on
+        behalf of a remote puller — the serving side of a fleet peer's
+        ``remote_delete`` (the cross-process form of orchestration
+        step (c) below)."""
+        t = ShardTicket(group=src_gid)
+        self.driver.start(
+            self._g2l[src_gid],
+            _DeleteOp(config_num=config_num, shard=shard, ticket=t),
         )
         return t
 
@@ -268,6 +324,11 @@ class BatchedShardKV(FrontierService):
         if command_id is None:
             self._ctrl_cmd += 1
             command_id = self._ctrl_cmd
+        else:
+            # Keep the auto counter ahead of externally supplied ids
+            # (fleet admin) — otherwise a later auto-allocated id lands
+            # below _ctrl_latest and is silently dedup-dropped as OK.
+            self._ctrl_cmd = max(self._ctrl_cmd, command_id)
         t = ShardTicket(group=0, command_id=command_id)
         self.driver.start(
             0, _CtrlOp(kind=kind, arg=arg, client_id=0,
@@ -377,7 +438,7 @@ class BatchedShardKV(FrontierService):
         if g == 0:
             self._apply_ctrl(op, now)
         else:
-            self._apply_replica(self.reps[g], op, now)
+            self._apply_replica(self.reps[self._l2g[g]], op, now)
 
     def _apply_ctrl(self, op: Any, now: int) -> None:
         if not isinstance(op, _CtrlOp):
@@ -494,46 +555,73 @@ class BatchedShardKV(FrontierService):
                 nxt = self.configs[rep.cur.num + 1].clone()
                 t = ShardTicket(group=gid)
                 rep.pending_config = t
-                self.driver.start(gid, _ConfigOp(config=nxt, ticket=t))
+                self.driver.start(self._g2l[gid], _ConfigOp(config=nxt, ticket=t))
             # (b) shard pull: read the source group's applied state once
-            # it has applied the same config (the ErrNotReady gate).
+            # it has applied the same config (the ErrNotReady gate).  A
+            # source gid hosted by another fleet process goes through
+            # the remote_fetch hook instead of the direct host read.
             for s in range(NSHARDS):
                 sh = rep.shards[s]
                 if sh.state == PULLING and not self._live(
                     rep.pending_insert.get(s)
                 ):
-                    src = self.reps.get(rep.prev.shards[s])
-                    if src is None or src.cur.num < rep.cur.num:
-                        continue  # source hasn't caught up; retry later
+                    src_gid = rep.prev.shards[s]
+                    src = self.reps.get(src_gid)
+                    if src is not None:
+                        if src.cur.num < rep.cur.num:
+                            continue  # source hasn't caught up; retry later
+                        pull_data = dict(src.shards[s].data)
+                        pull_latest = dict(src.shards[s].latest)
+                    elif self.remote_fetch is not None:
+                        got = self.remote_fetch(src_gid, s, rep.cur.num)
+                        if got is None:
+                            continue  # RPC in flight / source not ready
+                        pull_data, pull_latest = dict(got[0]), dict(got[1])
+                    else:
+                        continue  # source unknown and no fleet hook
                     t = ShardTicket(group=gid)
                     rep.pending_insert[s] = t
                     self.driver.start(
-                        gid,
+                        self._g2l[gid],
                         _InsertOp(
                             config_num=rep.cur.num,
                             shard=s,
-                            data=dict(src.shards[s].data),
-                            latest=dict(src.shards[s].latest),
+                            data=pull_data,
+                            latest=pull_latest,
                             ticket=t,
                         ),
                     )
                 # (c) GC handshake: delete at the old owner, then
-                # confirm locally (Challenge 1).
+                # confirm locally (Challenge 1).  A remote old owner is
+                # deleted through the remote_delete hook — Challenge 1
+                # crosses process boundaries too.
                 elif sh.state == GCING:
                     dt = rep.pending_delete.get(s)
                     if dt is None or (dt.done and (dt.failed or dt.err != OK)):
                         src_gid = rep.prev.shards[s]
-                        if src_gid not in self.reps:
-                            rep.pending_delete[s] = ShardTicket(
-                                group=0, done=True, err=OK
-                            )
-                        else:
+                        if src_gid in self.reps:
                             t = ShardTicket(group=src_gid)
                             rep.pending_delete[s] = t
                             self.driver.start(
-                                src_gid,
+                                self._g2l[src_gid],
                                 _DeleteOp(config_num=rep.cur.num, shard=s,
                                           ticket=t),
+                            )
+                        elif self.remote_delete is not None:
+                            st = self.remote_delete(src_gid, s, rep.cur.num)
+                            if st is not None:
+                                # Done ticket carries the outcome; a
+                                # not-ready outcome re-enters this branch
+                                # next sweep and re-asks the hook.
+                                rep.pending_delete[s] = ShardTicket(
+                                    group=src_gid, done=True,
+                                    err=OK if st else ERR_NOT_READY,
+                                )
+                        else:
+                            # No fleet: an unknown source was never
+                            # joined here — nothing to delete.
+                            rep.pending_delete[s] = ShardTicket(
+                                group=0, done=True, err=OK
                             )
                     elif (
                         dt.done
@@ -543,7 +631,7 @@ class BatchedShardKV(FrontierService):
                         t = ShardTicket(group=gid)
                         rep.pending_confirm[s] = t
                         self.driver.start(
-                            gid,
+                            self._g2l[gid],
                             _ConfirmOp(config_num=rep.cur.num, shard=s,
                                        ticket=t),
                         )

@@ -681,3 +681,35 @@ def test_engine_shardkv_served_over_real_sockets(tmp_path):
             ck.close()
     finally:
         cluster.shutdown()
+
+
+@needs_native
+def test_engine_fleet_cross_process_migration():
+    """Two chip-owning engine processes splitting the gid space: a join
+    on the second process migrates ~half the shards ACROSS processes
+    (pull_shard/delete_shard RPCs), and every key survives with
+    continued exactly-once appends."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+
+    fleet = EngineFleetCluster([[1], [2]], seed=3)
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        ck = fleet.clerk()
+        try:
+            kv = {chr(97 + i): f"v{i}" for i in range(10)}
+            for k, v in kv.items():
+                ck.put(k, v)
+            # gid 2 lives on the OTHER process: rebalance moves ~half
+            # the shards over the network.
+            fleet.admin("join", [2])
+            for k, v in kv.items():
+                assert ck.get(k) == v, f"{k} lost in cross-process migration"
+            for k in kv:
+                ck.append(k, "+")
+            for k, v in kv.items():
+                assert ck.get(k) == v + "+"
+        finally:
+            ck.close()
+    finally:
+        fleet.shutdown()

@@ -1,0 +1,291 @@
+"""Fleet mode: one global gid space split across several BatchedShardKV
+instances (the in-process form of multiple chip-owning server
+processes).
+
+Each instance hosts a gid subset (``BatchedShardKV(driver, gids=...)``)
+and migrates shards to/from peers through the ``remote_fetch`` /
+``remote_delete`` hooks.  These tests wire the hooks directly between
+two instances with the exact gating semantics the networked service
+uses (source must have applied the puller's config number; deletes go
+through the source's log) — deterministic, no sockets.  The socket form
+is covered by ``tests/test_distributed.py`` / ``examples/10``.
+
+Conformance: the same shardkv spec the single-instance tests cover
+(reference: shardkv test spec, SURVEY §4.4) — data preservation across
+migration, Challenge 1 (old owner deletes) ACROSS instances, Challenge
+2 (serving during migration), dedup tables traveling with shards.
+"""
+
+from typing import Dict
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.shardkv import (
+    ERR_WRONG_GROUP,
+    OK,
+    BatchedShardKV,
+)
+from multiraft_tpu.services.shardctrler import NSHARDS
+from multiraft_tpu.services.shardkv import SERVING, key2shard
+
+
+def make_instance(gids, seed=0):
+    cfg = EngineConfig(G=len(gids) + 1, P=3, L=64, E=8, INGEST=8)
+    driver = EngineDriver(cfg, seed=seed)
+    assert driver.run_until_quiet_leaders(max_ticks=1500)
+    return BatchedShardKV(driver, gids=gids)
+
+
+def wire_fleet(instances):
+    """Connect every instance's remote hooks to its peers, with the
+    networked service's gating: fetch waits for the source to apply the
+    puller's config; delete rides the source's log (async, one ticket
+    per (gid, shard, config) in flight)."""
+    owner: Dict[int, BatchedShardKV] = {}
+    for inst in instances:
+        for g in inst.gids:
+            owner[g] = inst
+
+    for inst in instances:
+        pending = {}
+
+        def remote_fetch(src_gid, shard, num, _me=inst):
+            peer = owner.get(src_gid)
+            if peer is None or peer is _me:
+                return None
+            rep = peer.reps.get(src_gid)
+            if rep is None or rep.cur.num < num:
+                return None  # ErrNotReady: source hasn't applied num yet
+            return dict(rep.shards[shard].data), dict(rep.shards[shard].latest)
+
+        def remote_delete(src_gid, shard, num, _pending=pending):
+            peer = owner.get(src_gid)
+            if peer is None:
+                return True  # never hosted anywhere: nothing to delete
+            key = (src_gid, shard, num)
+            t = _pending.get(key)
+            if t is None:
+                _pending[key] = peer.delete_shard(src_gid, shard, num)
+                return None  # in flight
+            if not t.done:
+                return None
+            del _pending[key]
+            return (not t.failed) and t.err == OK
+
+        inst.remote_fetch = remote_fetch
+        inst.remote_delete = remote_delete
+    return owner
+
+
+def fleet_admin(instances, kind, arg):
+    """Mirror one admin op to every instance's config RSM — same op,
+    same order, deterministic rebalance → identical config histories."""
+    for inst in instances:
+        inst.admin_sync(kind, arg)
+
+
+def pump_all(instances, n=5):
+    for inst in instances:
+        inst.pump(n)
+
+
+def settle_fleet(instances, max_rounds=600):
+    """Pump the whole fleet until every hosted rep is at the latest
+    config with all shards quiescent."""
+    target = instances[0].query_latest().num
+    assert all(i.query_latest().num == target for i in instances)
+    for _ in range(max_rounds):
+        pump_all(instances)
+        done = True
+        for inst in instances:
+            cfg = inst.query_latest()
+            for g in inst.gids:
+                if g not in cfg.groups:
+                    continue
+                rep = inst.reps[g]
+                if rep.cur.num != target or any(
+                    sh.state != SERVING for sh in rep.shards.values()
+                ):
+                    done = False
+        if done:
+            return
+    raise TimeoutError(f"fleet did not settle at config {target}")
+
+
+class FleetClerk:
+    """Minimal cross-instance clerk: route key→shard→gid→instance from
+    the (shared) latest config, retry on ErrWrongGroup — the reference
+    clerk loop (shardkv/client.go:68-129) against a fleet."""
+
+    def __init__(self, instances, client_id=1):
+        self.instances = instances
+        self.owner = {g: i for i in instances for g in i.gids}
+        self.client_id = client_id
+        self.command_id = 0
+
+    def _run(self, op, key, value=""):
+        if op != "Get":
+            self.command_id += 1
+        for _ in range(400):
+            cfg = self.instances[0].query_latest()
+            gid = cfg.shards[key2shard(key)]
+            inst = self.owner.get(gid)
+            if inst is None:
+                pump_all(self.instances)
+                continue
+            t = inst.submit(gid, op, key, value,
+                            client_id=self.client_id,
+                            command_id=self.command_id)
+            waited = 0
+            while not t.done and waited < 400:
+                pump_all(self.instances, 2)
+                waited += 2
+            if t.done and not t.failed and t.err != ERR_WRONG_GROUP:
+                return t
+        raise TimeoutError(f"{op}({key!r}) never served")
+
+    def get(self, key):
+        t = self._run("Get", key)
+        return t.value if t.err == OK else ""
+
+    def put(self, key, value):
+        self._run("Put", key, value)
+
+    def append(self, key, value):
+        self._run("Append", key, value)
+
+
+def keys_for_all_shards():
+    out = {}
+    for c in range(32, 127):
+        k = chr(c)
+        s = key2shard(k)
+        if s not in out:
+            out[s] = k
+        if len(out) == NSHARDS:
+            break
+    return out
+
+
+def make_fleet(seed=0):
+    a = make_instance([1], seed=seed)
+    b = make_instance([2], seed=seed + 100)
+    wire_fleet([a, b])
+    return a, b
+
+
+def test_fleet_migration_preserves_data():
+    a, b = make_fleet(seed=1)
+    fleet_admin([a, b], "join", [1])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    for shard, k in kmap.items():
+        clerk.put(k, f"v{shard}")
+    # gid 2 (hosted on instance B) joins: ~half the shards must migrate
+    # from A to B through the remote hooks.
+    fleet_admin([a, b], "join", [2])
+    settle_fleet([a, b])
+    cfg = a.query_latest()
+    owned = {g: sum(1 for s in cfg.shards if s == g) for g in (1, 2)}
+    assert abs(owned[1] - owned[2]) <= 1
+    moved = [s for s in range(NSHARDS) if cfg.shards[s] == 2]
+    assert moved, "rebalance moved nothing to the new instance"
+    for shard, k in kmap.items():
+        assert clerk.get(k) == f"v{shard}"
+    # Writes after migration land at the new owners.
+    for shard, k in kmap.items():
+        clerk.append(k, "+")
+        assert clerk.get(k) == f"v{shard}+"
+
+
+def test_fleet_challenge1_remote_owner_deletes():
+    a, b = make_fleet(seed=2)
+    fleet_admin([a, b], "join", [1])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    for shard, k in kmap.items():
+        clerk.put(k, f"w{shard}")
+    fleet_admin([a, b], "join", [2])
+    settle_fleet([a, b])
+    cfg = a.query_latest()
+    # Challenge 1 across processes: every shard that moved to B must be
+    # EMPTY at A (deleted through B's remote_delete → A's log).
+    for s in range(NSHARDS):
+        if cfg.shards[s] == 2:
+            assert a.reps[1].shards[s].data == {}, f"shard {s} not GC'd at A"
+            assert b.reps[2].shards[s].data, f"shard {s} empty at B"
+
+
+def test_fleet_serving_during_migration():
+    """Challenge 2: shards staying on A keep serving while B pulls."""
+    a, b = make_fleet(seed=3)
+    fleet_admin([a, b], "join", [1])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    for shard, k in kmap.items():
+        clerk.put(k, f"x{shard}")
+    # Propose the join on both config RSMs but pump only a little, then
+    # interleave reads of A-retained shards with the migration.
+    fleet_admin([a, b], "join", [2])
+    cfg = a.query_latest()
+    kept = [s for s in range(NSHARDS) if cfg.shards[s] == 1]
+    assert kept
+    for _ in range(30):
+        pump_all([a, b], 2)
+        for s in kept[:2]:
+            t = a.submit(1, "Get", kmap[s], client_id=9, command_id=0)
+            waited = 0
+            while not t.done and waited < 200:
+                pump_all([a, b], 2)
+                waited += 2
+            # Mid-migration a retained shard must never claim WrongGroup.
+            if t.done and not t.failed:
+                assert t.err in (OK,), f"kept shard {s} -> {t.err}"
+                assert t.value == f"x{s}"
+    settle_fleet([a, b])
+
+
+def test_fleet_dedup_travels_with_shards():
+    """A write resubmitted after its shard migrated must not re-apply:
+    the per-shard session table crossed the process boundary."""
+    a, b = make_fleet(seed=4)
+    fleet_admin([a, b], "join", [1])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    cfg_after = None
+    # Append once through the clerk (command_id=1 for this client).
+    target_shard, target_key = sorted(kmap.items())[0]
+    clerk.append(target_key, "first")
+    fleet_admin([a, b], "join", [2])
+    settle_fleet([a, b])
+    cfg_after = a.query_latest()
+    new_gid = cfg_after.shards[target_shard]
+    inst = a if new_gid == 1 else b
+    # Replay the SAME (client_id, command_id) append at the current
+    # owner — the migrated dedup table must suppress it.
+    t = inst.submit(new_gid, "Append", target_key, "first",
+                    client_id=clerk.client_id, command_id=clerk.command_id)
+    waited = 0
+    while not t.done and waited < 400:
+        pump_all([a, b], 2)
+        waited += 2
+    assert t.done and not t.failed and t.err == OK
+    assert clerk.get(target_key) == "first", "duplicate applied after migration"
+
+
+def test_fleet_move_shard_between_instances():
+    a, b = make_fleet(seed=5)
+    fleet_admin([a, b], "join", [1])
+    fleet_admin([a, b], "join", [2])
+    settle_fleet([a, b])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    cfg = a.query_latest()
+    src_shard = next(s for s in range(NSHARDS) if cfg.shards[s] == 1)
+    clerk.put(kmap[src_shard], "moved-data")
+    fleet_admin([a, b], "move", (src_shard, 2))
+    settle_fleet([a, b])
+    assert a.query_latest().shards[src_shard] == 2
+    assert clerk.get(kmap[src_shard]) == "moved-data"
+    assert b.reps[2].shards[src_shard].data, "moved shard empty at B"
+    assert a.reps[1].shards[src_shard].data == {}, "source not GC'd"
