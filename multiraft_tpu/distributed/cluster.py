@@ -258,6 +258,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             port=spec["ports"][0],
             G=spec.get("groups", 64),
             seed=spec.get("seed", 0),
+            data_dir=spec.get("data_dir"),
+            checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
         )
     elif kind == "engine_shardkv":
         _pin_platform(spec)
@@ -268,6 +270,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             G=spec.get("groups", 4),
             seed=spec.get("seed", 0),
             join_gids=spec.get("join_gids"),
+            data_dir=spec.get("data_dir"),
+            checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
         )
     elif kind == "engine_fleet":
         _pin_platform(spec)
@@ -282,6 +286,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
                 int(g): (a[0], int(a[1]))
                 for g, a in spec["peer_addrs"].items()
             },
+            data_dir=spec.get("data_dir"),
+            checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
@@ -429,6 +435,8 @@ class EngineProcessCluster:
         host: str = "127.0.0.1",
         seed: int = 0,
         join_gids: Optional[List[int]] = None,
+        data_dir: Optional[str] = None,
+        checkpoint_every_s: float = 30.0,
     ) -> None:
         assert kind in ("engine_kv", "engine_shardkv")
         self.kind = kind
@@ -442,6 +450,11 @@ class EngineProcessCluster:
         }
         if join_gids is not None:
             self.spec["join_gids"] = list(join_gids)
+        if data_dir is not None:
+            # Durable mode: checkpoint + WAL under data_dir; kill() +
+            # start() then recovers every acknowledged op.
+            self.spec["data_dir"] = data_dir
+            self.spec["checkpoint_every_s"] = checkpoint_every_s
         self.proc: Optional[subprocess.Popen] = None
 
     @property
@@ -452,6 +465,13 @@ class EngineProcessCluster:
         assert self.proc is None or self.proc.poll() is not None
         self.proc = _launch_server(self.spec, "engine")
         _check_ready(self.proc, "engine", timeout=300.0)
+
+    def kill(self) -> None:
+        """SIGKILL the server process (literal crash; restart with
+        :meth:`start` — durable mode recovers from data_dir)."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
 
     def clerk(self) -> "BlockingEngineClerk":
         return BlockingEngineClerk(
@@ -483,6 +503,8 @@ class EngineFleetCluster:
         assignment: Sequence[Sequence[int]],
         host: str = "127.0.0.1",
         seed: int = 0,
+        data_dir: Optional[str] = None,
+        checkpoint_every_s: float = 30.0,
     ) -> None:
         # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
         # codec — admin replies are refused as unregistered otherwise.
@@ -497,7 +519,7 @@ class EngineFleetCluster:
                 self.owner_addrs[g] = (host, self.ports[i])
         self.specs = []
         for i, gl in enumerate(self.assignment):
-            self.specs.append({
+            spec = {
                 "kind": "engine_fleet",
                 "ports": [self.ports[i]],
                 "gids": gl,
@@ -507,7 +529,11 @@ class EngineFleetCluster:
                 },
                 "seed": seed + i,
                 "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
-            })
+            }
+            if data_dir is not None:
+                spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
+                spec["checkpoint_every_s"] = checkpoint_every_s
+            self.specs.append(spec)
         self.procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._admin_node: Optional[RpcNode] = None
         self._admin_cmd = 0
@@ -520,6 +546,20 @@ class EngineFleetCluster:
             self.procs[i] = _launch_server(spec, f"fleet-{i}")
         for i, p in enumerate(self.procs):
             _check_ready(p, f"fleet-{i}", timeout=300.0)
+
+    def kill(self, i: int) -> None:
+        """SIGKILL fleet process ``i`` (its gids go dark until
+        :meth:`start` revives it — from its data_dir in durable mode)."""
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+
+    def start(self, i: int) -> None:
+        """(Re)start fleet process ``i`` on its original spec/ports."""
+        assert self.procs[i] is None or self.procs[i].poll() is not None
+        self.procs[i] = _launch_server(self.specs[i], f"fleet-{i}")
+        _check_ready(self.procs[i], f"fleet-{i}", timeout=300.0)
 
     def admin(self, kind: str, arg: Any, timeout: float = 60.0) -> None:
         """Mirror one config op to every process (same order, same

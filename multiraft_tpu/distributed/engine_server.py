@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
+import time
 import zlib
 from typing import Any, Optional, Sequence
 
@@ -58,6 +60,66 @@ OK = "OK"
 ERR_TIMEOUT = "ErrTimeout"
 
 _OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
+_OPNAME = {v: k for k, v in _OPCODE.items()}
+
+
+class EngineDurability:
+    """Checkpoint + WAL lifecycle for one engine server process.
+
+    The engine's durability contract (see distributed/wal.py): periodic
+    atomic whole-engine checkpoints + a WAL of ops since the last one;
+    write acks gate on the WAL record being fsynced (group commit at
+    pump cadence, so the fsync amortizes over every op in the ~2 ms
+    window).  Recovery restores the checkpoint and re-submits WAL
+    records through consensus — session dedup makes it exactly-once."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        driver: EngineDriver,
+        state_owner,  # has state_dict() (BatchedKV / BatchedShardKV)
+        checkpoint_every_s: float = 30.0,
+        fsync: bool = True,
+    ) -> None:
+        from .wal import WriteAheadLog
+
+        os.makedirs(data_dir, exist_ok=True)
+        self.ckpt_path = os.path.join(data_dir, "engine.ckpt")
+        self.wal = WriteAheadLog(os.path.join(data_dir, "ops.wal"),
+                                 fsync=fsync)
+        self.driver = driver
+        self.state_owner = state_owner
+        self.every = checkpoint_every_s
+        self._last_ckpt = time.monotonic()
+
+    def log(self, record) -> int:
+        """Append one op record; returns its ack-gate seq."""
+        return self.wal.append(codec.encode(record))
+
+    def synced(self, seq: int) -> bool:
+        return self.wal.synced >= seq
+
+    def replay_records(self):
+        for body in self.wal.replay():
+            yield codec.decode(body)
+
+    def after_pump(self) -> None:
+        """Group fsync + periodic checkpoint, called once per pump."""
+        self.wal.sync()
+        if self.every > 0 and (
+            time.monotonic() - self._last_ckpt >= self.every
+        ):
+            self.checkpoint()
+
+    def checkpoint(self) -> None:
+        """Atomic engine+service snapshot, then WAL rotation.  A crash
+        between the two merely makes the next replay redundant."""
+        self.driver.save(
+            self.ckpt_path,
+            extra={"service": self.state_owner.state_dict()},
+        )
+        self.wal.rotate()
+        self._last_ckpt = time.monotonic()
 
 
 @codec.registered
@@ -103,6 +165,7 @@ class EngineKVService:
         kv: BatchedKV,
         pump_interval: float = 0.002,
         ticks_per_pump: int = 2,
+        durability: Optional[EngineDurability] = None,
     ) -> None:
         self.sched = sched
         self.kv = kv
@@ -110,6 +173,20 @@ class EngineKVService:
         self._interval = pump_interval
         self._ticks = ticks_per_pump
         self._stopped = False
+        self._dur = durability
+        # (client_id, command_id) -> WAL seq of the op's apply-time
+        # record; handlers gate their ack on it being fsynced.  Pruned
+        # once synced (absence = already durable).
+        self._write_seqs: dict = {}
+        if durability is not None:
+            # WAL at APPLY time (commit order): evict-and-resubmit can
+            # commit ops in a different order than submission, and
+            # replay must reproduce the order reads actually saw.
+            kv.on_write = lambda g, op: self._write_seqs.__setitem__(
+                (op.client_id, op.command_id),
+                durability.log(("kv", _OPNAME[op.op], op.key, op.value,
+                                op.client_id, op.command_id)),
+            )
         sched.call_soon(self._pump_loop)
 
     def stop(self) -> None:
@@ -119,7 +196,48 @@ class EngineKVService:
         if self._stopped:
             return
         self.kv.pump(self._ticks)
+        if self._dur is not None:
+            self._dur.after_pump()  # group fsync + periodic checkpoint
+            if self._write_seqs:
+                self._write_seqs = {
+                    k: v for k, v in self._write_seqs.items()
+                    if not self._dur.synced(v)
+                }
         self.sched.call_after(self._interval, self._pump_loop)
+
+    def replay_wal(self) -> int:
+        """Re-submit every WAL record through consensus (recovery path;
+        runs to completion before the server starts answering).  Dedup
+        tables make records already in the checkpoint no-ops."""
+        if self._dur is None:
+            return 0
+        slots = []
+        for rec in self._dur.replay_records():
+            if rec[0] != "kv":
+                continue
+            _, op, key, value, cid, cmd = rec
+            slots.append([None, op, key, value, cid, cmd])
+        for s in slots:
+            s[0] = self._resubmit(s)
+        for _ in range(20_000):
+            if all(s[0].done and not s[0].failed for s in slots):
+                break
+            self.kv.pump(2)
+            for s in slots:
+                if s[0].done and s[0].failed:
+                    s[0] = self._resubmit(s)  # lost slot: propose again
+        else:
+            raise RuntimeError(
+                f"WAL replay did not converge ({len(slots)} records)"
+            )
+        return len(slots)
+
+    def _resubmit(self, s):
+        return self.kv.submit(
+            route_group(s[2], self.G),
+            KVOp(op=_OPCODE[s[1]], key=s[2], value=s[3],
+                 client_id=s[4], command_id=s[5]),
+        )
 
     def command(self, args: EngineCmdArgs):
         g = route_group(args.key, self.G)
@@ -149,6 +267,16 @@ class EngineKVService:
                 while not t.done and self.sched.now < sub_deadline:
                     yield 0.002
                 if t.done and not t.failed:
+                    # Ack only once the apply-time WAL record is
+                    # fsynced (absent = pruned = already durable, or
+                    # a duplicate applied before this incarnation).
+                    while self._dur is not None:
+                        seq = self._write_seqs.get(
+                            (args.client_id, args.command_id)
+                        )
+                        if seq is None or self._dur.synced(seq):
+                            break
+                        yield 0.002
                     return EngineCmdReply(err=OK, value=t.value)
                 # failed (evicted/orphaned) or wedged: resubmit under
                 # the same (client_id, command_id) — dedup-safe.
@@ -185,6 +313,7 @@ class EngineShardKVService:
         pump_interval: float = 0.002,
         ticks_per_pump: int = 2,
         peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
+        durability: Optional[EngineDurability] = None,
     ) -> None:
         self.sched = sched
         self.skv = skv
@@ -193,12 +322,45 @@ class EngineShardKVService:
         self._stopped = False
         self.peers = dict(peers or {})
         self._fleet = bool(self.peers)
+        self._dur = durability
+        # seq of the WAL record covering each applied insert — the GC
+        # gate below refuses to ask the old owner to delete until the
+        # inserted blob (possibly the last copy) is fsynced here.
+        self._insert_seqs: dict = {}
+        # (client_id, command_id) -> WAL seq, apply-time (commit order)
+        # — see EngineKVService; pruned once synced.
+        self._write_seqs: dict = {}
+        self._admin_seqs: dict = {}  # command_id -> WAL seq
+        if self._dur is not None:
+            skv.on_insert = self._on_insert_applied
+            skv.on_delete = self._on_delete_applied
+            skv.on_write = lambda gid, op: self._write_seqs.__setitem__(
+                (op.client_id, op.command_id),
+                durability.log(("skv", op.op, op.key, op.value,
+                                op.client_id, op.command_id)),
+            )
+            skv.on_ctrl = lambda op: self._admin_seqs.__setitem__(
+                op.command_id,
+                durability.log(("admin", op.kind, op.arg, op.command_id)),
+            )
         if self._fleet:
             self._fetches: dict = {}  # (gid, shard, num) -> Future
             self._deletes: dict = {}
             skv.remote_fetch = self._remote_fetch
             skv.remote_delete = self._remote_delete
         sched.call_soon(self._pump_loop)
+
+    # -- durability hooks (apply-time, loop thread) -----------------------
+
+    def _on_insert_applied(self, gid, shard, num, data, latest):
+        self._insert_seqs[(gid, shard, num)] = self._dur.log(
+            ("insert", gid, shard, num, dict(data), dict(latest))
+        )
+
+    def _on_delete_applied(self, gid, shard, num):
+        # Replayed on restore so a stale BEPULLING slot can't survive an
+        # older checkpoint and wedge config advance.
+        self._dur.log(("delete", gid, shard, num))
 
     # -- fleet migration hooks (run on the loop thread, inside pump) ------
 
@@ -230,6 +392,14 @@ class EngineShardKVService:
     def _remote_delete(self, src_gid: int, shard: int, num: int):
         from ..engine.shardkv import OK as SK_OK
 
+        # Durability gate: never tell the old owner to delete a shard
+        # whose inserted copy isn't fsynced locally yet — between its
+        # delete and our next checkpoint/WAL-sync, a crash would lose
+        # the only copy.  One pump's group fsync clears this.
+        if self._dur is not None:
+            for (g, s, n), seq in self._insert_seqs.items():
+                if s == shard and n == num and not self._dur.synced(seq):
+                    return None
         key = (src_gid, shard, num)
         fut = self._deletes.get(key)
         if fut is None:
@@ -316,7 +486,140 @@ class EngineShardKVService:
         if self._stopped:
             return
         self.skv.pump(self._ticks)
+        if self._dur is not None:
+            self._dur.after_pump()  # group fsync + periodic checkpoint
+            for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs"):
+                seqs = getattr(self, attr)
+                if seqs:
+                    setattr(self, attr, {
+                        k: v for k, v in seqs.items()
+                        if not self._dur.synced(v)
+                    })
         self.sched.call_after(self._interval, self._pump_loop)
+
+    def replay_wal(self) -> int:
+        """Recovery replay in two passes over the (commit-ordered) WAL:
+
+        1. admin records rebuild the config history, in order, each
+           retried until it actually commits (an eviction during
+           recovery must not silently skip a config — the fleet's
+           histories would diverge);
+        2. insert/delete/client records re-ride the local logs in WAL
+           order, with their apply-time gates making anything already
+           in the checkpoint a no-op.
+
+        The fleet hooks are suspended for the duration: a mid-replay
+        remote fetch could install an EMPTY blob from a peer that
+        already GC'd the shard (its copy lives in OUR wal), and GC
+        requests are deferred until local state is fully rebuilt."""
+        if self._dur is None:
+            return 0
+        recs = list(self._dur.replay_records())
+        saved = (self.skv.remote_fetch, self.skv.remote_delete)
+        self.skv.remote_fetch = None
+        if saved[1] is not None:
+            self.skv.remote_delete = lambda *a: None  # defer, don't skip
+        try:
+            for rec in recs:
+                if rec[0] == "admin":
+                    self._replay_admin(rec[1], rec[2], rec[3])
+            for rec in recs:
+                kind = rec[0]
+                if kind == "insert":
+                    self._replay_insert(*rec[1:])
+                elif kind == "delete":
+                    _, gid, shard, num = rec
+                    if gid in self.skv.reps:
+                        self._retry_until_ok(
+                            lambda: self.skv.delete_shard(gid, shard, num)
+                        )
+                elif kind == "skv":
+                    _, op, key, value, cid, cmd = rec
+                    self._replay_client_op(op, key, value, cid, cmd)
+            # Drain: let every replayed proposal commit before serving.
+            self._pump_until(lambda: False, max_rounds=50)
+        finally:
+            self.skv.remote_fetch, self.skv.remote_delete = saved
+        return len(recs)
+
+    def _pump_until(self, cond, max_rounds: int = 4000) -> None:
+        for _ in range(max_rounds):
+            if cond():
+                return
+            self.skv.pump(2)
+
+    def _retry_until_ok(self, propose, attempts: int = 50):
+        """Propose-and-wait with eviction retry (leader churn during
+        recovery must not drop a record)."""
+        for _ in range(attempts):
+            t = propose()
+            self._pump_until(lambda: t.done)
+            if t.done and not t.failed:
+                return t
+        raise RuntimeError("WAL replay proposal did not commit")
+
+    def _replay_admin(self, kind, payload, cmd) -> None:
+        def propose():
+            if kind == "move":
+                return self.skv.move(*payload, command_id=cmd)
+            return getattr(self.skv, kind)(payload, command_id=cmd)
+
+        self._retry_until_ok(propose)
+
+    def _replay_insert(self, gid, shard, num, data, latest) -> None:
+        if gid not in self.skv.reps:
+            return
+        from ..engine.shardkv import ShardTicket, _InsertOp
+        from ..services.shardkv import PULLING
+
+        rep = self.skv.reps[gid]
+        # The apply gate needs the rep AT config `num` and PULLING —
+        # wait for orchestration to advance it there (earlier inserts/
+        # configs already replayed), else the insert would silently
+        # no-op and a later remote re-fetch could find the peer's copy
+        # already GC'd.
+        self._pump_until(lambda: rep.cur.num >= num)
+        if rep.cur.num != num or rep.shards[shard].state != PULLING:
+            return  # checkpoint already contains this insert's effects
+
+        def propose():
+            t = ShardTicket(group=gid)
+            self.skv.driver.start(
+                self.skv._g2l[gid],
+                _InsertOp(config_num=num, shard=shard, data=dict(data),
+                          latest=dict(latest), ticket=t),
+            )
+            return t
+
+        self._retry_until_ok(propose)
+
+    def _replay_client_op(self, op, key, value, cid, cmd) -> None:
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        for _ in range(2000):
+            cfg = self.skv.query_latest()
+            gid = cfg.shards[key2shard(key)]
+            if gid not in self.skv.reps:
+                if not self._fleet:
+                    # Config history is fully replayed (pass 1), so an
+                    # unassigned shard here means a leave orphaned it —
+                    # the data is unreachable by config, nothing to do.
+                    if gid == 0:
+                        return
+                    raise RuntimeError(
+                        f"replay: shard owner {gid} unknown off-fleet"
+                    )
+                # Fleet: the current owner is a peer — the op's effects
+                # reached it inside a migrated blob (our GC gate ensures
+                # the blob was durable there before our copy could go).
+                return
+            t = self.skv.submit(gid, op, key, value,
+                                client_id=cid, command_id=cmd)
+            self._pump_until(lambda: t.done, max_rounds=400)
+            if t.done and not t.failed and t.err != ERR_WRONG_GROUP:
+                return
+        raise RuntimeError(f"WAL replay of {op}({key!r}) did not converge")
 
     def command(self, args: EngineCmdArgs):
         from ..engine.shardkv import ERR_WRONG_GROUP
@@ -366,6 +669,15 @@ class EngineShardKVService:
                     yield 0.002
                 if not t.done or t.failed or t.err == ERR_WRONG_GROUP:
                     continue  # resubmit / re-route; dedup-safe
+                # Ack gates on the apply-time WAL record being fsynced
+                # (absent = pruned/duplicate = already durable).
+                while self._dur is not None:
+                    seq = self._write_seqs.get(
+                        (args.client_id, args.command_id)
+                    )
+                    if seq is None or self._dur.synced(seq):
+                        break
+                    yield 0.002
                 return EngineCmdReply(err=OK, value=t.value)
             return EngineCmdReply(err=ERR_TIMEOUT)
 
@@ -395,7 +707,17 @@ class EngineShardKVService:
             deadline = self.sched.now + self.DEADLINE_S
             while self.sched.now < deadline:
                 if t.done:
-                    return EngineCmdReply(err=OK if not t.failed else ERR_TIMEOUT)
+                    if t.failed:
+                        return EngineCmdReply(err=ERR_TIMEOUT)
+                    # Ack gates on the apply-time ("admin", ...) WAL
+                    # record (logged by the on_ctrl hook in commit
+                    # order) being fsynced.
+                    while self._dur is not None:
+                        seq = self._admin_seqs.get(t.command_id)
+                        if seq is None or self._dur.synced(seq):
+                            break
+                        yield 0.002
+                    return EngineCmdReply(err=OK)
                 yield 0.005
             return EngineCmdReply(err=ERR_TIMEOUT)
 
@@ -523,28 +845,55 @@ def serve_engine_kv(
     host: str = "127.0.0.1",
     seed: int = 0,
     record_groups: Optional[Sequence[int]] = None,
+    data_dir: Optional[str] = None,
+    checkpoint_every_s: float = 30.0,
 ) -> RpcNode:
     """Bring up the chip-owning engine KV server process: one
     EngineDriver (G groups), a BatchedKV, the pump loop, and a
     listening RpcNode.  Returns the node (caller keeps the process
-    alive)."""
+    alive).
+
+    With ``data_dir``, the server is DURABLE: periodic atomic
+    checkpoints + a write-ahead log of acked ops (see EngineDurability)
+    — a kill -9'd process restarted on the same dir recovers every
+    acknowledged write."""
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
 
     def build():
-        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
-        driver = EngineDriver(cfg, seed=seed)
-        kv = BatchedKV(driver, record_groups=list(record_groups or []))
+        driver = None
+        if data_dir:
+            ckpt = os.path.join(data_dir, "engine.ckpt")
+            if os.path.exists(ckpt):
+                driver = EngineDriver.restore(ckpt)
+        if driver is not None:
+            kv = BatchedKV(driver, record_groups=list(record_groups or []))
+            blob = driver.restored_extra.get("service")
+            if blob:
+                kv.load_state_dict(blob)
+        else:
+            cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
+            driver = EngineDriver(cfg, seed=seed)
+            kv = BatchedKV(driver, record_groups=list(record_groups or []))
+            driver.run_until_quiet_leaders(2000)
         # Warm-up BEFORE the readiness line: elect leaders and compile
         # both tick variants (quiet + loaded).  The first jit compile
         # takes tens of seconds and runs on the scheduler loop — doing
         # it lazily would starve RPC dispatch and time out every early
-        # client (observed: all first ops stall ~10s on CPU).
-        driver.run_until_quiet_leaders(2000)
+        # client (observed: all first ops stall ~10s on CPU).  A
+        # restored process recompiles too (fresh interpreter).
         driver.start(0, (KVOp(op=OP_GET, key=""), None))
         for _ in range(8):
             kv.pump(1)
-        return EngineKVService(sched, kv)
+        dur = (
+            EngineDurability(data_dir, driver, kv,
+                             checkpoint_every_s=checkpoint_every_s)
+            if data_dir else None
+        )
+        svc = EngineKVService(sched, kv, durability=dur)
+        if dur is not None:
+            svc.replay_wal()  # recovery completes before readiness
+        return svc
 
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("EngineKV", svc)
@@ -560,6 +909,8 @@ def serve_engine_shardkv(
     join_gids: Optional[Sequence[int]] = None,
     gids: Optional[Sequence[int]] = None,
     peer_addrs: Optional[dict] = None,  # gid -> (host, port) of the owner
+    data_dir: Optional[str] = None,
+    checkpoint_every_s: float = 30.0,
 ) -> RpcNode:
     """The sharded engine behind TCP: BatchedShardKV (replicated config
     + per-shard migration pipeline) on one chip-owning process.
@@ -567,7 +918,13 @@ def serve_engine_shardkv(
     Fleet mode: pass ``gids`` (the global gids THIS process hosts; the
     local engine is sized ``len(gids)+1``) and ``peer_addrs`` (owner
     address for every remotely hosted gid) — shard migration then rides
-    ``pull_shard``/``delete_shard`` RPCs between processes."""
+    ``pull_shard``/``delete_shard`` RPCs between processes.
+
+    With ``data_dir`` the process is DURABLE (checkpoint + WAL of
+    client writes, admin ops, and migration inserts/deletes); a
+    restarted process recovers every acknowledged op, and in a fleet
+    the GC handshake is gated so a migrated-in blob is never the only
+    un-fsynced copy."""
     from ..engine.shardkv import BatchedShardKV
 
     node = RpcNode(listen=True, host=host, port=port)
@@ -581,14 +938,25 @@ def serve_engine_shardkv(
     }
 
     def build():
-        cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
-        driver = EngineDriver(cfg, seed=seed)
-        # Warm-up before readiness (see serve_engine_kv): elections +
-        # both tick compiles happen here, not under client traffic —
-        # the admin_sync join exercises the loaded variant.
-        ok = driver.run_until_quiet_leaders(2000)
-        assert ok, "engine groups failed to elect"
+        driver = None
+        if data_dir:
+            ckpt = os.path.join(data_dir, "engine.ckpt")
+            if os.path.exists(ckpt):
+                driver = EngineDriver.restore(ckpt)
+        restored = driver is not None
+        if not restored:
+            cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
+            driver = EngineDriver(cfg, seed=seed)
+            # Warm-up before readiness (see serve_engine_kv):
+            # elections + both tick compiles happen here, not under
+            # client traffic.
+            ok = driver.run_until_quiet_leaders(2000)
+            assert ok, "engine groups failed to elect"
         skv = BatchedShardKV(driver, gids=local_gids)
+        if restored:
+            blob = driver.restored_extra.get("service")
+            if blob:
+                skv.load_state_dict(blob)
         # Warm the LOADED tick variant before the readiness line (the
         # jit compile takes tens of seconds on CPU and would otherwise
         # land under the first admin/client RPC and time it out).  A
@@ -597,9 +965,22 @@ def serve_engine_shardkv(
         # fleet mode, where every process's history must stay aligned.
         skv.driver.start(0, None)
         skv.pump(8)
-        for gid in join_gids or []:
-            skv.admin_sync("join", [gid])
-        return EngineShardKVService(sched, skv, peers=peers)
+        if not restored:
+            # A restored process's config history lives in its
+            # checkpoint + WAL — re-running the bootstrap joins would
+            # allocate fresh ctrler ids the dedup table can't absorb
+            # and append a spurious config per restart.
+            for gid in join_gids or []:
+                skv.admin_sync("join", [gid])
+        dur = (
+            EngineDurability(data_dir, driver, skv,
+                             checkpoint_every_s=checkpoint_every_s)
+            if data_dir else None
+        )
+        svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
+        if dur is not None:
+            svc.replay_wal()  # recovery completes before readiness
+        return svc
 
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("EngineShardKV", svc)

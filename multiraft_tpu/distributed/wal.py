@@ -1,0 +1,131 @@
+"""Write-ahead log for the durable engine server.
+
+The reference persists every raft-state mutation through its Persister
+(reference quirk #6, raft/raft.go:205-216) — affordable when state is
+one group's log.  The batched engine cannot re-serialize ``[G, P, L]``
+tensors per op, so durability splits in two:
+
+* periodic whole-engine checkpoints (:meth:`EngineDriver.save`, atomic
+  at a tick boundary, service state in ``extra``), and
+* this WAL of acknowledged client/admin ops since the last checkpoint.
+
+Recovery = restore the checkpoint, then RE-SUBMIT every WAL record
+through consensus with its original ``(client_id, command_id)`` — the
+session dedup tables make replay exactly-once, the same machinery that
+absorbs duplicate RPCs (reference: kvraft/server.go:66-69).  Records
+already reflected in the checkpoint dedup to no-ops; records past it
+commit now.  A crash between checkpoint and rotation only makes replay
+redundant, never wrong.
+
+Framing mirrors ``DiskPersister``: per record ``magic ‖ crc32(len ‖
+body) ‖ len ‖ body``.  A torn tail record fails its checksum and is
+dropped — safe because acks gate on :meth:`sync` having covered the
+record (group fsync at pump cadence), so a torn record was never
+acknowledged.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator
+
+__all__ = ["WriteAheadLog"]
+
+_MAGIC = b"MRWL"
+_HEADER = struct.Struct("<4sIQ")  # magic, crc32(len ‖ body), len(body)
+_LEN = struct.Struct("<Q")
+
+
+class WriteAheadLog:
+    """Append-only record log with group fsync and atomic rotation.
+
+    Single-writer: the owning service appends/syncs from its loop
+    thread only.  ``seq`` numbers are per-incarnation (they gate acks,
+    they are not stored).
+    """
+
+    def __init__(self, path: str, fsync: bool = True) -> None:
+        self.path = path
+        self._fsync = fsync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        # Seqs are MONOTONIC for the whole incarnation — rotation must
+        # not reset them, because ack gates and the fleet GC gate hold
+        # seqs across it (a reset would turn synced(seq) false again
+        # and wedge a quiet server's ack waits forever).
+        self.appended = 0  # records appended by this incarnation
+        self.synced = 0    # records known durable
+
+    # -- recovery ---------------------------------------------------------
+
+    def replay(self) -> Iterator[bytes]:
+        """Yield every intact record body in append order, stopping at
+        the first torn/corrupt record (an unacknowledged tail).  Call
+        before appending."""
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return
+        off = 0
+        while off + _HEADER.size <= len(raw):
+            magic, crc, n = _HEADER.unpack_from(raw, off)
+            body = raw[off + _HEADER.size: off + _HEADER.size + n]
+            if (
+                magic != _MAGIC
+                or len(body) != n
+                or zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc
+            ):
+                return  # torn tail: never acked, drop it and stop
+            yield body
+            off += _HEADER.size + n
+
+    # -- append path ------------------------------------------------------
+
+    def append(self, body: bytes) -> int:
+        """Buffer one record; returns its seq (ack-gate with
+        ``synced >= seq`` after a :meth:`sync`)."""
+        crc = zlib.crc32(body, zlib.crc32(_LEN.pack(len(body))))
+        self._f.write(_HEADER.pack(_MAGIC, crc, len(body)))
+        self._f.write(body)
+        self.appended += 1
+        return self.appended
+
+    def sync(self) -> None:
+        """Group commit: make everything appended so far durable."""
+        if self.synced >= self.appended:
+            return
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+        self.synced = self.appended
+
+    # -- rotation (after a successful checkpoint) -------------------------
+
+    def rotate(self) -> None:
+        """Truncate to empty, atomically.  Call only after the covering
+        checkpoint is durable — a crash in between merely makes the
+        next replay redundant (dedup absorbs it)."""
+        self._f.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        if self._fsync:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        self._f = open(self.path, "ab")
+        # appended/synced deliberately NOT reset — see __init__.
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass

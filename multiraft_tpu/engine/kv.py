@@ -72,6 +72,11 @@ class BatchedKV(FrontierService):
             g: [] for g in self._record
         }
         self._next_client = 0
+        # Durability hook (distributed/engine_server.py): fired for
+        # every NON-DUPLICATE applied write, in apply (= commit) order
+        # — the WAL must be a commit-ordered redo log or replay can
+        # disagree with reads the old incarnation acknowledged.
+        self.on_write = None  # (group, KVOp)
 
     # -- submission (DeferredConsensus.submit) ---------------------------
 
@@ -163,6 +168,8 @@ class BatchedKV(FrontierService):
             out = ""
         if op.op != OP_GET and op.command_id > 0 and not dup:
             self.sessions[g][op.client_id] = op.command_id
+            if self.on_write is not None:
+                self.on_write(g, op)
         if ticket is not None and not ticket.done:
             ticket.done = True
             ticket.value = out

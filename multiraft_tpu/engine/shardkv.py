@@ -244,6 +244,20 @@ class BatchedShardKV(FrontierService):
         # Fleet-mode hooks (see class docstring); None = single-instance.
         self.remote_fetch = None
         self.remote_delete = None
+        # Durability hooks (distributed/engine_server.py): fired at
+        # apply time when a migration actually mutates shard state —
+        # the WAL must cover an inserted blob before the old owner may
+        # be told to GC it (the only remaining copy otherwise dies with
+        # an untimely crash), and replayed deletes clear stale
+        # BEPULLING slots that would wedge config advance after a
+        # restore from an older checkpoint.
+        self.on_insert = None  # (gid, shard, config_num, data, latest)
+        self.on_delete = None  # (gid, shard, config_num)
+        # Fired in apply (= commit) order — the durable WAL must be a
+        # commit-ordered redo log, not submit-ordered (evict-and-
+        # resubmit can commit in a different order than submission).
+        self.on_write = None   # (gid, _ClientOp), non-duplicate applies
+        self.on_ctrl = None    # (_CtrlOp), non-duplicate config applies
 
     # -- checkpoint (pairs with EngineDriver.save/restore) ----------------
 
@@ -461,6 +475,8 @@ class BatchedShardKV(FrontierService):
             cfg.shards[shard] = gid
         self.configs.append(cfg)
         self._route = jnp.asarray(np.array(cfg.shards, np.int32))
+        if self.on_ctrl is not None:
+            self.on_ctrl(op)
         self._resolve(op, now)
 
     def _apply_replica(self, rep: _Replica, op: Any, now: int) -> None:
@@ -491,6 +507,9 @@ class BatchedShardKV(FrontierService):
                 sh.data = dict(op.data)
                 sh.latest = dict(op.latest)
                 sh.state = GCING  # serve before the old copy is deleted
+                if self.on_insert is not None:
+                    self.on_insert(rep.gid, op.shard, op.config_num,
+                                   sh.data, sh.latest)
             rep.pending_insert.pop(op.shard, None)
             self._resolve(op, now)
         elif isinstance(op, _DeleteOp):
@@ -503,6 +522,8 @@ class BatchedShardKV(FrontierService):
                 sh = rep.shards[op.shard]
                 if sh.state == BEPULLING:
                     rep.shards[op.shard] = _ShardSlot()  # Challenge 1
+                    if self.on_delete is not None:
+                        self.on_delete(rep.gid, op.shard, op.config_num)
             self._resolve(op, now)  # < cur.num: already gone, idempotent
         elif isinstance(op, _ConfirmOp):
             sh = rep.shards[op.shard]
@@ -533,6 +554,8 @@ class BatchedShardKV(FrontierService):
         else:
             sh.data[op.key] = sh.data.get(op.key, "") + op.value
         sh.latest[op.client_id] = op.command_id
+        if self.on_write is not None:
+            self.on_write(rep.gid, op)
         self._resolve(op, now)
 
     # -- migration orchestration (the batched form of the tickers) ---------
