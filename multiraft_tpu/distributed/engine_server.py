@@ -208,36 +208,36 @@ class EngineKVService:
     def replay_wal(self) -> int:
         """Re-submit every WAL record through consensus (recovery path;
         runs to completion before the server starts answering).  Dedup
-        tables make records already in the checkpoint no-ops."""
+        tables make records already in the checkpoint no-ops.
+
+        STRICTLY one record at a time: the WAL is commit-ordered, and
+        replaying a client's cmd N and cmd N+1 concurrently lets an
+        eviction commit N+1 first — the session table then treats the
+        resubmitted N as a duplicate and its acked mutation is lost."""
         if self._dur is None:
             return 0
-        slots = []
-        for rec in self._dur.replay_records():
-            if rec[0] != "kv":
-                continue
+        recs = [rec for rec in self._dur.replay_records() if rec[0] == "kv"]
+        for rec in recs:
             _, op, key, value, cid, cmd = rec
-            slots.append([None, op, key, value, cid, cmd])
-        for s in slots:
-            s[0] = self._resubmit(s)
-        for _ in range(20_000):
-            if all(s[0].done and not s[0].failed for s in slots):
-                break
-            self.kv.pump(2)
-            for s in slots:
-                if s[0].done and s[0].failed:
-                    s[0] = self._resubmit(s)  # lost slot: propose again
-        else:
-            raise RuntimeError(
-                f"WAL replay did not converge ({len(slots)} records)"
-            )
-        return len(slots)
-
-    def _resubmit(self, s):
-        return self.kv.submit(
-            route_group(s[2], self.G),
-            KVOp(op=_OPCODE[s[1]], key=s[2], value=s[3],
-                 client_id=s[4], command_id=s[5]),
-        )
+            done = False
+            for _ in range(50):  # eviction retries
+                t = self.kv.submit(
+                    route_group(key, self.G),
+                    KVOp(op=_OPCODE[op], key=key, value=value,
+                         client_id=cid, command_id=cmd),
+                )
+                for _ in range(2000):
+                    if t.done:
+                        break
+                    self.kv.pump(2)
+                if t.done and not t.failed:
+                    done = True
+                    break
+            if not done:
+                raise RuntimeError(
+                    f"WAL replay of {op}({key!r}) did not converge"
+                )
+        return len(recs)
 
     def command(self, args: EngineCmdArgs):
         g = route_group(args.key, self.G)
@@ -542,11 +542,12 @@ class EngineShardKVService:
             self.skv.remote_fetch, self.skv.remote_delete = saved
         return len(recs)
 
-    def _pump_until(self, cond, max_rounds: int = 4000) -> None:
+    def _pump_until(self, cond, max_rounds: int = 4000) -> bool:
         for _ in range(max_rounds):
             if cond():
-                return
+                return True
             self.skv.pump(2)
+        return cond()
 
     def _retry_until_ok(self, propose, attempts: int = 50):
         """Propose-and-wait with eviction retry (leader churn during
@@ -577,8 +578,13 @@ class EngineShardKVService:
         # wait for orchestration to advance it there (earlier inserts/
         # configs already replayed), else the insert would silently
         # no-op and a later remote re-fetch could find the peer's copy
-        # already GC'd.
-        self._pump_until(lambda: rep.cur.num >= num)
+        # already GC'd.  A timeout here is a REAL failure (loud), not
+        # the already-in-checkpoint case (rep past num / not PULLING).
+        if not self._pump_until(lambda: rep.cur.num >= num):
+            raise RuntimeError(
+                f"replay: rep {gid} never reached config {num} "
+                f"(stuck at {rep.cur.num})"
+            )
         if rep.cur.num != num or rep.shards[shard].state != PULLING:
             return  # checkpoint already contains this insert's effects
 
@@ -893,6 +899,10 @@ def serve_engine_kv(
         svc = EngineKVService(sched, kv, durability=dur)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
+            # Fold the replayed state into a fresh checkpoint and
+            # rotate: bounds the next recovery, and discards the
+            # duplicate records the replay's own apply hooks appended.
+            dur.checkpoint()
         return svc
 
     svc = sched.run_call(build, timeout=600.0)
@@ -980,6 +990,7 @@ def serve_engine_shardkv(
         svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
+            dur.checkpoint()  # fold replay into a fresh checkpoint
         return svc
 
     svc = sched.run_call(build, timeout=600.0)

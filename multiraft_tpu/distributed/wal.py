@@ -50,6 +50,13 @@ class WriteAheadLog:
         self.path = path
         self._fsync = fsync
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Truncate any torn tail BEFORE appending: records written
+        # after leftover garbage would be unreachable to every future
+        # replay (it stops at the first bad record) — silently losing
+        # the next incarnation's acked writes.
+        valid = self._valid_prefix_len()
+        if valid is not None:
+            os.truncate(path, valid)
         self._f = open(path, "ab")
         # Seqs are MONOTONIC for the whole incarnation — rotation must
         # not reset them, because ack gates and the fleet GC gate hold
@@ -57,6 +64,27 @@ class WriteAheadLog:
         # and wedge a quiet server's ack waits forever).
         self.appended = 0  # records appended by this incarnation
         self.synced = 0    # records known durable
+
+    def _valid_prefix_len(self):
+        """Byte length of the intact record prefix, or None if the file
+        is missing or already fully valid."""
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        off = 0
+        while off + _HEADER.size <= len(raw):
+            magic, crc, n = _HEADER.unpack_from(raw, off)
+            body = raw[off + _HEADER.size: off + _HEADER.size + n]
+            if (
+                magic != _MAGIC
+                or len(body) != n
+                or zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc
+            ):
+                return off
+            off += _HEADER.size + n
+        return off if off < len(raw) else None
 
     # -- recovery ---------------------------------------------------------
 

@@ -463,6 +463,15 @@ class EngineDriver:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)  # atomic: a crash mid-save keeps the old one
+        # Make the rename itself durable: the durable-server protocol
+        # truncates its WAL right after this call, and on power loss
+        # POSIX gives no cross-file ordering — the truncation must not
+        # become durable while the checkpoint rename does not.
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         return path
 
     @classmethod

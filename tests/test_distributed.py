@@ -789,3 +789,93 @@ def test_engine_fleet_durable_process_restart(tmp_path):
             ck.close()
     finally:
         fleet.shutdown()
+
+
+@needs_native
+def test_engine_fleet_linearizable_across_migration(tmp_path):
+    """Fleet linearizability: concurrent clerks drive two chip-owning
+    processes while a join migrates shards BETWEEN them; client-side
+    wall-clock histories must stay linearizable under porcupine, and
+    appends exactly-once, across the cross-process migration."""
+    import threading
+    import time
+
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+    from multiraft_tpu.porcupine.kv import (
+        OP_APPEND,
+        OP_GET,
+        KvInput,
+        KvOutput,
+        kv_model,
+    )
+    from multiraft_tpu.porcupine.model import Operation
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    fleet = EngineFleetCluster([[1], [2]], seed=17)
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        history = []
+        hist_lock = threading.Lock()
+        keys = ["fa", "fb", "fc"]
+
+        def worker(wid):
+            ck = fleet.clerk()
+            try:
+                for j in range(8):
+                    key = keys[(wid + j) % len(keys)]
+                    t0 = time.monotonic()
+                    if j % 3 == 2:
+                        v = ck.get(key)
+                        inp = KvInput(op=OP_GET, key=key)
+                        out = KvOutput(value=v)
+                    else:
+                        tag = f"({wid}.{j})"
+                        ck.append(key, tag)
+                        inp = KvInput(op=OP_APPEND, key=key, value=tag)
+                        out = KvOutput(value="")
+                    with hist_lock:
+                        history.append(
+                            Operation(
+                                client_id=ck.client_id,
+                                input=inp,
+                                call=t0,
+                                output=out,
+                                ret=time.monotonic(),
+                            )
+                        )
+            finally:
+                ck.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        # Join gid 2 WHILE clerk traffic flows: shards migrate to the
+        # second process mid-history.
+        fleet.admin("join", [2])
+        for t in threads:
+            t.join()
+
+        ck = fleet.clerk()
+        try:
+            for key in keys:
+                v = ck.get(key)
+                for wid in range(3):
+                    for j in range(8):
+                        tag = f"({wid}.{j})"
+                        expected = (
+                            keys[(wid + j) % len(keys)] == key and j % 3 != 2
+                        )
+                        assert v.count(tag) == (1 if expected else 0), (
+                            f"{tag} appears {v.count(tag)}x in {key}={v!r}"
+                        )
+        finally:
+            ck.close()
+        assert len(history) == 24
+        assert_linearizable(
+            kv_model, history, timeout=30.0, name="engine-fleet-migration"
+        )
+    finally:
+        fleet.shutdown()

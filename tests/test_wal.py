@@ -75,3 +75,23 @@ def test_empty_and_missing(tmp_path):
     os.remove(p)
     w = WriteAheadLog(p, fsync=False)
     assert list(w.replay()) == []
+
+
+def test_append_after_torn_tail_reaches_replay(tmp_path):
+    """Records appended by a new incarnation after a torn tail must be
+    replayable — the constructor truncates the garbage first (otherwise
+    every later record hides behind the bad one forever)."""
+    p = str(tmp_path / "wal.bin")
+    w = WriteAheadLog(p, fsync=False)
+    w.append(b"old-1")
+    w.append(b"old-2")
+    w.sync()
+    w.close()
+    with open(p, "ab") as f:
+        f.write(b"MRWL\xde\xad")  # torn record from a crash mid-append
+    w2 = WriteAheadLog(p, fsync=False)  # truncates the tail
+    w2.append(b"new-after-crash")
+    w2.sync()
+    w2.close()
+    got = list(WriteAheadLog(p, fsync=False).replay())
+    assert got == [b"old-1", b"old-2", b"new-after-crash"]
