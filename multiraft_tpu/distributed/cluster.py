@@ -260,6 +260,7 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             seed=spec.get("seed", 0),
             data_dir=spec.get("data_dir"),
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
+            mesh_devices=spec.get("mesh_devices", 0),
         )
     elif kind == "engine_shardkv":
         _pin_platform(spec)
@@ -272,6 +273,7 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             join_gids=spec.get("join_gids"),
             data_dir=spec.get("data_dir"),
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
+            mesh_devices=spec.get("mesh_devices", 0),
         )
     elif kind == "engine_fleet":
         _pin_platform(spec)
@@ -288,6 +290,7 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             },
             data_dir=spec.get("data_dir"),
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
+            mesh_devices=spec.get("mesh_devices", 0),
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
@@ -437,6 +440,7 @@ class EngineProcessCluster:
         join_gids: Optional[List[int]] = None,
         data_dir: Optional[str] = None,
         checkpoint_every_s: float = 30.0,
+        mesh_devices: int = 0,
     ) -> None:
         assert kind in ("engine_kv", "engine_shardkv")
         self.kind = kind
@@ -455,6 +459,10 @@ class EngineProcessCluster:
             # start() then recovers every acknowledged op.
             self.spec["data_dir"] = data_dir
             self.spec["checkpoint_every_s"] = checkpoint_every_s
+        if mesh_devices:
+            # Multi-chip mode: the server runs the shard_map tick over
+            # this many local devices (G must divide evenly).
+            self.spec["mesh_devices"] = mesh_devices
         self.proc: Optional[subprocess.Popen] = None
 
     @property

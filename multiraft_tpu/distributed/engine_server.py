@@ -145,6 +145,24 @@ def route_group(key: str, G: int) -> int:
     return zlib.crc32(key.encode()) % G
 
 
+def _make_mesh(n_devices: int):
+    """A 1-D ``groups`` mesh over the first ``n_devices`` local devices
+    — the production entry to the shard_map tick (engine/mesh.py): the
+    server's state lives sharded across its chips, consensus stays
+    zero-collective, and the same driver/pump/checkpoint path serves
+    single- and multi-chip alike."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices > len(devs):
+        raise ValueError(
+            f"mesh_devices={n_devices} > {len(devs)} visible devices"
+        )
+    return Mesh(np.array(devs[:n_devices]), ("groups",))
+
+
 class EngineKVService:
     """``EngineKV.command`` RPC front for a :class:`BatchedKV`.
 
@@ -853,6 +871,7 @@ def serve_engine_kv(
     record_groups: Optional[Sequence[int]] = None,
     data_dir: Optional[str] = None,
     checkpoint_every_s: float = 30.0,
+    mesh_devices: int = 0,
 ) -> RpcNode:
     """Bring up the chip-owning engine KV server process: one
     EngineDriver (G groups), a BatchedKV, the pump loop, and a
@@ -862,16 +881,22 @@ def serve_engine_kv(
     With ``data_dir``, the server is DURABLE: periodic atomic
     checkpoints + a write-ahead log of acked ops (see EngineDurability)
     — a kill -9'd process restarted on the same dir recovers every
-    acknowledged write."""
+    acknowledged write.
+
+    With ``mesh_devices`` > 0, the engine runs the shard_map tick over
+    that many local chips (G must divide evenly) — the multi-chip
+    production path; checkpoints restore back onto the same-size
+    mesh."""
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
 
     def build():
+        mesh = _make_mesh(mesh_devices) if mesh_devices else None
         driver = None
         if data_dir:
             ckpt = os.path.join(data_dir, "engine.ckpt")
             if os.path.exists(ckpt):
-                driver = EngineDriver.restore(ckpt)
+                driver = EngineDriver.restore(ckpt, mesh=mesh)
         if driver is not None:
             kv = BatchedKV(driver, record_groups=list(record_groups or []))
             blob = driver.restored_extra.get("service")
@@ -879,7 +904,7 @@ def serve_engine_kv(
                 kv.load_state_dict(blob)
         else:
             cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
-            driver = EngineDriver(cfg, seed=seed)
+            driver = EngineDriver(cfg, seed=seed, mesh=mesh)
             kv = BatchedKV(driver, record_groups=list(record_groups or []))
             driver.run_until_quiet_leaders(2000)
         # Warm-up BEFORE the readiness line: elect leaders and compile
@@ -921,6 +946,7 @@ def serve_engine_shardkv(
     peer_addrs: Optional[dict] = None,  # gid -> (host, port) of the owner
     data_dir: Optional[str] = None,
     checkpoint_every_s: float = 30.0,
+    mesh_devices: int = 0,
 ) -> RpcNode:
     """The sharded engine behind TCP: BatchedShardKV (replicated config
     + per-shard migration pipeline) on one chip-owning process.
@@ -948,15 +974,16 @@ def serve_engine_shardkv(
     }
 
     def build():
+        mesh = _make_mesh(mesh_devices) if mesh_devices else None
         driver = None
         if data_dir:
             ckpt = os.path.join(data_dir, "engine.ckpt")
             if os.path.exists(ckpt):
-                driver = EngineDriver.restore(ckpt)
+                driver = EngineDriver.restore(ckpt, mesh=mesh)
         restored = driver is not None
         if not restored:
             cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
-            driver = EngineDriver(cfg, seed=seed)
+            driver = EngineDriver(cfg, seed=seed, mesh=mesh)
             # Warm-up before readiness (see serve_engine_kv):
             # elections + both tick compiles happen here, not under
             # client traffic.
