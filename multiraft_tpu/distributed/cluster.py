@@ -513,6 +513,7 @@ class EngineFleetCluster:
         seed: int = 0,
         data_dir: Optional[str] = None,
         checkpoint_every_s: float = 30.0,
+        mesh_devices: int = 0,
     ) -> None:
         # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
         # codec — admin replies are refused as unregistered otherwise.
@@ -541,6 +542,11 @@ class EngineFleetCluster:
             if data_dir is not None:
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
                 spec["checkpoint_every_s"] = checkpoint_every_s
+            if mesh_devices:
+                # Each process runs its engine over a local mesh; its
+                # len(gids)+1 engine groups must divide evenly over
+                # mesh_devices (loud error from engine/mesh.py if not).
+                spec["mesh_devices"] = mesh_devices
             self.specs.append(spec)
         self.procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._admin_node: Optional[RpcNode] = None

@@ -155,6 +155,8 @@ def _make_mesh(n_devices: int):
     import numpy as np
     from jax.sharding import Mesh
 
+    if n_devices <= 0:
+        raise ValueError(f"mesh_devices must be positive, got {n_devices}")
     devs = jax.devices()
     if n_devices > len(devs):
         raise ValueError(

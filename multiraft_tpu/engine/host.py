@@ -496,6 +496,16 @@ class EngineDriver:
                 f"checkpoint was taken from a {saved_mesh}-device mesh "
                 f"driver; pass restore(..., mesh=) to re-shard it"
             )
+        if saved_mesh and mesh is not None and (
+            int(mesh.devices.size) != saved_mesh
+        ):
+            # Silently concentrating N× the per-chip state on a smaller
+            # mesh is an OOM/perf cliff, not a config the operator
+            # asked for — loud beats lucky.
+            raise ValueError(
+                f"checkpoint was taken on {saved_mesh} devices but "
+                f"restore got a {int(mesh.devices.size)}-device mesh"
+            )
         d = object.__new__(cls)  # skip __init__: no throwaway device state
         d._init_host(blob["cfg"], seed=0)
         d.state = EngineState(
