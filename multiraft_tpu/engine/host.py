@@ -494,7 +494,8 @@ class EngineDriver:
         if saved_mesh and mesh is None:
             raise ValueError(
                 f"checkpoint was taken from a {saved_mesh}-device mesh "
-                f"driver; pass restore(..., mesh=) to re-shard it"
+                f"driver; pass restore(..., mesh=) with a "
+                f"{saved_mesh}-device mesh to re-shard it"
             )
         if saved_mesh and mesh is not None and (
             int(mesh.devices.size) != saved_mesh

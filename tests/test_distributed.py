@@ -915,3 +915,31 @@ def test_engine_kv_mesh_durable_restart(tmp_path):
             ck.close()
     finally:
         cluster.shutdown()
+
+
+@needs_native
+def test_engine_fleet_mesh_migration(tmp_path):
+    """Fleet × mesh: two processes, each running its engine over a
+    2-virtual-device mesh, migrating shards between them over TCP."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+
+    fleet = EngineFleetCluster(
+        [[1], [2]], seed=29, mesh_devices=2,
+        data_dir=str(tmp_path / "fleet-mesh"),  # durable + mesh together
+    )
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        ck = fleet.clerk()
+        try:
+            kv = {chr(110 + i): f"v{i}" for i in range(6)}
+            for k, v in kv.items():
+                ck.put(k, v)
+            fleet.admin("join", [2])  # cross-process, cross-mesh migration
+            assert all(ck.get(k) == v for k, v in kv.items())
+            ck.append("n", "+mesh")
+            assert ck.get("n") == kv["n"] + "+mesh"
+        finally:
+            ck.close()
+    finally:
+        fleet.shutdown()

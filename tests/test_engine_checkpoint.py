@@ -231,3 +231,34 @@ def test_checkpoint_midmigration_resumes_orchestration(tmp_path):
     for k in keys:
         v = skv2.get_fast(k)
         assert v.err == OK and v.value == "m" + k
+
+
+def test_mesh_size_mismatch_rejected(tmp_path):
+    """A checkpoint taken on an N-device mesh must refuse a different-
+    size mesh at restore (silent re-concentration = OOM/perf cliff)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import jax
+
+    devs = jax.devices()
+    mesh4 = Mesh(np.array(devs[:4]), ("groups",))
+    mesh2 = Mesh(np.array(devs[:2]), ("groups",))
+    d = EngineDriver(
+        EngineConfig(G=8, P=3, L=32, E=4, INGEST=4), seed=5, mesh=mesh4
+    )
+    d.step(5)
+    path = str(tmp_path / "mesh.pkl")
+    d.save(path)
+    with pytest.raises(ValueError, match="4 devices"):
+        EngineDriver.restore(path, mesh=mesh2)
+    # Same size restores fine.
+    EngineDriver.restore(path, mesh=mesh4)
+
+
+def test_make_mesh_rejects_nonpositive():
+    from multiraft_tpu.distributed.engine_server import _make_mesh
+
+    for bad in (0, -1, -4):
+        with pytest.raises(ValueError, match="positive"):
+            _make_mesh(bad)
