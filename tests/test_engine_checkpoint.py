@@ -236,10 +236,8 @@ def test_checkpoint_midmigration_resumes_orchestration(tmp_path):
 def test_mesh_size_mismatch_rejected(tmp_path):
     """A checkpoint taken on an N-device mesh must refuse a different-
     size mesh at restore (silent re-concentration = OOM/perf cliff)."""
-    import numpy as np
-    from jax.sharding import Mesh
-
     import jax
+    from jax.sharding import Mesh
 
     devs = jax.devices()
     mesh4 = Mesh(np.array(devs[:4]), ("groups",))

@@ -253,3 +253,31 @@ def test_throughput():
         sched.run_until(end.call("JunkServer.handler2", i))
     per_rpc = (sched.now - t0) / n
     assert per_rpc < 100e-6  # virtual 22 µs-ish per RPC
+
+
+def test_concurrent_one_end():
+    """20 concurrent calls through ONE shared ClientEnd; all complete,
+    all deliveries land, counters add up (reference:
+    labrpc/test_test.go:386-441 TestConcurrentOne — many goroutines on
+    a single end; here many coroutines on a single end)."""
+    sched, net = make_net()
+    js = JunkServer()
+    srv = Server()
+    srv.add_service(Service(js, name="JunkServer"))
+    net.add_server(1000, srv)
+    end = net.make_end("c")
+    net.connect("c", 1000)
+    net.enable("c", True)
+
+    nrpcs = 20
+
+    def one_call(i):
+        reply = yield end.call("JunkServer.handler2", 100 + i)
+        assert reply == f"handler2-{100 + i}"
+        return 1
+
+    futs = [sched.spawn(one_call(i)) for i in range(nrpcs)]
+    total = sum(sched.run_until(f) for f in futs)
+    assert total == nrpcs
+    assert len(js.log2) == nrpcs
+    assert net.get_count(1000) == total
