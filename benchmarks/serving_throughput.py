@@ -1,0 +1,83 @@
+"""Served-engine throughput: concurrent clerks over real sockets.
+
+The transport echo bench (transport_echo.py) measures serial RPC
+latency; this measures the serving dimension that actually matters for
+the sidecar story — how many client ops/s one chip-owning engine
+server sustains when many clerks pipeline into the pump loop.  Each
+pump coalesces every command that arrived since the last one into a
+single device step, so throughput scales with concurrency until the
+pump (or the box) saturates, while per-op latency stays ~pump-bounded.
+
+Usage::
+
+    python -m benchmarks.serving_throughput [n_clerks] [ops_per_clerk]
+
+One JSON line: {"clerks": K, "ops": N, "ops_per_sec": R,
+"mean_latency_ms": L}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench(n_clerks: int = 16, ops_per_clerk: int = 50) -> dict:
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.distributed.engine_server import EngineClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=64, seed=41)
+    node = None
+    try:
+        cluster.start()
+        node = RpcNode()
+        end = node.client_end(cluster.host, cluster.port)
+        sched = node.sched
+
+        # Warm up the connection + both server tick variants.
+        warm = EngineClerk(sched, end)
+        assert sched.wait(sched.spawn(warm.put("warm", "1")), 30.0) is not TIMEOUT
+
+        lat_acc = []
+
+        def clerk_driver(i):
+            ck = EngineClerk(sched, end)
+            for j in range(ops_per_clerk):
+                t0 = time.perf_counter()
+                if j % 3 == 2:
+                    yield from ck.get(f"k{i}-{j % 5}")
+                else:
+                    yield from ck.put(f"k{i}-{j % 5}", f"v{j}")
+                lat_acc.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        futs = [sched.spawn(clerk_driver(i)) for i in range(n_clerks)]
+        for f in futs:
+            assert sched.wait(f, 600.0) is not TIMEOUT
+        elapsed = time.perf_counter() - t0
+        total = n_clerks * ops_per_clerk
+        return {
+            "clerks": n_clerks,
+            "ops": total,
+            "ops_per_sec": round(total / elapsed, 1),
+            "mean_latency_ms": round(
+                1e3 * sum(lat_acc) / max(1, len(lat_acc)), 2
+            ),
+        }
+    finally:
+        if node is not None:
+            node.close()
+        cluster.shutdown()
+
+
+def main(argv) -> None:
+    n_clerks = int(argv[1]) if len(argv) > 1 else 16
+    ops = int(argv[2]) if len(argv) > 2 else 50
+    print(json.dumps(bench(n_clerks, ops)), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

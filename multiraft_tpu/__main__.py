@@ -1,0 +1,173 @@
+"""Command-line entry: serve engines and talk to them.
+
+The reference ships as a Go library driven by `go test`; this
+framework additionally deploys.  The CLI wraps the server entrypoints
+(`distributed.engine_server`) and a one-shot client so an operator can
+stand up a chip-owning KV service and poke it without writing code:
+
+    python -m multiraft_tpu serve-kv --port 7000 --groups 64 \
+        --data-dir /var/lib/mrt --platform tpu
+    python -m multiraft_tpu kv put  --addr 127.0.0.1:7000 greeting hello
+    python -m multiraft_tpu kv get  --addr 127.0.0.1:7000 greeting
+
+Sharded/fleet serving uses the same flags plus --gids/--peer; process
+supervision (restart-on-crash, placement) belongs to the operator's
+init system — a restarted durable server recovers from --data-dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _pin(platform: str) -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception as exc:
+        if platform != "cpu":
+            raise RuntimeError(f"could not pin platform {platform}: {exc}")
+
+
+def _serve_forever(args, build) -> int:
+    """Shared serve scaffold: pin the backend, build the node, print
+    the readiness line, park the main thread."""
+    _pin(args.platform)
+    node = build()
+    print(f"ready {node.port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _cmd_serve_kv(args) -> int:
+    def build():
+        from .distributed.engine_server import serve_engine_kv
+
+        return serve_engine_kv(
+            port=args.port,
+            G=args.groups,
+            host=args.host,
+            seed=args.seed,
+            data_dir=args.data_dir,
+            checkpoint_every_s=args.checkpoint_every,
+            mesh_devices=args.mesh_devices,
+        )
+
+    return _serve_forever(args, build)
+
+
+def _cmd_serve_shardkv(args) -> int:
+    def build():
+        from .distributed.engine_server import serve_engine_shardkv
+
+        peer_addrs = {}
+        for spec in args.peer or []:
+            gid, addr = spec.split("=", 1)
+            h, p = addr.rsplit(":", 1)
+            peer_addrs[int(gid)] = (h, int(p))
+        gids = [int(g) for g in args.gids.split(",")] if args.gids else None
+        return serve_engine_shardkv(
+            port=args.port,
+            G=args.groups,
+            host=args.host,
+            seed=args.seed,
+            join_gids=(
+                [int(g) for g in args.join.split(",")] if args.join else None
+            ),
+            gids=gids,
+            peer_addrs=peer_addrs or None,
+            data_dir=args.data_dir,
+            checkpoint_every_s=args.checkpoint_every,
+            mesh_devices=args.mesh_devices,
+        )
+
+    return _serve_forever(args, build)
+
+
+def _cmd_kv(args) -> int:
+    from .distributed.engine_server import EngineClerk
+    from .distributed.tcp import RpcNode
+    from .sim.scheduler import TIMEOUT
+
+    if args.op != "get" and args.value is None:
+        # Silently writing "" on a forgotten value would be data
+        # destruction with exit code 0.
+        print(f"error: kv {args.op} requires a VALUE", file=sys.stderr)
+        return 2
+    h, p = args.addr.rsplit(":", 1)
+    node = RpcNode()
+    try:
+        end = node.client_end(h, int(p))
+        ck = EngineClerk(node.sched, end, service=args.service)
+        if args.op == "get":
+            gen = ck.get(args.key)
+        elif args.op == "put":
+            gen = ck.put(args.key, args.value)
+        else:
+            gen = ck.append(args.key, args.value)
+        out = node.sched.wait(node.sched.spawn(gen), args.timeout)
+        if out is TIMEOUT:
+            print("error: server did not answer", file=sys.stderr)
+            return 1
+        if args.op == "get":
+            print(out)
+        return 0
+    finally:
+        node.close()
+
+
+def _add_serve_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = ephemeral, printed on ready)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--groups", type=int, default=64,
+                   help="engine consensus groups (G)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data-dir", default=None,
+                   help="enable durability (checkpoints + WAL) here")
+    p.add_argument("--checkpoint-every", type=float, default=30.0,
+                   metavar="SECONDS")
+    p.add_argument("--mesh-devices", type=int, default=0,
+                   help="run the tick over this many local chips")
+    p.add_argument("--platform", default="cpu", choices=("cpu", "tpu"),
+                   help="pin the jax backend (tpu = own the chip)")
+
+
+def main(argv=None) -> int:
+    top = argparse.ArgumentParser(prog="multiraft_tpu", description=__doc__)
+    sub = top.add_subparsers(dest="cmd", required=True)
+
+    s1 = sub.add_parser("serve-kv", help="chip-owning engine KV server")
+    _add_serve_flags(s1)
+    s1.set_defaults(fn=_cmd_serve_kv)
+
+    s2 = sub.add_parser("serve-shardkv",
+                        help="sharded engine server (standalone or fleet)")
+    _add_serve_flags(s2)
+    s2.add_argument("--join", default=None, metavar="GID,GID",
+                    help="bootstrap-join these gids before readiness")
+    s2.add_argument("--gids", default=None, metavar="GID,GID",
+                    help="fleet mode: the global gids THIS process hosts")
+    s2.add_argument("--peer", action="append", metavar="GID=HOST:PORT",
+                    help="fleet mode: owner address of a remote gid")
+    s2.set_defaults(fn=_cmd_serve_shardkv)
+
+    s3 = sub.add_parser("kv", help="one-shot client op")
+    s3.add_argument("op", choices=("get", "put", "append"))
+    s3.add_argument("key")
+    s3.add_argument("value", nargs="?", default=None)
+    s3.add_argument("--addr", required=True, metavar="HOST:PORT")
+    s3.add_argument("--service", default="EngineKV",
+                    choices=("EngineKV", "EngineShardKV"))
+    s3.add_argument("--timeout", type=float, default=30.0)
+    s3.set_defaults(fn=_cmd_kv)
+
+    args = top.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

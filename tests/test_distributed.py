@@ -7,6 +7,7 @@ reference: kvraft/config.go — but over real sockets and real crashes).
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 import pytest
@@ -943,3 +944,51 @@ def test_engine_fleet_mesh_migration(tmp_path):
             ck.close()
     finally:
         fleet.shutdown()
+
+
+@needs_native
+def test_cli_serve_and_kv_roundtrip(tmp_path):
+    """The CLI end-to-end: `python -m multiraft_tpu serve-kv` in a
+    subprocess, one-shot `kv put/get` clients against it."""
+    import subprocess
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiraft_tpu", "serve-kv",
+         "--groups", "16", "--data-dir", str(tmp_path / "cli")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    try:
+        line = ""
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break  # server died pre-readiness; don't spin on EOF
+            line = proc.stdout.readline()
+            if line.startswith("ready"):
+                break
+        assert line.startswith("ready"), (
+            f"no readiness line: {line!r} (exit={proc.poll()})"
+        )
+        port = int(line.split()[1])
+        addr = f"127.0.0.1:{port}"
+
+        def cli(*args):
+            return subprocess.run(
+                [sys.executable, "-m", "multiraft_tpu", *args],
+                capture_output=True, text=True, env=env, timeout=120,
+            )
+
+        r = cli("kv", "put", "greeting", "hello", "--addr", addr)
+        assert r.returncode == 0, r.stderr
+        r = cli("kv", "append", "greeting", " world", "--addr", addr)
+        assert r.returncode == 0, r.stderr
+        r = cli("kv", "get", "greeting", "--addr", addr)
+        assert r.returncode == 0 and r.stdout.strip() == "hello world", (
+            r.stdout, r.stderr)
+    finally:
+        proc.kill()
+        proc.wait()
