@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 
 
 def _pin(platform: str) -> None:
@@ -34,12 +33,28 @@ def _pin(platform: str) -> None:
 
 def _serve_forever(args, build) -> int:
     """Shared serve scaffold: pin the backend, build the node, print
-    the readiness line, park the main thread."""
+    the readiness line, park the main thread.
+
+    SIGTERM/SIGINT shut down gracefully: a durable server writes a
+    final checkpoint (rotating the WAL away), so the next start
+    recovers instantly instead of replaying — kill -9 remains the
+    crash path and recovers via WAL replay."""
+    import signal
+    import threading
+
     _pin(args.platform)
     node = build()
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
     print(f"ready {node.port}", flush=True)
-    while True:
-        time.sleep(3600)
+    stop.wait()
+    svc = getattr(node, "engine_service", None)
+    if svc is not None:
+        # On the loop thread: checkpoint at a tick boundary, not mid-pump.
+        node.sched.run_call(svc.final_checkpoint, timeout=600.0)
+    node.close()
+    return 0
 
 
 def _cmd_serve_kv(args) -> int:

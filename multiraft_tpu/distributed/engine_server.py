@@ -212,6 +212,15 @@ class EngineKVService:
     def stop(self) -> None:
         self._stopped = True
 
+    def final_checkpoint(self) -> bool:
+        """Graceful-shutdown hook (CLI SIGTERM): fold everything into
+        one last checkpoint so the next start skips WAL replay.  False
+        when the server is not durable."""
+        if self._dur is None:
+            return False
+        self._dur.checkpoint()
+        return True
+
     def _pump_loop(self) -> None:
         if self._stopped:
             return
@@ -501,6 +510,13 @@ class EngineShardKVService:
 
     def stop(self) -> None:
         self._stopped = True
+
+    def final_checkpoint(self) -> bool:
+        """Graceful-shutdown hook — see EngineKVService."""
+        if self._dur is None:
+            return False
+        self._dur.checkpoint()
+        return True
 
     def _pump_loop(self) -> None:
         if self._stopped:

@@ -946,6 +946,33 @@ def test_engine_fleet_mesh_migration(tmp_path):
         fleet.shutdown()
 
 
+def _wait_cli_ready(proc, timeout=240.0):
+    """Read the CLI server's readiness line without blocking past the
+    deadline (a wedged pre-readiness server must fail, not hang)."""
+    import select as _select
+
+    deadline = time.time() + timeout
+    buf = ""
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break  # died pre-readiness
+        r, _, _ = _select.select([proc.stdout], [], [], 1.0)
+        if not r:
+            continue
+        chunk = os.read(proc.stdout.fileno(), 4096).decode("utf-8", "replace")
+        if chunk == "":
+            break
+        buf += chunk
+        if "\n" in buf:
+            line = buf.split("\n", 1)[0]
+            assert line.startswith("ready"), f"bad readiness: {line!r}"
+            return int(line.split()[1])
+    raise AssertionError(
+        f"no readiness line within {timeout:.0f}s (exit={proc.poll()}, "
+        f"buf={buf!r})"
+    )
+
+
 @needs_native
 def test_cli_serve_and_kv_roundtrip(tmp_path):
     """The CLI end-to-end: `python -m multiraft_tpu serve-kv` in a
@@ -962,18 +989,7 @@ def test_cli_serve_and_kv_roundtrip(tmp_path):
         env=env, text=True,
     )
     try:
-        line = ""
-        deadline = time.time() + 240
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                break  # server died pre-readiness; don't spin on EOF
-            line = proc.stdout.readline()
-            if line.startswith("ready"):
-                break
-        assert line.startswith("ready"), (
-            f"no readiness line: {line!r} (exit={proc.poll()})"
-        )
-        port = int(line.split()[1])
+        port = _wait_cli_ready(proc)
         addr = f"127.0.0.1:{port}"
 
         def cli(*args):
@@ -988,6 +1004,58 @@ def test_cli_serve_and_kv_roundtrip(tmp_path):
         assert r.returncode == 0, r.stderr
         r = cli("kv", "get", "greeting", "--addr", addr)
         assert r.returncode == 0 and r.stdout.strip() == "hello world", (
+            r.stdout, r.stderr)
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+@needs_native
+def test_cli_sigterm_checkpoints_before_exit(tmp_path):
+    """Graceful shutdown: SIGTERM makes a durable server write a final
+    checkpoint and rotate its WAL, so the next start recovers from the
+    checkpoint alone (empty WAL = instant replay)."""
+    import signal
+    import subprocess
+
+    from multiraft_tpu.distributed.wal import WriteAheadLog
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    data = tmp_path / "graceful"
+
+    def start():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "multiraft_tpu", "serve-kv",
+             "--groups", "16", "--data-dir", str(data),
+             "--checkpoint-every", "3600"],  # no periodic checkpoints
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+        return p, _wait_cli_ready(p)
+
+    proc, port = start()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "multiraft_tpu", "kv", "put",
+             "grace", "ful", "--addr", f"127.0.0.1:{port}"],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+        # The final checkpoint rotated the WAL: nothing left to replay.
+        assert os.path.exists(data / "engine.ckpt")
+        assert list(WriteAheadLog(str(data / "ops.wal"), fsync=False).replay()) == []
+        # Recovery from the checkpoint alone.
+        proc, port = start()
+        r = subprocess.run(
+            [sys.executable, "-m", "multiraft_tpu", "kv", "get",
+             "grace", "--addr", f"127.0.0.1:{port}"],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0 and r.stdout.strip() == "ful", (
             r.stdout, r.stderr)
     finally:
         proc.kill()
