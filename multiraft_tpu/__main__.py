@@ -45,8 +45,19 @@ def _serve_forever(args, build) -> int:
     _pin(args.platform)
     node = build()
     stop = threading.Event()
+
+    def _on_signal(*_):
+        if stop.is_set():
+            # Second signal: the graceful path is wedged (e.g. a stalled
+            # device mid-checkpoint) — force-exit like the pre-handler
+            # behavior instead of sitting out the run_call timeout.
+            import os as _os
+
+            _os._exit(130)
+        stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, _on_signal)
     print(f"ready {node.port}", flush=True)
     stop.wait()
     svc = getattr(node, "engine_service", None)

@@ -939,6 +939,8 @@ def serve_engine_kv(
                              checkpoint_every_s=checkpoint_every_s)
             if data_dir else None
         )
+        if node.tracer is not None:
+            driver.tracer = node.tracer  # ticks + RPCs on one timeline
         svc = EngineKVService(sched, kv, durability=dur)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
@@ -1032,6 +1034,8 @@ def serve_engine_shardkv(
                              checkpoint_every_s=checkpoint_every_s)
             if data_dir else None
         )
+        if node.tracer is not None:
+            driver.tracer = node.tracer  # ticks + RPCs on one timeline
         svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness

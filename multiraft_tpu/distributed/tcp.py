@@ -56,6 +56,8 @@ class TcpClientEnd:
 class RpcNode:
     """One process's RPC endpoint: optional listener + outbound calls."""
 
+    _trace_seq = itertools.count()  # unique trace filenames per process
+
     def __init__(
         self,
         listen: bool = False,
@@ -75,6 +77,26 @@ class RpcNode:
         self._closed = False
         # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
         self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
+        # MRT_TRACE_DIR=<dir>: record a Chrome-trace span per handled
+        # RPC (dispatch → reply), saved on close().  Engine servers
+        # additionally point their driver's tick spans at the same
+        # tracer, so one timeline shows RPC handling interleaved with
+        # device ticks.  Listening nodes only — pure clients handle no
+        # RPCs and would litter the dir with empty files.
+        self.tracer = None
+        self._trace_path = None
+        trace_dir = os.environ.get("MRT_TRACE_DIR")
+        if trace_dir and listen:
+            from ..utils.trace import Tracer
+
+            os.makedirs(trace_dir, exist_ok=True)
+            self.tracer = Tracer()
+            # Process-local counter, not id(self): CPython recycles ids,
+            # and a recycled id would overwrite an earlier node's trace.
+            seq = next(RpcNode._trace_seq)
+            self._trace_path = os.path.join(
+                trace_dir, f"rpc-{os.getpid()}-{seq}.json"
+            )
         # Adaptive busy-poll: a serial RPC's next event lands tens of
         # µs out, so spinning that long before blocking removes the
         # futex wake from the round trip.  Pointless (and harmful —
@@ -197,6 +219,19 @@ class RpcNode:
 
     def _dispatch(self, conn: int, req_id: int, svc_meth: str, args: Any) -> None:
         # Runs on the scheduler loop.
+        if self.tracer is not None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+
+            def _done(conn_, req_id_, value):
+                now = _time.perf_counter()
+                self.tracer.span(
+                    svc_meth, t0 * 1e6, (now - t0) * 1e6, track="rpc"
+                )
+                self._reply(conn_, req_id_, value)
+        else:
+            _done = self._reply
         try:
             handler = self._handlers.get(svc_meth)
             if handler is None:
@@ -213,10 +248,10 @@ class RpcNode:
             # caller retries the same failing request forever.
             reply_fut = self.sched.spawn(_guarded(result))
             reply_fut.add_done_callback(
-                lambda f: self._reply(conn, req_id, f.value)
+                lambda f: _done(conn, req_id, f.value)
             )
         else:
-            self._reply(conn, req_id, result)
+            _done(conn, req_id, result)
 
     def _reply(self, conn: int, req_id: int, value: Any) -> None:
         try:
@@ -232,6 +267,11 @@ class RpcNode:
         self._closed = True
         self.sched.stop()
         self._tr.close()
+        if self.tracer is not None and self._trace_path:
+            try:
+                self.tracer.save(self._trace_path)
+            except Exception:
+                pass  # tracing must never fail a shutdown
 
 
 def _is_gen(obj: Any) -> bool:

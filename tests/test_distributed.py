@@ -1060,3 +1060,38 @@ def test_cli_sigterm_checkpoints_before_exit(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+@needs_native
+def test_rpc_tracing_records_spans(tmp_path, monkeypatch):
+    """MRT_TRACE_DIR: the node records a Chrome-trace span per handled
+    RPC and the engine driver's tick spans share the timeline."""
+    import json
+
+    monkeypatch.setenv("MRT_TRACE_DIR", str(tmp_path))
+    from multiraft_tpu.distributed.engine_server import serve_engine_kv
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    node = serve_engine_kv(0, G=8, seed=31)
+    try:
+        monkeypatch.delenv("MRT_TRACE_DIR")  # client node untraced
+        cli = RpcNode()
+        try:
+            from multiraft_tpu.distributed.engine_server import EngineClerk
+
+            end = cli.client_end("127.0.0.1", node.port)
+            ck = EngineClerk(cli.sched, end)
+            for i in range(3):
+                assert cli.sched.wait(
+                    cli.sched.spawn(ck.put(f"t{i}", "v")), 30.0
+                ) is not None
+        finally:
+            cli.close()
+    finally:
+        node.close()
+    traces = list(tmp_path.glob("rpc-*.json"))
+    assert traces, "no trace file saved on close"
+    events = json.loads(traces[0].read_text())["traceEvents"]
+    names = {e.get("name") for e in events}
+    assert "EngineKV.command" in names, sorted(names)[:10]
+    assert "tick" in names, "driver tick spans not on the shared timeline"
