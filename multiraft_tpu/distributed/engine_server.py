@@ -363,9 +363,15 @@ class EngineShardKVService:
         if self._dur is not None:
             skv.on_insert = self._on_insert_applied
             skv.on_delete = self._on_delete_applied
+            # The committing gid travels in the record: recovery REDOES
+            # the write into that gid's slot directly (see
+            # _redo_client_op) — re-routing by the latest config would
+            # drop a write acked at an old owner just before a config
+            # change, and a peer that never pulled pre-crash would then
+            # pull an empty slot.
             skv.on_write = lambda gid, op: self._write_seqs.__setitem__(
                 (op.client_id, op.command_id),
-                durability.log(("skv", op.op, op.key, op.value,
+                durability.log(("skv", gid, op.op, op.key, op.value,
                                 op.client_id, op.command_id)),
             )
             skv.on_ctrl = lambda op: self._admin_seqs.__setitem__(
@@ -544,17 +550,17 @@ class EngineShardKVService:
            order, with their apply-time gates making anything already
            in the checkpoint a no-op.
 
-        The fleet hooks are suspended for the duration: a mid-replay
-        remote fetch could install an EMPTY blob from a peer that
-        already GC'd the shard (its copy lives in OUR wal), and GC
-        requests are deferred until local state is fully rebuilt."""
+        Migration (pulls + GC, local AND remote) is paused for the
+        duration via ``skv.migration_paused`` — a pull completing
+        mid-replay would copy a slot before its redo records landed
+        (remote: an empty blob from a peer that already GC'd; local: a
+        same-process destination reading the pre-redo source slot).
+        Config advance keeps running so replayed inserts can reach
+        their config numbers."""
         if self._dur is None:
             return 0
         recs = list(self._dur.replay_records())
-        saved = (self.skv.remote_fetch, self.skv.remote_delete)
-        self.skv.remote_fetch = None
-        if saved[1] is not None:
-            self.skv.remote_delete = lambda *a: None  # defer, don't skip
+        self.skv.migration_paused = True
         try:
             for rec in recs:
                 if rec[0] == "admin":
@@ -570,12 +576,20 @@ class EngineShardKVService:
                             lambda: self.skv.delete_shard(gid, shard, num)
                         )
                 elif kind == "skv":
-                    _, op, key, value, cid, cmd = rec
-                    self._replay_client_op(op, key, value, cid, cmd)
+                    if len(rec) != 7:
+                        # Records from the pre-gid WAL format cannot be
+                        # routed safely — refuse loudly rather than
+                        # misparse (shifted fields) or silently drop.
+                        raise RuntimeError(
+                            "WAL 'skv' record has legacy format "
+                            f"({len(rec)} fields); cannot replay"
+                        )
+                    _, gid, op, key, value, cid, cmd = rec
+                    self._redo_client_op(gid, op, key, value, cid, cmd)
             # Drain: let every replayed proposal commit before serving.
             self._pump_until(lambda: False, max_rounds=50)
         finally:
-            self.skv.remote_fetch, self.skv.remote_delete = saved
+            self.skv.migration_paused = False
         return len(recs)
 
     def _pump_until(self, cond, max_rounds: int = 4000) -> bool:
@@ -635,33 +649,27 @@ class EngineShardKVService:
 
         self._retry_until_ok(propose)
 
-    def _replay_client_op(self, op, key, value, cid, cmd) -> None:
-        from ..engine.shardkv import ERR_WRONG_GROUP
+    def _redo_client_op(self, gid, op, key, value, cid, cmd) -> None:
+        """REDO one acknowledged write into the slot of the gid that
+        committed it, directly on the host state — the standard
+        redo-log discipline.  Routing/ownership gates don't apply to
+        redo: the op already linearized pre-crash; in particular a
+        write acked just before its shard went BEPULLING must land in
+        that (now non-serving) slot so a peer's later pull sees it, and
+        a subsequent WAL delete record clears it in order."""
         from ..services.shardkv import key2shard
 
-        for _ in range(2000):
-            cfg = self.skv.query_latest()
-            gid = cfg.shards[key2shard(key)]
-            if gid not in self.skv.reps:
-                if not self._fleet:
-                    # Config history is fully replayed (pass 1), so an
-                    # unassigned shard here means a leave orphaned it —
-                    # the data is unreachable by config, nothing to do.
-                    if gid == 0:
-                        return
-                    raise RuntimeError(
-                        f"replay: shard owner {gid} unknown off-fleet"
-                    )
-                # Fleet: the current owner is a peer — the op's effects
-                # reached it inside a migrated blob (our GC gate ensures
-                # the blob was durable there before our copy could go).
-                return
-            t = self.skv.submit(gid, op, key, value,
-                                client_id=cid, command_id=cmd)
-            self._pump_until(lambda: t.done, max_rounds=400)
-            if t.done and not t.failed and t.err != ERR_WRONG_GROUP:
-                return
-        raise RuntimeError(f"WAL replay of {op}({key!r}) did not converge")
+        rep = self.skv.reps.get(gid)
+        if rep is None:
+            return  # record from a gid this process no longer hosts
+        sh = rep.shards[key2shard(key)]
+        if sh.latest.get(cid, -1) >= cmd:
+            return  # already in the checkpoint / an earlier redo
+        if op == "Put":
+            sh.data[key] = value
+        elif op == "Append":
+            sh.data[key] = sh.data.get(key, "") + value
+        sh.latest[cid] = cmd
 
     def command(self, args: EngineCmdArgs):
         from ..engine.shardkv import ERR_WRONG_GROUP

@@ -241,6 +241,12 @@ class BatchedShardKV(FrontierService):
         self._route = jnp.zeros((NSHARDS,), jnp.int32)
         self._ctrl_cmd = 0
         self._orchestrate_enabled = True
+        # Recovery gate (durable server replay): config advance keeps
+        # running, but pulls and GC must not — a pull completing
+        # mid-replay would copy a slot BEFORE its redo records landed,
+        # losing acked writes (both the local direct-read path and the
+        # remote hook path).
+        self.migration_paused = False
         # Fleet-mode hooks (see class docstring); None = single-instance.
         self.remote_fetch = None
         self.remote_delete = None
@@ -579,6 +585,8 @@ class BatchedShardKV(FrontierService):
                 t = ShardTicket(group=gid)
                 rep.pending_config = t
                 self.driver.start(self._g2l[gid], _ConfigOp(config=nxt, ticket=t))
+            if self.migration_paused:
+                continue  # recovery: no pulls/GC until redo completes
             # (b) shard pull: read the source group's applied state once
             # it has applied the same config (the ErrNotReady gate).  A
             # source gid hosted by another fleet process goes through

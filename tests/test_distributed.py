@@ -1095,3 +1095,117 @@ def test_rpc_tracing_records_spans(tmp_path, monkeypatch):
     names = {e.get("name") for e in events}
     assert "EngineKV.command" in names, sorted(names)[:10]
     assert "tick" in names, "driver tick spans not on the shared timeline"
+
+
+@needs_native
+def test_engine_fleet_durable_crash_mid_migration(tmp_path):
+    """Kill the PULLING process right after the join commits — pulls
+    are in flight, GC may be mid-handshake.  Restart must converge with
+    every acknowledged key intact (replay rebuilds config history, the
+    suspended-hook window prevents empty-blob installs, and deferred GC
+    completes after recovery)."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+
+    fleet = EngineFleetCluster(
+        [[1], [2]], seed=37,
+        data_dir=str(tmp_path / "midmig"), checkpoint_every_s=3600.0,
+    )
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        ck = fleet.clerk()
+        try:
+            kv = {chr(97 + i): f"v{i}" for i in range(10)}
+            for k, v in kv.items():
+                ck.put(k, v)
+            # Join gid 2 and kill its process immediately: migration is
+            # mid-flight (the admin is committed on both config RSMs,
+            # but shard pulls/GC race the SIGKILL).
+            fleet.admin("join", [2])
+            fleet.kill(1)
+            fleet.start(1)  # recover from checkpoint-less WAL replay
+            for k, v in kv.items():
+                assert ck.get(k) == v, f"{k} lost in mid-migration crash"
+            for k in list(kv)[:4]:
+                ck.append(k, "+post")
+                assert ck.get(k) == kv[k] + "+post"
+        finally:
+            ck.close()
+    finally:
+        fleet.shutdown()
+
+
+@needs_native
+def test_fleet_redo_preserves_write_acked_before_migration(tmp_path):
+    """The redo-log regression: a write acked at the OLD owner right
+    before a config change, with the process crashing BEFORE the new
+    owner ever pulled.  The restarted old owner must reproduce the
+    write in its (non-serving) BEPULLING slot so the pull delivers it —
+    re-routing the replay by the latest config would drop it."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.services.shardkv import key2shard
+
+    fleet = EngineFleetCluster(
+        [[1], [2]], seed=53,
+        data_dir=str(tmp_path / "redo"), checkpoint_every_s=3600.0,
+    )
+    # Start ONLY process 0 (gid 1): process 1 stays down, so no pull
+    # can possibly happen before the crash.
+    fleet.start(0)
+    probe = RpcNode()
+    try:
+        a = probe.client_end(fleet.host, fleet.ports[0])
+
+        def call(svc_meth, args, timeout=30.0):
+            r = probe.sched.wait(a.call(svc_meth, args), timeout)
+            assert r is not None and r is not TIMEOUT, f"{svc_meth} failed"
+            return r
+
+        assert call("EngineShardKV.admin", ("join", [1], 1)).err == "OK"
+        # Find a key whose shard gid 2 will own after the second join.
+        from multiraft_tpu.services.shardctrler import rebalance
+        cfg1_shards = [1] * 10
+        cfg2 = rebalance(list(cfg1_shards), {1: ["a"], 2: ["b"]})
+        shard2 = next(s for s in range(10) if cfg2[s] == 2)
+        key = next(chr(c) for c in range(97, 123)
+                   if key2shard(chr(c)) == shard2)
+
+        from multiraft_tpu.distributed.engine_server import EngineCmdArgs
+        rep = call("EngineShardKV.command", EngineCmdArgs(
+            op="Put", key=key, value="acked-pre-migration",
+            client_id=777, command_id=1))
+        assert rep.err == "OK"
+        # Config moves the shard to (down) gid 2; A's slot -> BEPULLING.
+        assert call("EngineShardKV.admin", ("join", [2], 2)).err == "OK"
+        time.sleep(0.3)
+
+        # CRASH before any pull existed anywhere.
+        fleet.kill(0)
+        fleet.start(0)
+
+        # The restarted old owner must serve the write to a puller.
+        blob = call("EngineShardKV.pull_shard", (1, shard2, 2), 60.0)
+        assert blob[0] == "OK", blob
+        assert blob[1].get(key) == "acked-pre-migration", (
+            f"acked write lost from the BEPULLING slot: {blob[1]}"
+        )
+
+        # And the full fleet converges end-to-end once B comes up.
+        fleet.start(1)
+        assert call("EngineShardKV.admin", ("join", [1], 1)).err == "OK"
+        b = probe.client_end(fleet.host, fleet.ports[1])
+        rb = probe.sched.wait(
+            b.call("EngineShardKV.admin", ("join", [1], 1)), 30.0)
+        assert rb is not None and rb.err == "OK"
+        rb = probe.sched.wait(
+            b.call("EngineShardKV.admin", ("join", [2], 2)), 30.0)
+        assert rb is not None and rb.err == "OK"
+        ck = fleet.clerk()
+        try:
+            assert ck.get(key) == "acked-pre-migration"
+        finally:
+            ck.close()
+    finally:
+        probe.close()
+        fleet.shutdown()
