@@ -572,6 +572,17 @@ class EngineShardKVService:
                 elif kind == "delete":
                     _, gid, shard, num = rec
                     if gid in self.skv.reps:
+                        # The apply gate answers ErrNotReady while the
+                        # source rep is behind `num` — wait like the
+                        # insert replay does, or the record would
+                        # "succeed" as a no-op and the stale BEPULLING
+                        # slot would wedge config advance forever.
+                        rep = self.skv.reps[gid]
+                        if not self._pump_until(lambda: rep.cur.num >= num):
+                            raise RuntimeError(
+                                f"replay: rep {gid} never reached config "
+                                f"{num} for a delete record"
+                            )
                         self._retry_until_ok(
                             lambda: self.skv.delete_shard(gid, shard, num)
                         )
@@ -601,11 +612,15 @@ class EngineShardKVService:
 
     def _retry_until_ok(self, propose, attempts: int = 50):
         """Propose-and-wait with eviction retry (leader churn during
-        recovery must not drop a record)."""
+        recovery must not drop a record).  A resolved-but-not-OK ticket
+        (e.g. ErrNotReady) retries too — callers gate config catch-up
+        beforehand, so non-OK can only be transient."""
+        from ..engine.shardkv import OK as SK_OK
+
         for _ in range(attempts):
             t = propose()
             self._pump_until(lambda: t.done)
-            if t.done and not t.failed:
+            if t.done and not t.failed and t.err == SK_OK:
                 return t
         raise RuntimeError("WAL replay proposal did not commit")
 

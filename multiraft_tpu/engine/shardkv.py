@@ -241,11 +241,14 @@ class BatchedShardKV(FrontierService):
         self._route = jnp.zeros((NSHARDS,), jnp.int32)
         self._ctrl_cmd = 0
         self._orchestrate_enabled = True
-        # Recovery gate (durable server replay): config advance keeps
-        # running, but pulls and GC must not — a pull completing
+        # Recovery gate (durable server replay): config advance, GC and
+        # confirm keep running, but PULLS must not — a pull completing
         # mid-replay would copy a slot BEFORE its redo records landed,
         # losing acked writes (both the local direct-read path and the
-        # remote hook path).
+        # remote hook path).  GC/confirm are safe mid-replay: WAL order
+        # puts a source's redo records before the insert that makes its
+        # deletion possible, and freezing confirm would pin a replayed
+        # GCING slot forever (config advance needs all-SERVING).
         self.migration_paused = False
         # Fleet-mode hooks (see class docstring); None = single-instance.
         self.remote_fetch = None
@@ -585,8 +588,6 @@ class BatchedShardKV(FrontierService):
                 t = ShardTicket(group=gid)
                 rep.pending_config = t
                 self.driver.start(self._g2l[gid], _ConfigOp(config=nxt, ticket=t))
-            if self.migration_paused:
-                continue  # recovery: no pulls/GC until redo completes
             # (b) shard pull: read the source group's applied state once
             # it has applied the same config (the ErrNotReady gate).  A
             # source gid hosted by another fleet process goes through
@@ -596,6 +597,8 @@ class BatchedShardKV(FrontierService):
                 if sh.state == PULLING and not self._live(
                     rep.pending_insert.get(s)
                 ):
+                    if self.migration_paused:
+                        continue  # recovery: no pulls until redo completes
                     src_gid = rep.prev.shards[s]
                     src = self.reps.get(src_gid)
                     if src is not None:

@@ -289,3 +289,38 @@ def test_fleet_move_shard_between_instances():
     assert clerk.get(kmap[src_shard]) == "moved-data"
     assert b.reps[2].shards[src_shard].data, "moved shard empty at B"
     assert a.reps[1].shards[src_shard].data == {}, "source not GC'd"
+
+
+def test_migration_paused_blocks_pulls_until_released():
+    """The recovery gate: while ``migration_paused`` is set, config
+    advance continues but no pull or GC runs — slots stay PULLING /
+    BEPULLING with their data untouched; releasing the flag lets the
+    migration complete normally."""
+    from multiraft_tpu.services.shardkv import BEPULLING, PULLING
+
+    a, b = make_fleet(seed=7)
+    fleet_admin([a, b], "join", [1])
+    clerk = FleetClerk([a, b])
+    kmap = keys_for_all_shards()
+    for shard, k in sorted(kmap.items())[:4]:
+        clerk.put(k, f"p{shard}")
+    a.migration_paused = True
+    b.migration_paused = True
+    fleet_admin([a, b], "join", [2])
+    pump_all([a, b], 60)  # plenty of rounds for a pull to fire if unpaused
+    cfg = a.query_latest()
+    moved = [s for s in range(NSHARDS) if cfg.shards[s] == 2]
+    assert moved
+    # Configs advanced (reps entered the migration states)…
+    assert b.reps[2].cur.num == cfg.num
+    # …but no pull happened: destination still PULLING and empty,
+    # source still BEPULLING with its data.
+    for s in moved:
+        assert b.reps[2].shards[s].state == PULLING
+        assert b.reps[2].shards[s].data == {}
+        assert a.reps[1].shards[s].state == BEPULLING
+    a.migration_paused = False
+    b.migration_paused = False
+    settle_fleet([a, b])
+    for shard, k in sorted(kmap.items())[:4]:
+        assert clerk.get(k) == f"p{shard}"
