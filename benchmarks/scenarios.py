@@ -32,10 +32,11 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _cfg(G=None, P=None, L=80, E=20, ingest=20):
-    """Defaults match bench.py's measured sweet spot (E=INGEST=20,
-    L=80 — see the operating-point note there).  P comes from
-    MULTIRAFT_BENCH_P so every scenario is peer-count-generic."""
+def _cfg(G=None, P=None, L=112, E=28, ingest=28):
+    """Defaults match bench.py's measured sweet spot (E=INGEST=28,
+    L=112, re-tuned round 2 — see the operating-point note there).  P
+    comes from MULTIRAFT_BENCH_P so every scenario is
+    peer-count-generic."""
     from multiraft_tpu.engine.core import EngineConfig
 
     G = G or int(os.environ.get("MULTIRAFT_BENCH_G", "10000"))
@@ -285,10 +286,11 @@ def bench_sweep() -> Dict:
     points = {}
     for P in peer_counts:
         for G in [g for g in (1000, 10000, 100000) if g <= gmax]:
-            # Per-scale operating point: at 100k groups the working set
-            # is HBM-bandwidth-bound and the leaner 16/64 ring wins
-            # (174M vs 146M measured); at <=10k the 20/80 point wins
-            # (~15%).
+            # Per-scale operating point: at 100k groups the working
+            # set is HBM-bandwidth-bound and the leaner 16/64 ring
+            # wins; at <=10k the round-2 retune (28/112, _cfg's
+            # default) wins ~35% over the old 20/80 — see bench.py's
+            # operating-point note.
             cfg = (
                 _cfg(G=G, P=P, L=64, E=16, ingest=16)
                 if G >= 100000

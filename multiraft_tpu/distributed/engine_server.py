@@ -550,13 +550,15 @@ class EngineShardKVService:
            order, with their apply-time gates making anything already
            in the checkpoint a no-op.
 
-        Migration (pulls + GC, local AND remote) is paused for the
-        duration via ``skv.migration_paused`` — a pull completing
-        mid-replay would copy a slot before its redo records landed
-        (remote: an empty blob from a peer that already GC'd; local: a
-        same-process destination reading the pre-redo source slot).
-        Config advance keeps running so replayed inserts can reach
-        their config numbers."""
+        PULLS are paused for the duration via ``skv.migration_paused``
+        — a pull completing mid-replay would copy a slot before its
+        redo records landed (remote: an empty blob from a peer that
+        already GC'd; local: a same-process destination reading the
+        pre-redo source slot).  Config advance AND the GC/confirm
+        handshake keep running: WAL order puts a source's redo records
+        before the insert that makes its deletion possible, and
+        freezing confirm would pin a replayed GCING slot forever
+        (config advance needs all-SERVING)."""
         if self._dur is None:
             return 0
         recs = list(self._dur.replay_records())
@@ -577,12 +579,7 @@ class EngineShardKVService:
                         # insert replay does, or the record would
                         # "succeed" as a no-op and the stale BEPULLING
                         # slot would wedge config advance forever.
-                        rep = self.skv.reps[gid]
-                        if not self._pump_until(lambda: rep.cur.num >= num):
-                            raise RuntimeError(
-                                f"replay: rep {gid} never reached config "
-                                f"{num} for a delete record"
-                            )
+                        self._await_config(gid, num, "a delete record")
                         self._retry_until_ok(
                             lambda: self.skv.delete_shard(gid, shard, num)
                         )
@@ -609,6 +606,17 @@ class EngineShardKVService:
                 return True
             self.skv.pump(2)
         return cond()
+
+    def _await_config(self, gid: int, num: int, what: str) -> None:
+        """Pump until rep ``gid`` has applied config ``num`` (replay
+        gate shared by insert and delete records); a timeout is a real
+        recovery failure, raised loudly."""
+        rep = self.skv.reps[gid]
+        if not self._pump_until(lambda: rep.cur.num >= num):
+            raise RuntimeError(
+                f"replay: rep {gid} never reached config {num} for "
+                f"{what} (stuck at {rep.cur.num})"
+            )
 
     def _retry_until_ok(self, propose, attempts: int = 50):
         """Propose-and-wait with eviction retry (leader churn during
@@ -643,13 +651,8 @@ class EngineShardKVService:
         # wait for orchestration to advance it there (earlier inserts/
         # configs already replayed), else the insert would silently
         # no-op and a later remote re-fetch could find the peer's copy
-        # already GC'd.  A timeout here is a REAL failure (loud), not
-        # the already-in-checkpoint case (rep past num / not PULLING).
-        if not self._pump_until(lambda: rep.cur.num >= num):
-            raise RuntimeError(
-                f"replay: rep {gid} never reached config {num} "
-                f"(stuck at {rep.cur.num})"
-            )
+        # already GC'd.
+        self._await_config(gid, num, "an insert record")
         if rep.cur.num != num or rep.shards[shard].state != PULLING:
             return  # checkpoint already contains this insert's effects
 
