@@ -239,33 +239,65 @@ class EngineKVService:
         runs to completion before the server starts answering).  Dedup
         tables make records already in the checkpoint no-ops.
 
-        STRICTLY one record at a time: the WAL is commit-ordered, and
-        replaying a client's cmd N and cmd N+1 concurrently lets an
-        eviction commit N+1 first — the session table then treats the
-        resubmitted N as a duplicate and its acked mutation is lost."""
+        STRICTLY one record at a time PER GROUP: the WAL is
+        commit-ordered, and both order guarantees that replay must
+        reproduce are group-local — a client's cmd N vs N+1 (an
+        eviction committing N+1 first would dedup-swallow the
+        resubmitted N) and cross-client order on a shared key (an
+        acked A-then-B pair replayed B-then-A would recover the wrong
+        value).  A key routes to exactly one group, so serial-per-group
+        preserves both while groups pipeline through each pump wave:
+        recovery wall-clock scales with the deepest single-group
+        backlog, not the WAL length.  With the default 30 s checkpoint
+        interval the WAL bounds to ~30 s of acked writes, so expected
+        RTO ≈ that backlog's longest per-group chain at one commit per
+        ~2 pump rounds."""
         if self._dur is None:
             return 0
         recs = [rec for rec in self._dur.replay_records() if rec[0] == "kv"]
+        queues: dict = {}
         for rec in recs:
+            queues.setdefault(route_group(rec[2], self.G), []).append(rec)
+
+        def submit(rec):
             _, op, key, value, cid, cmd = rec
-            done = False
-            for _ in range(50):  # eviction retries
-                t = self.kv.submit(
-                    route_group(key, self.G),
-                    KVOp(op=_OPCODE[op], key=key, value=value,
-                         client_id=cid, command_id=cmd),
-                )
-                for _ in range(2000):
-                    if t.done:
-                        break
-                    self.kv.pump(2)
+            return self.kv.submit(
+                route_group(key, self.G),
+                KVOp(op=_OPCODE[op], key=key, value=value,
+                     client_id=cid, command_id=cmd),
+            )
+
+        depth = max((len(q) for q in queues.values()), default=0)
+        max_rounds = 4000 + 200 * depth
+        pending: dict = {}  # group -> [ticket, attempts_left, submit_round]
+        rounds = 0
+        while queues:
+            for g in queues:
+                if g not in pending:
+                    pending[g] = [submit(queues[g][0]), 50, rounds]
+            self.kv.pump(2)
+            rounds += 1
+            for g, (t, left, since) in list(pending.items()):
+                resubmit = False
                 if t.done and not t.failed:
-                    done = True
-                    break
-            if not done:
-                raise RuntimeError(
-                    f"WAL replay of {op}({key!r}) did not converge"
-                )
+                    queues[g].pop(0)
+                    del pending[g]
+                    if not queues[g]:
+                        del queues[g]
+                elif t.done and t.failed:
+                    resubmit = True  # evicted: same ids, dedup-safe
+                elif rounds - since >= 600:
+                    resubmit = True  # wedged ticket (binding lost)
+                if resubmit:
+                    if left <= 1:
+                        rec = queues[g][0]
+                        raise RuntimeError(
+                            f"WAL replay of {rec[1]}({rec[2]!r}) did not "
+                            "converge"
+                        )
+                    pending[g] = [submit(queues[g][0]), left - 1, rounds]
+            if rounds > max_rounds:
+                raise RuntimeError("WAL replay did not converge")
         return len(recs)
 
     def command(self, args: EngineCmdArgs):
@@ -360,9 +392,16 @@ class EngineShardKVService:
         # — see EngineKVService; pruned once synced.
         self._write_seqs: dict = {}
         self._admin_seqs: dict = {}  # command_id -> WAL seq
+        # seq of the WAL record covering each applied delete — the
+        # delete_shard RPC reply gates on it being fsynced: the puller
+        # confirms (and never re-asks) the moment we answer OK, so an
+        # OK that could be lost to a crash would leave a BEPULLING slot
+        # here that nothing ever clears, wedging config advance.
+        self._delete_seqs: dict = {}
         if self._dur is not None:
             skv.on_insert = self._on_insert_applied
             skv.on_delete = self._on_delete_applied
+            skv.on_confirm = self._on_confirm_applied
             # The committing gid travels in the record: recovery REDOES
             # the write into that gid's slot directly (see
             # _redo_client_op) — re-routing by the latest config would
@@ -395,7 +434,18 @@ class EngineShardKVService:
     def _on_delete_applied(self, gid, shard, num):
         # Replayed on restore so a stale BEPULLING slot can't survive an
         # older checkpoint and wedge config advance.
-        self._dur.log(("delete", gid, shard, num))
+        self._delete_seqs[(gid, shard, num)] = self._dur.log(
+            ("delete", gid, shard, num)
+        )
+
+    def _on_confirm_applied(self, gid, shard, num):
+        # Replayed on restore so recovery re-applies GCING→SERVING
+        # locally instead of re-running the GC handshake — during
+        # replay the loop thread is busy replaying, so an RPC to a
+        # remote old owner could never resolve and recovery would
+        # wedge (the confirm only ever committed because the delete
+        # leg already succeeded pre-crash).
+        self._dur.log(("confirm", gid, shard, num))
 
     # -- fleet migration hooks (run on the loop thread, inside pump) ------
 
@@ -498,7 +548,25 @@ class EngineShardKVService:
                 if t.done:
                     if t.failed:
                         return (ERR_TIMEOUT,)
-                    return (SK_OK,) if t.err == SK_OK else (t.err,)
+                    if t.err != SK_OK:
+                        return (t.err,)
+                    # Gate the OK on the delete's WAL record being
+                    # fsynced: the puller confirms on our OK and never
+                    # re-asks, so losing the record to a crash would
+                    # strand a BEPULLING slot here forever.  (Absent =
+                    # pruned = already durable, or the slot was already
+                    # clear and no record was written — also durable.)
+                    # Deadline-bounded: a stalled fsync must surface as
+                    # a timeout the puller retries, not a pinned
+                    # generator.
+                    while self._dur is not None:
+                        seq = self._delete_seqs.get((src_gid, shard, num))
+                        if seq is None or self._dur.synced(seq):
+                            break
+                        if self.sched.now >= deadline:
+                            return (ERR_TIMEOUT,)
+                        yield 0.002
+                    return (SK_OK,)
                 yield 0.005
             return (ERR_TIMEOUT,)
 
@@ -530,7 +598,8 @@ class EngineShardKVService:
         self.skv.pump(self._ticks)
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
-            for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs"):
+            for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs",
+                         "_delete_seqs"):
                 seqs = getattr(self, attr)
                 if seqs:
                     setattr(self, attr, {
@@ -546,19 +615,23 @@ class EngineShardKVService:
            retried until it actually commits (an eviction during
            recovery must not silently skip a config — the fleet's
            histories would diverge);
-        2. insert/delete/client records re-ride the local logs in WAL
-           order, with their apply-time gates making anything already
-           in the checkpoint a no-op.
+        2. insert/delete/confirm/client records re-ride the local logs
+           in WAL order, with their apply-time gates making anything
+           already in the checkpoint a no-op.
 
-        PULLS are paused for the duration via ``skv.migration_paused``
-        — a pull completing mid-replay would copy a slot before its
-        redo records landed (remote: an empty blob from a peer that
-        already GC'd; local: a same-process destination reading the
-        pre-redo source slot).  Config advance AND the GC/confirm
-        handshake keep running: WAL order puts a source's redo records
-        before the insert that makes its deletion possible, and
-        freezing confirm would pin a replayed GCING slot forever
-        (config advance needs all-SERVING)."""
+        PULLS and the live GC/confirm handshake are paused for the
+        duration via ``skv.migration_paused`` — a pull completing
+        mid-replay would copy a slot before its redo records landed,
+        and a GC handshake whose old owner is a REMOTE peer can never
+        resolve here (this method runs synchronously on the scheduler
+        loop, so peer RPC replies are not serviced until it returns).
+        Committed GCING→SERVING transitions are instead re-applied from
+        the WAL's "confirm" records — the pre-crash handshake already
+        ran its delete leg, so replaying the confirm alone is sound —
+        which keeps config advance (needs all-SERVING) purely local.
+        A slot whose confirm had not committed pre-crash stays GCING
+        through replay; the post-replay pump loop re-runs its handshake
+        live (idempotent at the peer)."""
         if self._dur is None:
             return 0
         recs = list(self._dur.replay_records())
@@ -582,6 +655,17 @@ class EngineShardKVService:
                         self._await_config(gid, num, "a delete record")
                         self._retry_until_ok(
                             lambda: self.skv.delete_shard(gid, shard, num)
+                        )
+                elif kind == "confirm":
+                    _, gid, shard, num = rec
+                    if gid in self.skv.reps:
+                        # Re-apply the committed GCING→SERVING flip
+                        # locally (never the cross-process handshake —
+                        # see the docstring).  Gated on the rep having
+                        # reached config `num` like insert/delete.
+                        self._await_config(gid, num, "a confirm record")
+                        self._retry_until_ok(
+                            lambda: self.skv.confirm_shard(gid, shard, num)
                         )
                 elif kind == "skv":
                     if len(rec) != 7:

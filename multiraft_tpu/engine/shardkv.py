@@ -241,14 +241,18 @@ class BatchedShardKV(FrontierService):
         self._route = jnp.zeros((NSHARDS,), jnp.int32)
         self._ctrl_cmd = 0
         self._orchestrate_enabled = True
-        # Recovery gate (durable server replay): config advance, GC and
-        # confirm keep running, but PULLS must not — a pull completing
-        # mid-replay would copy a slot BEFORE its redo records landed,
-        # losing acked writes (both the local direct-read path and the
-        # remote hook path).  GC/confirm are safe mid-replay: WAL order
-        # puts a source's redo records before the insert that makes its
-        # deletion possible, and freezing confirm would pin a replayed
-        # GCING slot forever (config advance needs all-SERVING).
+        # Recovery gate (durable server replay): config advance keeps
+        # running, but PULLS and the GC/confirm handshake must not.
+        # A pull completing mid-replay would copy a slot BEFORE its
+        # redo records landed, losing acked writes; the GC handshake
+        # mid-replay can involve a REMOTE old owner, and during replay
+        # the server's scheduler loop is blocked — the RPC could never
+        # resolve, wedging recovery forever.  Replay instead re-applies
+        # committed GCING→SERVING transitions from WAL "confirm"
+        # records (see on_confirm / EngineShardKVService.replay_wal),
+        # so config advance never needs a live handshake; a slot whose
+        # confirm had NOT committed pre-crash simply stays GCING until
+        # the post-replay pump loop re-runs the handshake live.
         self.migration_paused = False
         # Fleet-mode hooks (see class docstring); None = single-instance.
         self.remote_fetch = None
@@ -262,6 +266,12 @@ class BatchedShardKV(FrontierService):
         # restore from an older checkpoint.
         self.on_insert = None  # (gid, shard, config_num, data, latest)
         self.on_delete = None  # (gid, shard, config_num)
+        # Fired when a committed confirm actually flips GCING→SERVING.
+        # The WAL record lets recovery re-apply the transition locally
+        # instead of re-running the (possibly cross-process) GC
+        # handshake — the handshake's peer may be unreachable while the
+        # restarting server's loop is blocked in replay.
+        self.on_confirm = None  # (gid, shard, config_num)
         # Fired in apply (= commit) order — the durable WAL must be a
         # commit-ordered redo log, not submit-ordered (evict-and-
         # resubmit can commit in a different order than submission).
@@ -309,10 +319,20 @@ class BatchedShardKV(FrontierService):
         self._ctrl_cmd = blob["ctrl_cmd"]
         self._orchestrate_enabled = blob["orchestrate"]
         # gid → engine-group mapping travels with the checkpoint (older
-        # blobs predate fleet mode: identity mapping).
-        self.gids = list(blob.get("gids", self.gids))
-        self._g2l = {gid: i + 1 for i, gid in enumerate(self.gids)}
-        self._l2g = {i + 1: gid for i, gid in enumerate(self.gids)}
+        # blobs predate fleet mode: identity mapping).  A checkpoint
+        # whose gid set diverges from the constructor's is refused loudly
+        # — silently adopting it would keep serving the old assignment
+        # while peers/routing were built from the new spec (same
+        # loud-beats-lucky stance as EngineDriver.restore's mesh check).
+        saved_gids = blob.get("gids")
+        if saved_gids is not None and list(saved_gids) != self.gids:
+            raise ValueError(
+                f"checkpoint hosts gids {list(saved_gids)} but this "
+                f"instance was built for gids {self.gids}; restart with "
+                "the checkpoint's gid set (or a fresh data dir)"
+            )
+        # (After the guard, saved_gids can only equal self.gids — the
+        # constructor's gid→engine-group mapping stands.)
 
     # -- client/admin surface ---------------------------------------------
 
@@ -336,6 +356,21 @@ class BatchedShardKV(FrontierService):
         self.driver.start(
             self._g2l[src_gid],
             _DeleteOp(config_num=config_num, shard=shard, ticket=t),
+        )
+        return t
+
+    def confirm_shard(self, gid: int, shard: int,
+                      config_num: int) -> ShardTicket:
+        """Propose a GC confirm (GCING→SERVING) directly in ``gid``'s
+        log — the recovery path's re-application of a confirm the WAL
+        proves already committed pre-crash (the delete leg of the
+        handshake already ran then; re-running it against a possibly
+        unreachable peer would wedge replay).  Idempotent: a no-op when
+        the slot is past GCING or the config has moved on."""
+        t = ShardTicket(group=gid)
+        self.driver.start(
+            self._g2l[gid],
+            _ConfirmOp(config_num=config_num, shard=shard, ticket=t),
         )
         return t
 
@@ -538,6 +573,8 @@ class BatchedShardKV(FrontierService):
             sh = rep.shards[op.shard]
             if op.config_num == rep.cur.num and sh.state == GCING:
                 sh.state = SERVING
+                if self.on_confirm is not None:
+                    self.on_confirm(rep.gid, op.shard, op.config_num)
             rep.pending_confirm.pop(op.shard, None)
             self._resolve(op, now)
 
@@ -630,6 +667,8 @@ class BatchedShardKV(FrontierService):
                 # deleted through the remote_delete hook — Challenge 1
                 # crosses process boundaries too.
                 elif sh.state == GCING:
+                    if self.migration_paused:
+                        continue  # recovery: WAL confirm records stand in
                     dt = rep.pending_delete.get(s)
                     if dt is None or (dt.done and (dt.failed or dt.err != OK)):
                         src_gid = rep.prev.shards[s]

@@ -57,18 +57,31 @@ class _DurableRig:
         )
         self.kv, self.dur = kv, dur
         # Replay: re-submit every record through consensus (the
-        # service's recovery loop, inlined).
-        slots = [rec for rec in dur.replay_records()]
-        tickets = [self._submit(r) for r in slots]
-        for _ in range(4000):
-            if all(t.done and not t.failed for t in tickets):
-                break
+        # service's recovery loop, inlined) — STRICTLY one record at a
+        # time PER GROUP, the discipline EngineKVService.replay_wal
+        # depends on: both same-client cmd ordering (eviction + dedup)
+        # and cross-client same-key ordering are group-local, since a
+        # key routes to exactly one group.
+        queues = {}
+        for rec in dur.replay_records():
+            queues.setdefault(route_group(rec[2], 8), []).append(rec)
+        pending = {}
+        rounds = 0
+        while queues:
+            for cid in queues:
+                if cid not in pending:
+                    pending[cid] = self._submit(queues[cid][0])
             kv.pump(2)
-            tickets = [
-                t if not (t.done and t.failed) else self._submit(slots[i])
-                for i, t in enumerate(tickets)
-            ]
-        assert all(t.done and not t.failed for t in tickets), "replay stuck"
+            rounds += 1
+            assert rounds < 8000, "replay stuck"
+            for cid, t in list(pending.items()):
+                if not t.done:
+                    continue
+                del pending[cid]
+                if not t.failed:  # failed = evicted: resubmit next wave
+                    queues[cid].pop(0)
+                    if not queues[cid]:
+                        del queues[cid]
 
     def _submit(self, rec):
         _, _opname, key, value, cid, cmd = rec
@@ -145,11 +158,183 @@ def test_durable_crash_rebuild_fuzz(tmp_path):
             )
 
 
+def test_fleet_replay_with_unreachable_remote_old_owner(tmp_path):
+    """Regression (advisor r2, high): a durable fleet process whose WAL
+    crosses a config where the GC old owner was a REMOTE peer must
+    restart even when that peer is unreachable during replay — which it
+    effectively always is, since replay runs synchronously on the
+    scheduler loop and peer RPC replies cannot be serviced until it
+    returns.  Pre-fix, replay relied on the live GC handshake for
+    GCING→SERVING, so a later record needing config advance past the
+    migration (_await_config) exhausted its pump budget and raised —
+    the process could never restart from its own data_dir.  Post-fix,
+    committed confirms re-apply from WAL "confirm" records, keeping
+    replay purely local."""
+    import time
+
+    from multiraft_tpu.distributed.engine_server import (
+        EngineDurability,
+        EngineShardKVService,
+    )
+    from multiraft_tpu.distributed.realtime import RealtimeScheduler
+    from multiraft_tpu.engine.shardkv import OK as SK_OK
+    from multiraft_tpu.engine.shardkv import BatchedShardKV
+    from multiraft_tpu.services.shardctrler import rebalance
+    from multiraft_tpu.services.shardkv import SERVING, key2shard
+
+    data = str(tmp_path / "fleetwedge")
+
+    # Peer process B hosts gid 1 (bare instance, no durability — we
+    # only crash/restart A).  All access to B happens on A's loop
+    # thread via run_call, so the in-process hooks below are race-free.
+    b = BatchedShardKV(
+        EngineDriver(EngineConfig(G=2, P=3, L=64, E=8, INGEST=8), seed=21),
+        gids=[1],
+    )
+    assert b.driver.run_until_quiet_leaders(1500)
+
+    def build(peer_alive: bool):
+        sched = RealtimeScheduler()
+
+        def make():
+            ckpt = os.path.join(data, "engine.ckpt")
+            if os.path.exists(ckpt):
+                driver = EngineDriver.restore(ckpt)
+                skv = BatchedShardKV(driver, gids=[2])
+                blob = driver.restored_extra.get("service")
+                if blob:
+                    skv.load_state_dict(blob)
+            else:
+                driver = EngineDriver(
+                    EngineConfig(G=2, P=3, L=64, E=8, INGEST=8), seed=22
+                )
+                assert driver.run_until_quiet_leaders(1500)
+                skv = BatchedShardKV(driver, gids=[2])
+            dur = EngineDurability(data, driver, skv,
+                                   checkpoint_every_s=0.0, fsync=False)
+            svc = EngineShardKVService(sched, skv, durability=dur)
+            # Fleet hooks: live in-process pre-crash; DEAD post-restart
+            # (an unreachable peer — also exactly what a blocked replay
+            # loop observes: RPCs that never resolve).
+            if peer_alive:
+                pending = {}
+
+                def remote_fetch(src_gid, shard, num):
+                    rep = b.reps.get(src_gid)
+                    if rep is None or rep.cur.num < num:
+                        return None
+                    sh = rep.shards[shard]
+                    return dict(sh.data), dict(sh.latest)
+
+                def remote_delete(src_gid, shard, num):
+                    key = (src_gid, shard, num)
+                    t = pending.get(key)
+                    if t is None:
+                        pending[key] = b.delete_shard(src_gid, shard, num)
+                        return None
+                    b.pump(2)
+                    if not t.done:
+                        return None
+                    del pending[key]
+                    return (not t.failed) and t.err == SK_OK
+            else:
+                def remote_fetch(src_gid, shard, num):
+                    return None
+
+                def remote_delete(src_gid, shard, num):
+                    return None
+
+            skv.remote_fetch = remote_fetch
+            skv.remote_delete = remote_delete
+            svc.replay_wal()
+            return svc
+
+        return sched, sched.run_call(make, timeout=600.0)
+
+    def settle_a(sched, svc, max_rounds=3000):
+        def check():
+            b.pump(5)  # keep the peer advancing too (loop thread)
+            cfg = svc.skv.query_latest()
+            rep = svc.skv.reps[2]
+            return rep.cur.num == cfg.num and all(
+                sh.state == SERVING for sh in rep.shards.values()
+            )
+
+        for _ in range(max_rounds):
+            if sched.run_call(check):
+                return
+            time.sleep(0.005)
+        raise TimeoutError("A did not settle")
+
+    sched, svc = build(peer_alive=True)
+    try:
+        # config 1: everything at remote gid 1; config 2: half moves to
+        # local gid 2 (remote fetch + remote GC + local confirms).
+        sched.run_call(lambda: (b.admin_sync("join", [1]),
+                                svc.skv.admin_sync("join", [1])))
+        sched.run_call(lambda: (b.admin_sync("join", [2]),
+                                svc.skv.admin_sync("join", [2])))
+        settle_a(sched, svc)
+
+        cfg2 = rebalance(rebalance([0] * 10, {1: ["a"]}), {1: ["a"], 2: ["b"]})
+        shard2 = next(s for s in range(10) if cfg2[s] == 2)
+        key = next(chr(c) for c in range(97, 123)
+                   if key2shard(chr(c)) == shard2)
+
+        def put():
+            t = svc.skv.submit(2, "Put", key, "survives",
+                               client_id=7, command_id=1)
+            for _ in range(2000):
+                if t.done:
+                    break
+                svc.skv.pump(2)
+            assert t.done and not t.failed and t.err == SK_OK
+
+        sched.run_call(put)
+        # config 3: gid 1 leaves; the rest migrates 1 -> 2 (more remote
+        # fetches + GC).  Later WAL records (these inserts/confirms at
+        # config 3) are what force replay past the config-2 migration.
+        sched.run_call(lambda: (b.admin_sync("leave", [1]),
+                                svc.skv.admin_sync("leave", [1])))
+        settle_a(sched, svc)
+        sched.run_call(lambda: svc._dur.wal.sync())
+    finally:
+        svc.stop()
+        sched.stop()
+
+    # CRASH A; restart with the peer UNREACHABLE.  Replay must converge
+    # from the WAL alone (admin + insert + confirm + redo records).
+    sched, svc = build(peer_alive=False)
+    try:
+        def check():
+            cfg = svc.skv.query_latest()
+            rep = svc.skv.reps[2]
+            assert cfg.num == 3
+            return rep.cur.num == cfg.num and all(
+                sh.state == SERVING for sh in rep.shards.values()
+            )
+
+        for _ in range(3000):
+            if sched.run_call(check):
+                break
+            time.sleep(0.005)
+        else:
+            raise TimeoutError("restarted process did not settle")
+        assert sched.run_call(
+            lambda: svc.skv.reps[2].shards[shard2].data.get(key)
+        ) == "survives", "acked write lost across fleet replay"
+    finally:
+        svc.stop()
+        sched.stop()
+
+
 def test_shardkv_replay_across_multiple_config_migrations(tmp_path):
     """A WAL spanning TWO config changes with completed local
-    migrations (inserts at different config numbers, GC deletes in
-    between) must replay to convergence: confirm/GC keep running while
-    pulls are paused, and delete records wait for their config."""
+    migrations (inserts at different config numbers, GC deletes and
+    confirms in between) must replay to convergence: pulls and the live
+    GC handshake are paused, so every committed migration step —
+    inserts, deletes, GCING→SERVING confirms — re-applies from its own
+    WAL record, each waiting for its config."""
     from multiraft_tpu.distributed.engine_server import (
         EngineDurability,
         EngineShardKVService,

@@ -293,10 +293,9 @@ def test_fleet_move_shard_between_instances():
 
 def test_migration_paused_blocks_pulls_until_released():
     """The recovery gate: while ``migration_paused`` is set, config
-    advance (and GC/confirm for slots that already pulled) continues,
-    but no PULL runs — PULLING slots stay empty and BEPULLING sources
-    keep their data; releasing the flag lets the migration complete
-    normally."""
+    advance continues but no PULL (nor GC handshake) runs — PULLING
+    slots stay empty and BEPULLING sources keep their data; releasing
+    the flag lets the migration complete normally."""
     from multiraft_tpu.services.shardkv import BEPULLING, PULLING
 
     a, b = make_fleet(seed=7)
