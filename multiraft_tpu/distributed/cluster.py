@@ -19,7 +19,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from ..sim.scheduler import TIMEOUT
 from .disk import DiskPersister
@@ -292,6 +292,24 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
             mesh_devices=spec.get("mesh_devices", 0),
         )
+    elif kind == "split_kv":
+        _pin_platform(spec)
+        from .split_server import serve_split_kv
+
+        node = serve_split_kv(
+            port=spec["ports"][spec["me"]],
+            me=spec["me"],
+            # JSON stringifies the group keys and listifies slot lists.
+            owners={int(g): list(o) for g, o in spec["owners"].items()},
+            peer_addrs={
+                i: (spec.get("host", "127.0.0.1"), p)
+                for i, p in enumerate(spec["ports"])
+            },
+            G=spec.get("groups", 8),
+            host=spec.get("host", "127.0.0.1"),
+            seed=spec.get("seed", 0),
+            delay_elections=spec.get("delay_elections", 0),
+        )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
     print(f"ready {node.port}", flush=True)
@@ -493,6 +511,89 @@ class EngineProcessCluster:
             self.proc.kill()
             self.proc.wait()
         self.proc = None
+
+
+class SplitProcessCluster:
+    """Several engine processes SHARING each replica group's peer slots
+    (engine/split.py + distributed/split_server.py) — the deployment
+    where one process's death loses only its owned peer slots, and any
+    group whose surviving slots hold a quorum keeps serving with every
+    acknowledged write intact (no WAL, no disk: replication is the
+    durability).  Contrast :class:`EngineFleetCluster`, which
+    partitions whole gids per process.
+
+    ``owners[g][p]`` = process index owning peer slot ``p`` of group
+    ``g`` (same map for every process).  ``delay_elections[i]`` biases
+    process ``i``'s first election deadlines later — tests use it to
+    park initial leadership on a chosen process."""
+
+    def __init__(
+        self,
+        owners: Dict[int, Sequence[int]],
+        n_procs: int,
+        groups: int = 8,
+        host: str = "127.0.0.1",
+        seed: int = 0,
+        delay_elections: Optional[Sequence[int]] = None,
+    ) -> None:
+        from . import engine_server  # noqa: F401  (codec registration)
+        from . import split_server  # noqa: F401
+
+        self.host = host
+        self.ports = _reserve_ports(n_procs, host)
+        self.specs = []
+        for i in range(n_procs):
+            self.specs.append({
+                "kind": "split_kv",
+                "me": i,
+                "host": host,
+                "ports": self.ports,
+                "owners": {str(g): list(o) for g, o in owners.items()},
+                "groups": groups,
+                "seed": seed + i,
+                "delay_elections": (
+                    int(delay_elections[i]) if delay_elections else 0
+                ),
+                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+            })
+        self.procs: List[Optional[subprocess.Popen]] = [None] * n_procs
+
+    def start_all(self) -> None:
+        for i, spec in enumerate(self.specs):
+            self.procs[i] = _launch_server(spec, f"split-{i}")
+        for i, p in enumerate(self.procs):
+            _check_ready(p, f"split-{i}", timeout=300.0)
+
+    def kill(self, i: int) -> None:
+        """SIGKILL process ``i`` — its owned peer slots are gone (no
+        restart path: a split peer must not rejoin with fresh state,
+        see engine/split.py's double-vote note)."""
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self.procs[i] = None
+
+    def clerk(self) -> "BlockingSplitClerk":
+        return BlockingSplitClerk(self.ports, host=self.host)
+
+    def shutdown(self) -> None:
+        for i in range(len(self.procs)):
+            self.kill(i)
+
+
+class BlockingSplitClerk(_BlockingClerkBase):
+    """Blocking client of a :class:`SplitProcessCluster`."""
+
+    def __init__(
+        self, ports: Sequence[int], host: str = "127.0.0.1"
+    ) -> None:
+        from .split_server import SplitNetClerk
+
+        self.node = RpcNode()
+        self.sched = self.node.sched
+        ends = [self.node.client_end(host, p) for p in ports]
+        self._clerk = SplitNetClerk(self.sched, ends)
 
 
 class EngineFleetCluster:

@@ -1,0 +1,247 @@
+"""Serving layer for cross-process replica groups (engine/split.py).
+
+Each process runs ``serve_split_kv``: one chip-owning engine whose
+split groups share their P peer slots with peer processes, per-tick
+boundary mailbox slabs riding ``SplitEngine.slab`` RPCs between them
+(SURVEY §2.2's "node↔node over DCN/gRPC").  Unlike
+``serve_engine_kv``'s whole-group engine, losing one of these
+processes loses only its owned peer slots — a group whose surviving
+peers still hold a quorum keeps electing and committing, and every
+acknowledged write survives from replication alone (no WAL replay).
+
+Client surface mirrors the reference kvraft deployment: a clerk
+carries (client_id, command_id) sessions and rotates processes on
+ErrWrongLeader/timeout (reference: kvraft/client.go:47-71); the server
+gates submission on an owned slot actually leading the group and rides
+EVERY op — Gets included — through the log (reference semantics,
+SURVEY §3.4; the single-process ReadIndex collapse does not reason
+across processes).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..engine.core import EngineConfig
+from ..engine.host import EngineDriver
+from ..engine.kv import KVOp
+from ..engine.split import SplitKV, SplitPeering, SplitSpec
+from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+from ..sim.scheduler import TIMEOUT, Future
+from ..utils.ids import unique_client_id
+from .engine_server import (
+    ERR_TIMEOUT,
+    OK,
+    EngineCmdArgs,
+    EngineCmdReply,
+    route_group,
+)
+from .realtime import RealtimeScheduler
+from .tcp import RpcNode
+
+__all__ = [
+    "ERR_WRONG_LEADER",
+    "SplitKVService",
+    "SplitNetClerk",
+    "serve_split_kv",
+]
+
+ERR_WRONG_LEADER = "ErrWrongLeader"
+
+_OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
+
+
+class SplitKVService:
+    """``SplitKV.command`` + ``SplitEngine.slab`` on one process.
+
+    The pump loop advances the device one tick at a time and ships the
+    boundary slabs immediately — per-tick granularity matters here
+    (multi-tick pumps would drop the intermediate ticks' boundary
+    messages, doubling effective RTT across the process boundary)."""
+
+    RESUBMIT_S = 0.25
+    DEADLINE_S = 3.0
+
+    def __init__(
+        self,
+        sched: RealtimeScheduler,
+        kv: SplitKV,
+        peering: SplitPeering,
+        peer_ends: Dict[int, object],  # proc index -> TcpClientEnd
+        pump_interval: float = 0.002,
+    ) -> None:
+        self.sched = sched
+        self.kv = kv
+        self.peering = peering
+        self.peer_ends = dict(peer_ends)
+        self.G = kv.driver.cfg.G
+        self._interval = pump_interval
+        self._stopped = False
+        sched.call_soon(self._pump_loop)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _pump_loop(self) -> None:
+        if self._stopped:
+            return
+        self.kv.pump(1)
+        for proc, slab in self.peering.extract().items():
+            end = self.peer_ends.get(proc)
+            if end is not None:
+                # Fire-and-forget: a lost slab is a dropped message and
+                # Raft retries; the timeout just reclaims the future.
+                self.sched.with_timeout(
+                    end.call("SplitEngine.slab", slab), 1.0
+                )
+        self.sched.call_after(self._interval, self._pump_loop)
+
+    # -- peer-facing -------------------------------------------------------
+
+    def slab(self, blob: dict):
+        """Boundary mailbox lanes (+payloads/snapshots) from a peer
+        process — merged before the next tick (same loop thread)."""
+        self.peering.inject(blob)
+        return True
+
+    # -- client-facing -----------------------------------------------------
+
+    def command(self, args: EngineCmdArgs):
+        g = route_group(args.key, self.G)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                t = self.kv.submit_local(
+                    g,
+                    KVOp(
+                        op=_OPCODE[args.op],
+                        key=args.key,
+                        value=args.value,
+                        client_id=args.client_id,
+                        command_id=args.command_id,
+                    ),
+                )
+                if t is None:
+                    # No owned slot leads this group: the leader lives
+                    # in (or is being elected by) a peer process.
+                    return EngineCmdReply(err=ERR_WRONG_LEADER)
+                sub_deadline = min(
+                    self.sched.now + self.RESUBMIT_S, deadline
+                )
+                while not t.done and self.sched.now < sub_deadline:
+                    yield 0.002
+                if t.done and not t.failed:
+                    return EngineCmdReply(err=OK, value=t.value)
+                # failed (lost slot / lost leadership) or wedged:
+                # re-check leadership and resubmit — dedup-safe.
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+
+class SplitNetClerk:
+    """Generator-coroutine clerk over a set of split-KV processes:
+    session dedup + rotate-on-ErrWrongLeader/timeout with a per-group
+    leader cache (reference clerk loop, kvraft/client.go:47-71)."""
+
+    _next = itertools.count(1)
+
+    def __init__(self, sched, ends: Sequence) -> None:
+        self.sched = sched
+        self.ends = list(ends)
+        self.client_id = unique_client_id(next(SplitNetClerk._next))
+        self.command_id = 0
+        self._leader: Dict[int, int] = {}  # route bucket -> ends index
+
+    def _command(self, op: str, key: str, value: str = ""):
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        # Group routing is server-side; the leader cache keys on the
+        # key's route bucket (stable across retries of the same key).
+        gkey = route_group(key, max(len(self.ends), 1))
+        i = self._leader.get(gkey, 0)
+        while True:
+            end = self.ends[i % len(self.ends)]
+            fut: Future = end.call("SplitKV.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if (
+                reply is None
+                or reply is TIMEOUT
+                or reply.err != OK
+            ):
+                i += 1  # rotate: dropped / wrong leader / timed out
+                yield self.sched.sleep(0.02)
+                continue
+            self._leader[gkey] = i % len(self.ends)
+            return reply.value
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+
+def serve_split_kv(
+    port: int,
+    me: int,
+    owners: Dict[int, Sequence[int]],
+    peer_addrs: Dict[int, Tuple[str, int]],
+    G: int = 8,
+    host: str = "127.0.0.1",
+    seed: int = 0,
+    delay_elections: int = 0,
+) -> RpcNode:
+    """Bring up one split-KV process: engine over ``G`` groups, peer
+    slots placed per ``owners`` (see :class:`SplitSpec` — every process
+    passes the SAME map), slab exchange with ``peer_addrs``.
+
+    ``delay_elections`` biases this process's owned slots' first
+    election deadlines later — deployments use it to steer initial
+    leadership (tests park leaders on a chosen process; a real rollout
+    can spread them).  Readiness prints before leaders exist: elections
+    converge once the peers are up, and clerks retry ErrWrongLeader
+    until then."""
+    node = RpcNode(listen=True, host=host, port=port)
+    sched = node.sched
+
+    def build():
+        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8,
+                           host_paced_compaction=True)
+        driver = EngineDriver(cfg, seed=seed)
+        kv = SplitKV(driver)
+        peering = SplitPeering(
+            driver, kv, SplitSpec(me=me, owners={
+                int(g): list(o) for g, o in owners.items()
+            })
+        )
+        if delay_elections:
+            driver.state = driver.state._replace(
+                elect_dl=driver.state.elect_dl + int(delay_elections)
+            )
+        # Warm both tick variants before the readiness line (first jit
+        # compile would otherwise starve RPC dispatch under the first
+        # client — see serve_engine_kv).
+        driver.start(0, (KVOp(op=OP_GET, key=""), None))
+        kv.pump(4)
+        ends = {
+            int(p): node.client_end(h, int(pt))
+            for p, (h, pt) in peer_addrs.items()
+            if int(p) != me
+        }
+        return SplitKVService(sched, kv, peering, ends)
+
+    svc = sched.run_call(build, timeout=600.0)
+    node.add_service("SplitKV", svc)
+    node.add_service("SplitEngine", svc)
+    node.engine_service = svc
+    return node

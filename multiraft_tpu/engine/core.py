@@ -94,6 +94,14 @@ class EngineConfig:
     # non-TPU backends (parity/testing path).
     use_pallas: bool = False
     pallas_interpret: bool = False
+    # Host-paced compaction (split-group mode, engine/split.py): the
+    # tick stops auto-advancing `applied` to `commit`, leaving the host
+    # to raise it as its state machine actually applies — so ring
+    # compaction can never pass an index whose entry term the host
+    # still needs (payload term-arbitration reads it from the ring).
+    # Off for the throughput path: device-paced applied keeps the ring
+    # compacting without host round-trips.
+    host_paced_compaction: bool = False
     # PreVote (etcd/TiKV-style, beyond the reference): an election
     # timeout launches a NON-BINDING prevote round at term+1 first;
     # only a prevote quorum promotes to a real candidacy.  Voters that
@@ -825,7 +833,10 @@ def tick_impl(
     )
 
     # ---- 6. apply frontier + ring compaction ----
-    state = state._replace(applied=jnp.maximum(state.applied, state.commit))
+    if not cfg.host_paced_compaction:
+        state = state._replace(
+            applied=jnp.maximum(state.applied, state.commit)
+        )
     # Compact when headroom shrinks: advance base over the applied
     # prefix (device analog of service-driven Snapshot(),
     # reference: raft/raft_snapshot.go:3-13).

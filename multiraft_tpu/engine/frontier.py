@@ -35,6 +35,11 @@ class FrontierService:
         self.applied_upto = [0] * driver.cfg.G
         driver.on_payload_evicted = self._on_evicted
         self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
+        # Split-group mode (engine/split.py): applied payloads are KEPT
+        # so a lagging remote peer's resend can still ship them; the
+        # peering GCs below the ring floor instead.  Default False: the
+        # pop keeps host memory bounded under a sustained firehose.
+        self.retain_payloads = False
 
     # -- subclass hooks ----------------------------------------------------
 
@@ -45,6 +50,11 @@ class FrontierService:
         raise NotImplementedError
 
     def _post_pump(self) -> None:
+        pass
+
+    def _pre_sweep(self) -> None:
+        """Runs between the device step and the apply sweep (split mode
+        raises the device's host-paced applied frontier here)."""
         pass
 
     # -- checkpoint hooks (pair with EngineDriver.save/restore) -----------
@@ -64,6 +74,7 @@ class FrontierService:
         """Advance the engine and apply the committed frontier
         (DeferredConsensus.pump)."""
         self.driver.step(n_ticks)
+        self._pre_sweep()
         commit = np.asarray(self.driver.last_metrics["commit_index"])
         now = self.driver.tick
         for g in range(self.driver.cfg.G):
@@ -71,8 +82,13 @@ class FrontierService:
             while self.applied_upto[g] < upto:
                 idx = self.applied_upto[g] + 1
                 # pop: an applied payload is never needed again (host
-                # memory stays bounded under a sustained firehose).
-                payload = self.driver.payloads.pop((g, idx), None)
+                # memory stays bounded under a sustained firehose) —
+                # unless split-group resends still need it (see
+                # retain_payloads above).
+                if self.retain_payloads:
+                    payload = self.driver.payloads.get((g, idx))
+                else:
+                    payload = self.driver.payloads.pop((g, idx), None)
                 self._apply(g, idx, payload, now)
                 self.applied_upto[g] = idx
         self._post_pump()

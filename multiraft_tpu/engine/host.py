@@ -164,6 +164,11 @@ class EngineDriver:
         # overwritten — i.e. the old command lost its slot to a leader
         # change and will never commit at that index.
         self.on_payload_evicted: Optional[Any] = None
+        # Called as (g, idx, term) when a payload binds at ingest —
+        # split-group peering records the accept term so a stale slab
+        # from a deposed leader can never replace a newer local binding
+        # (engine/split.py).  None = skip the extra metric readback.
+        self.on_payload_bound: Optional[Any] = None
         # Optional utils.trace.Tracer: each tick becomes a wall-clock
         # span carrying its metrics.  Forces a device sync per tick, so
         # it is a diagnostic mode, not a throughput mode.
@@ -356,6 +361,10 @@ class EngineDriver:
                 # Host sync only while commands are in flight.
                 accepted = np.asarray(metrics["accepted"])
                 starts = np.asarray(metrics["start_index"])
+                terms = (
+                    np.asarray(metrics["accept_term"])
+                    if self.on_payload_bound else None
+                )
                 for g in np.nonzero(accepted)[0]:
                     k = int(accepted[g])
                     self.backlog[g] -= k
@@ -368,6 +377,10 @@ class EngineDriver:
                             if old is not None and self.on_payload_evicted:
                                 self.on_payload_evicted(old)
                             self.payloads[slot] = pend.pop(0)
+                            if terms is not None:
+                                self.on_payload_bound(
+                                    slot[0], slot[1], int(terms[g])
+                                )
             # Accumulate on device; converted lazily by readers.
             self._commits_dev = (
                 getattr(self, "_commits_dev", jnp.int32(0)) + metrics["commits"]

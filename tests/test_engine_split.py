@@ -1,0 +1,256 @@
+"""Split replica groups: one group's P peers hosted by SEVERAL engine
+processes (engine/split.py), exchanged as per-tick mailbox slabs.
+
+These tests run two drivers in-process with a deterministic manual slab
+shuttle — the same extract/inject machinery the socket servers use,
+minus the sockets (those are covered by tests/test_split_server.py).
+Conformance targets: elections and commits across the process boundary,
+payload replication (both processes materialize the applied state),
+leader failover when a process dies with the surviving process holding
+a quorum, and InstallSnapshot catch-up (service blob travel) after a
+long partition.  Reference analog: every server is its own failure
+domain (labrpc/labrpc.go:316-364, raft/config.go:113-142).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.kv import KVOp
+from multiraft_tpu.engine.split import SplitKV, SplitPeering, SplitSpec
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+
+
+class Side:
+    """One 'process': driver + service + peering."""
+
+    def __init__(self, me, owners, G, seed, delay_elections=0):
+        cfg = EngineConfig(G=G, P=3, L=32, E=8, INGEST=8,
+                           host_paced_compaction=True)
+        self.driver = EngineDriver(cfg, seed=seed)
+        self.kv = SplitKV(self.driver)
+        self.peering = SplitPeering(
+            self.driver, self.kv, SplitSpec(me=me, owners=owners)
+        )
+        self.me = me
+        self.alive = True
+        if delay_elections:
+            # Bias: let the OTHER side win the first elections.
+            self.driver.state = self.driver.state._replace(
+                elect_dl=self.driver.state.elect_dl + delay_elections
+            )
+
+
+def make_pair(owners, G=2, delay_on=None, delay=200):
+    sides = [
+        Side(0, owners, G, seed=11,
+             delay_elections=delay if delay_on == 0 else 0),
+        Side(1, owners, G, seed=22,
+             delay_elections=delay if delay_on == 1 else 0),
+    ]
+    return sides
+
+
+def pump(sides, rounds=1, cut=False):
+    """One round = each live side ticks once, then its boundary slabs
+    are delivered to the other live side (``cut`` drops them all — a
+    full partition between the processes)."""
+    for _ in range(rounds):
+        for side in sides:
+            if not side.alive:
+                continue
+            side.kv.pump(1)
+            slabs = side.peering.extract()
+            if cut:
+                continue
+            for proc, slab in slabs.items():
+                dst = sides[proc]
+                if dst.alive:
+                    dst.peering.inject(slab)
+
+
+def total_leaders(sides, g):
+    return sum(
+        int(s.driver.leaders_per_group()[g]) for s in sides if s.alive
+    )
+
+
+def settle_leaders(sides, G, max_rounds=400):
+    for _ in range(max_rounds):
+        pump(sides, 1)
+        if all(total_leaders(sides, g) == 1 for g in range(G)):
+            return
+    raise TimeoutError("split groups did not elect a single leader")
+
+
+def leader_side(sides, g):
+    for s in sides:
+        if s.alive and s.kv.local_leader(g) is not None:
+            return s
+    return None
+
+
+_next_cmd = [0]
+
+
+def run_op(sides, g, op, max_rounds=500, cut=False):
+    """Submit at the current leader's side, pump to commit.  Session
+    ids are assigned so leadership-change resubmits stay exactly-once
+    (command_id=0 would disable dedup and double-apply on retry)."""
+    if op.command_id == 0:
+        _next_cmd[0] += 1
+        op.client_id, op.command_id = 424242, _next_cmd[0]
+    for _ in range(max_rounds):
+        side = leader_side(sides, g)
+        if side is None:
+            pump(sides, 1, cut=cut)
+            continue
+        t = side.kv.submit_local(g, op)
+        if t is None:
+            pump(sides, 1, cut=cut)
+            continue
+        for _ in range(max_rounds):
+            pump(sides, 1, cut=cut)
+            if t.done:
+                break
+        if t.done and not t.failed:
+            return t
+    raise TimeoutError(f"op {op} did not commit")
+
+
+def test_split_group_elects_and_commits_across_processes():
+    owners = {0: [0, 0, 1], 1: [1, 1, 0]}
+    sides = make_pair(owners)
+    settle_leaders(sides, G=2)
+    # Exactly one leader per group, and it lives where a quorum can
+    # back it — both placements must work.
+    for g in (0, 1):
+        t = run_op(sides, g, KVOp(op=OP_PUT, key=f"k{g}", value=f"v{g}"))
+        assert t.done and not t.failed
+    # Both processes materialized the same applied state (payloads
+    # travel with the append lanes).
+    for _ in range(100):
+        pump(sides, 1)
+        if all(
+            sides[0].kv.data[g] == sides[1].kv.data[g] for g in (0, 1)
+        ):
+            break
+    for g in (0, 1):
+        assert sides[0].kv.data[g] == {f"k{g}": f"v{g}"}
+        assert sides[1].kv.data[g] == {f"k{g}": f"v{g}"}
+
+
+def test_split_group_survives_minority_process_death():
+    """The headline property: kill the process hosting 1 of 3 peers
+    (including the leader) while the group is under load — the
+    surviving process's 2 peers elect among themselves and keep
+    committing, with every acknowledged write intact, from replication
+    alone (no WAL, no disk)."""
+    owners = {0: [0, 1, 1]}
+    sides = make_pair(owners, G=1, delay_on=1)  # leader lands on proc 0
+    settle_leaders(sides, G=1)
+    assert sides[0].kv.local_leader(0) is not None, "bias failed"
+
+    acked = []
+    for i in range(5):
+        run_op(sides, 0, KVOp(op=OP_APPEND, key="log", value=f"[{i}]"))
+        acked.append(f"[{i}]")
+
+    # KILL the minority/leader process mid-stream.
+    sides[0].alive = False
+
+    # Survivors elect and keep serving: every acked append present,
+    # new appends commit.
+    for _ in range(600):
+        pump(sides, 1)
+        if sides[1].kv.local_leader(0) is not None:
+            break
+    assert sides[1].kv.local_leader(0) is not None, "no failover leader"
+    run_op(sides, 0, KVOp(op=OP_APPEND, key="log", value="[post]"))
+    assert sides[1].kv.data[0]["log"] == "".join(acked) + "[post]"
+
+
+def test_split_group_get_rides_the_log_after_failover():
+    owners = {0: [0, 1, 1]}
+    sides = make_pair(owners, G=1, delay_on=1)
+    settle_leaders(sides, G=1)
+    run_op(sides, 0, KVOp(op=OP_PUT, key="k", value="pre-crash"))
+    sides[0].alive = False
+    for _ in range(600):
+        pump(sides, 1)
+        if sides[1].kv.local_leader(0) is not None:
+            break
+    t = run_op(sides, 0, KVOp(op=OP_GET, key="k"))
+    assert t.value == "pre-crash", "acked write invisible after failover"
+
+
+def test_split_group_snapshot_catchup_after_partition():
+    """A process partitioned long enough that the quorum side's ring
+    compacts past its tail must catch up via the InstallSnapshot lane —
+    the slab then carries the service state blob, not entries."""
+    owners = {0: [0, 0, 1]}  # proc 0 holds a quorum alone
+    sides = make_pair(owners, G=1, delay_on=1)
+    settle_leaders(sides, G=1)
+    assert sides[0].kv.local_leader(0) is not None
+
+    # Partition proc 1; commit enough to wrap the L=32 ring at proc 0.
+    for i in range(40):
+        run_op(sides, 0, KVOp(op=OP_PUT, key=f"k{i}", value=str(i)),
+               cut=True)
+    st = sides[0].driver.np_state()
+    lead = sides[0].kv.local_leader(0)
+    assert int(st["base"][0, lead]) > 0, "ring never compacted"
+
+    # Heal: proc 1's replica is behind the leader's base, so the leader
+    # sends ar_snap and the slab ships the KV blob.
+    for _ in range(400):
+        pump(sides, 1)
+        if sides[1].kv.data[0] == sides[0].kv.data[0]:
+            break
+    assert sides[1].kv.data[0] == sides[0].kv.data[0]
+    assert sides[1].kv.data[0]["k39"] == "39"
+
+
+def test_submit_local_rejects_non_leader_process():
+    owners = {0: [0, 1, 1]}
+    sides = make_pair(owners, G=1, delay_on=1)
+    settle_leaders(sides, G=1)
+    follower = sides[1] if sides[0].kv.local_leader(0) is not None else sides[0]
+    assert follower.kv.submit_local(
+        0, KVOp(op=OP_PUT, key="x", value="y")
+    ) is None
+
+
+def test_lost_leadership_flushes_foreign_backlog():
+    """Commands queued at a process that loses leadership (and cannot
+    bind them) must fail their tickets so clients re-route — not sit in
+    the backlog forever."""
+    owners = {0: [0, 1, 1]}
+    sides = make_pair(owners, G=1, delay_on=1)
+    settle_leaders(sides, G=1)
+    assert sides[0].kv.local_leader(0) is not None
+    # Partition proc 0 (leader): survivors elect a new leader; the old
+    # one steps down when it rejoins... but first, queue a command that
+    # arrives while proc 0 still thinks it leads, then cut it off
+    # before it can replicate.
+    t = sides[0].kv.submit_local(0, KVOp(op=OP_PUT, key="k", value="lost"))
+    assert t is not None
+    sides[0].alive = False
+    for _ in range(600):
+        pump(sides, 1)
+        if sides[1].kv.local_leader(0) is not None:
+            break
+    # Rejoining is not supported (fresh-state double-vote hazard) —
+    # instead verify the dead side's pending work fails fast when its
+    # own pump keeps running without leadership (step down on seeing
+    # the new term is covered by the failover tests; here the flush
+    # path): revive only its pump loop, partitioned.
+    sides[0].alive = True
+    for _ in range(200):
+        pump(sides, 1)  # reconnected: proc 0 sees the higher term
+        if t.done:
+            break
+    assert t.done, "orphaned backlog command never resolved"

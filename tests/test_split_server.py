@@ -1,0 +1,92 @@
+"""Cross-process replica groups over real sockets + real kill -9.
+
+The verdict-r2 deliverable for per-replica failure independence on the
+engine backend: a replica group's P peers live on TWO chip-owning OS
+processes; kill -9 one of them UNDER CLIENT LOAD and the group must
+keep committing from the surviving peers with every acknowledged write
+intact — from replication alone, no WAL replay (the killed process has
+no disk state at all).
+
+In-process slab-exchange semantics are covered deterministically by
+tests/test_engine_split.py; this file is the OS-process/socket form.
+Reference analog: per-server crash with the rest of the cluster
+serving on (raft/config.go:113-142; kvraft 3A crash tests).
+"""
+
+import time
+
+import pytest
+
+from tests.test_distributed import needs_native
+
+
+@needs_native
+class TestSplitProcessCluster:
+    def test_kill9_under_load_survivors_keep_serving(self):
+        """Two processes share every group's 3 peer slots 1/2; leaders
+        are parked on the MINORITY process (election bias), then that
+        process is SIGKILLed mid-stream.  The surviving process's two
+        peers must elect among themselves and serve on: every acked
+        append present exactly once, new appends committing."""
+        from multiraft_tpu.distributed.cluster import SplitProcessCluster
+
+        G = 4
+        owners = {g: [0, 1, 1] for g in range(G)}
+        cluster = SplitProcessCluster(
+            owners, n_procs=2, groups=G,
+            # Park initial leadership on process 0 (the 1-slot owner):
+            # its death then forces a real cross-process failover.
+            delay_elections=[0, 300],
+        )
+        try:
+            cluster.start_all()
+            clerk = cluster.clerk()
+            keys = [f"key-{i}" for i in range(8)]  # spread over groups
+            acked = {k: [] for k in keys}
+
+            def load(round_tag, rounds):
+                for r in range(rounds):
+                    for k in keys:
+                        piece = f"[{round_tag}{r}]"
+                        clerk.append(k, piece, timeout=60.0)
+                        acked[k].append(piece)
+
+            load("a", 3)
+
+            # KILL -9 the leader-hosting process mid-load.
+            cluster.kill(0)
+
+            # Clerk retries route to the survivor; failover elections
+            # need only the survivor's own quorum (2 of 3).
+            load("b", 3)
+
+            for k in keys:
+                got = clerk.get(k, timeout=60.0)
+                assert got == "".join(acked[k]), (
+                    f"{k}: acked history diverged after kill -9:"
+                    f" {got!r} != {''.join(acked[k])!r}"
+                )
+            clerk.close()
+        finally:
+            cluster.shutdown()
+
+    def test_kill9_majority_owner_stalls_until_nothing_lost(self):
+        """Sanity inverse: killing the MAJORITY owner (2 of 3 slots)
+        must stall the groups (no quorum — correctness over
+        availability), never serve stale or partial state."""
+        from multiraft_tpu.distributed.cluster import SplitProcessCluster
+
+        owners = {g: [0, 1, 1] for g in range(2)}
+        cluster = SplitProcessCluster(
+            owners, n_procs=2, groups=2, delay_elections=[0, 300]
+        )
+        try:
+            cluster.start_all()
+            clerk = cluster.clerk()
+            clerk.put("k", "v", timeout=60.0)
+            cluster.kill(1)  # the 2-slot owner: quorum gone
+            with pytest.raises(TimeoutError):
+                clerk.put("k", "lost", timeout=6.0)
+            clerk.close()
+        finally:
+            cluster.shutdown()
