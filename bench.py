@@ -1,15 +1,26 @@
 """Headline benchmark: log commits/sec across 10k Raft groups.
 
 North star (BASELINE.md): >= 1,000,000 log commits/sec across 10k Raft
-groups on a single TPU v5e chip, p99 commit latency tracked.
+groups on a single TPU v5e chip, p99 commit latency tracked,
+porcupine-verified on sampled shards.
 
 Method: the batched engine at G=10,000 x P=3 with a saturating Start()
 firehose, run as device-resident lax.scan chunks (zero host round trips
 between ticks).  Committed entries are counted exactly from the commit
-frontier delta; p99 commit latency is the measured per-tick wall time
-times the commit pipeline depth in ticks (append is sent the tick it is
-ingested, acked next tick, committed the tick after: depth 2, +1 tick
-of ingestion queueing at saturation).
+frontier delta.  The timed chunks run the TRACED loop
+(core.run_ticks_traced): the device records per-tick ingest/commit
+frontiers + accept terms, from which the bench derives
+
+* the MEASURED per-entry commit-latency distribution (exact, every
+  entry in the window — engine/bench_verify.latency_histogram), and
+* a porcupine check of 64 sampled groups' reconstructed operation
+  histories, cross-checked entry-for-entry against the final device
+  ring (engine/bench_verify.verify_sampled_groups) — the reference's
+  check-the-actual-run pattern (kvraft/test_test.go:365-381) applied
+  to the flagship measurement itself.
+
+Set MULTIRAFT_BENCH_VERIFY=0 for the untraced loop (e.g. to measure
+trace overhead; it is ~free — four [G] i32 vectors per tick).
 
 Prints ONE JSON line on stdout; progress goes to stderr.  The
 headline value is the MEDIAN of the per-chunk rates (with min/max
@@ -40,8 +51,15 @@ def main() -> None:
         empty_mailbox,
         init_state,
         run_ticks,
+        run_ticks_traced,
     )
 
+    # MULTIRAFT_BENCH_PLATFORM=cpu pins the backend (the axon plugin
+    # otherwise steers even JAX_PLATFORMS=cpu runs to the tunnel chip)
+    # — used by the CPU smoke tests of the bench rig itself.
+    forced = os.environ.get("MULTIRAFT_BENCH_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
     platform = jax.devices()[0].platform
     log(f"bench: devices={jax.devices()} platform={platform}")
 
@@ -51,7 +69,12 @@ def main() -> None:
     # the pure-XLA lowering at the 10k-group bench shape; default on
     # where they have a real lowering (CPU-only hosts would need the
     # interpreter, which is far slower than the XLA path).
-    default_pallas = "1" if platform == "tpu" else "0"
+    n_mesh = int(os.environ.get("MULTIRAFT_BENCH_MESH", "0"))
+    # Pallas quorum/tally kernels are the single-chip fast path; under
+    # shard_map the pallas_call's output avals fail jax's vma check
+    # (and each shard is small anyway) — mesh mode uses the XLA
+    # lowering of the same ops.
+    default_pallas = "1" if (platform == "tpu" and not n_mesh) else "0"
     use_pallas = (
         os.environ.get("MULTIRAFT_BENCH_PALLAS", default_pallas) == "1"
     )
@@ -70,18 +93,20 @@ def main() -> None:
 
     CHUNK = int(os.environ.get("MULTIRAFT_BENCH_CHUNK", "200"))
     N_CHUNKS = int(os.environ.get("MULTIRAFT_BENCH_CHUNKS", "5"))
+    VERIFY = os.environ.get("MULTIRAFT_BENCH_VERIFY", "1") == "1"
+    N_SAMPLE = int(os.environ.get("MULTIRAFT_BENCH_SAMPLE", "64"))
 
     # MULTIRAFT_BENCH_MESH=n shards the groups axis over an n-device
     # mesh using the same shard_map recipe as EngineDriver(mesh=...)
     # and dryrun_multichip (engine/mesh.py) — one code path from dryrun
     # to bench.  Zero collectives asserted at compile.
-    n_mesh = int(os.environ.get("MULTIRAFT_BENCH_MESH", "0"))
     if n_mesh:
         from jax.sharding import Mesh
 
         from multiraft_tpu.engine.mesh import (
             assert_zero_collectives,
             make_sharded_run_ticks,
+            make_sharded_run_ticks_traced,
             shard_arrays,
         )
 
@@ -90,10 +115,15 @@ def main() -> None:
         inbox = shard_arrays(cfg, mesh, inbox)
         _warm = make_sharded_run_ticks(cfg, mesh, CHUNK, 0)
         _load = make_sharded_run_ticks(cfg, mesh, CHUNK, cfg.INGEST)
+        _traced = make_sharded_run_ticks_traced(cfg, mesh, CHUNK, cfg.INGEST)
         assert_zero_collectives(_load, state, inbox, key)
+        # The timed loop in verify mode is the TRACED one — its
+        # zero-collective property is the one the headline rests on.
+        assert_zero_collectives(_traced, state, inbox, key)
         run_ticks = lambda c, st, mb, n, ingest, k: (
             (_warm if ingest == 0 else _load)(st, mb, k)
         )
+        run_ticks_traced = lambda c, st, mb, n, ingest, k: _traced(st, mb, k)
         log(f"bench: mesh mode over {n_mesh} devices (zero collectives)")
 
     # Warm-up: elect leaders everywhere; same static (n_ticks, ingest)
@@ -118,14 +148,43 @@ def main() -> None:
     m = Metrics()
     tick_times = []
     prev = np.asarray(jnp.max(state.commit, axis=1)).astype(np.int64)
+    # Pre-window frontier seeds for the trace analysis: the last log
+    # index and commit per group at the instant the timed window opens.
+    seed_last = np.asarray(
+        jnp.max(state.base + state.log_len, axis=1)
+    ).astype(np.int64)
+    seed_commit = prev.copy()
+    chunk_recs = []
+    if VERIFY:
+        # Compile the traced variant outside the timed region.
+        state, inbox, _warm_rec = run_ticks_traced(
+            cfg, state, inbox, CHUNK, cfg.INGEST, jax.random.fold_in(key, 3)
+        )
+        jax.block_until_ready(state.term)
+        del _warm_rec
+        prev = np.asarray(jnp.max(state.commit, axis=1)).astype(np.int64)
+        seed_last = np.asarray(
+            jnp.max(state.base + state.log_len, axis=1)
+        ).astype(np.int64)
+        seed_commit = prev.copy()
     t_begin = time.perf_counter()
     for c in range(N_CHUNKS):
         t0 = time.perf_counter()
-        state, inbox = run_ticks(
-            cfg, state, inbox, CHUNK, cfg.INGEST, jax.random.fold_in(key, 10 + c)
-        )
+        if VERIFY:
+            state, inbox, rec = run_ticks_traced(
+                cfg, state, inbox, CHUNK, cfg.INGEST,
+                jax.random.fold_in(key, 10 + c),
+            )
+        else:
+            state, inbox = run_ticks(
+                cfg, state, inbox, CHUNK, cfg.INGEST,
+                jax.random.fold_in(key, 10 + c),
+            )
         jax.block_until_ready(state.term)
         dt = time.perf_counter() - t0
+        if VERIFY:
+            # Host transfer happens outside the timed region.
+            chunk_recs.append({k: np.asarray(v) for k, v in rec.items()})
         cur = np.asarray(jnp.max(state.commit, axis=1)).astype(np.int64)
         chunk_commits = int((cur - prev).sum())
         rate = chunk_commits / dt
@@ -144,11 +203,60 @@ def main() -> None:
     rates = sorted(m.samples["chunk_rate"])
     commits_per_sec = m.percentile("chunk_rate", 0.5)
     total_commits = m.counters["commits"]
-    # Commit latency: ingest->send (same tick), follower append (+1),
-    # reply+quorum commit (+1) = 2 ticks pipeline + ~1 tick queue wait.
     per_tick_p99 = float(np.percentile(np.array(tick_times), 99))
-    p99_latency_ms = 3 * per_tick_p99 * 1e3
+    per_tick_mean = float(np.mean(np.array(tick_times)))
+    # The former 3-tick MODEL (ingest->send, follower append, quorum
+    # commit + 1 queue tick) — kept for comparison against the measured
+    # distribution below.
+    p99_model_ms = 3 * per_tick_p99 * 1e3
     leaders = int(jnp.sum((state.role == 2) & state.alive))
+
+    extra = {}
+    if VERIFY and chunk_recs:
+        from multiraft_tpu.engine.bench_verify import (
+            concat_records,
+            latency_histogram,
+            verify_sampled_groups,
+        )
+
+        recs = concat_records(chunk_recs)
+        lat = latency_histogram(recs, seed_last, seed_commit)
+        # MEASURED p99: the per-entry latency distribution in ticks,
+        # exact for every committed entry of the window, converted at
+        # the mean measured tick time (and, conservatively, at the p99
+        # tick time — the gate uses the conservative number).
+        p99_latency_ms = lat["p99_ticks"] * per_tick_mean * 1e3
+        p99_conservative_ms = lat["p99_ticks"] * per_tick_p99 * 1e3
+        log(
+            f"bench: measured latency p50={lat['p50_ticks']} ticks, "
+            f"p99={lat['p99_ticks']} ticks over {lat['entries']:,} "
+            f"entries (model said 3 ticks); hist={lat['hist_ticks']}"
+        )
+        sample = sorted(set(np.linspace(0, G - 1, N_SAMPLE, dtype=int)))
+        t0 = time.perf_counter()
+        porc = verify_sampled_groups(
+            recs, seed_last, seed_commit, [int(g) for g in sample],
+            state, cfg,
+        )
+        log(
+            f"bench: porcupine over {len(sample)} sampled groups: "
+            f"{porc['porcupine']} ({time.perf_counter()-t0:.1f}s, "
+            f"{porc.get('ring_entries_crosschecked', 0)} ring entries "
+            f"cross-checked)"
+        )
+        extra = {
+            "p99_latency_ticks": lat["p99_ticks"],
+            "p50_latency_ticks": lat["p50_ticks"],
+            "latency_entries_measured": lat["entries"],
+            "p99_conservative_ms": round(p99_conservative_ms, 3),
+            "p99_model_ms": round(p99_model_ms, 3),
+            "porcupine": porc["porcupine"],
+            "sampled_groups": porc["sampled_groups"],
+        }
+        p99_gate_ms = p99_conservative_ms
+    else:
+        p99_latency_ms = p99_model_ms
+        p99_gate_ms = p99_model_ms
     log(
         f"bench: {total_commits} commits in {elapsed:.2f}s over {G} groups "
         f"(leaders={leaders}), p99 commit latency ~{p99_latency_ms:.2f} ms"
@@ -164,14 +272,17 @@ def main() -> None:
                 "vs_baseline": round(commits_per_sec / baseline, 3),
                 "p99_commit_latency_ms": round(p99_latency_ms, 3),
                 # Latency target (BENCHMARKS.md): ≤ 5 ms at the
-                # north-star shape — False = regression.
-                "p99_within_target": bool(p99_latency_ms <= 5.0),
+                # north-star shape — False = regression.  Gated on the
+                # conservative (p99-tick-time) conversion when the
+                # measured distribution is available.
+                "p99_within_target": bool(p99_gate_ms <= 5.0),
                 "median_of": len(rates),
                 "min": round(rates[0], 1),
                 "max": round(rates[-1], 1),
                 "spread_pct": round(
                     100.0 * (rates[-1] - rates[0]) / commits_per_sec, 1
                 ),
+                **extra,
             }
         )
     )

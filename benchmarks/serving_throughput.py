@@ -8,12 +8,20 @@ pump coalesces every command that arrived since the last one into a
 single device step, so throughput scales with concurrency until the
 pump (or the box) saturates, while per-op latency stays ~pump-bounded.
 
+Two modes, both measured by default:
+
+* per-op (``frame=0``): every op is its own RPC — the reference
+  clerk's serial loop shape (kvraft/client.go:47-71);
+* framed (``frame=B``): each clerk ships B ops per ``batch`` RPC
+  (PipelinedClerk) and the server applies the frame in one pump —
+  the multi-op-frames fix for per-op RPC overhead.
+
 Usage::
 
-    python -m benchmarks.serving_throughput [n_clerks] [ops_per_clerk]
+    python -m benchmarks.serving_throughput [n_clerks] [ops_per_clerk] [frame]
 
 One JSON line: {"clerks": K, "ops": N, "ops_per_sec": R,
-"mean_latency_ms": L}.
+"mean_latency_ms": L, "framed_ops_per_sec": ..., "frame": B}.
 """
 
 from __future__ import annotations
@@ -23,13 +31,21 @@ import sys
 import time
 
 
-def bench(n_clerks: int = 16, ops_per_clerk: int = 50) -> dict:
+def bench(
+    n_clerks: int = 16, ops_per_clerk: int = 50, frame: int = 0,
+    data_dir=None,
+) -> dict:
     from multiraft_tpu.distributed.cluster import EngineProcessCluster
-    from multiraft_tpu.distributed.engine_server import EngineClerk
+    from multiraft_tpu.distributed.engine_server import (
+        EngineClerk,
+        PipelinedClerk,
+    )
     from multiraft_tpu.distributed.tcp import RpcNode
     from multiraft_tpu.sim.scheduler import TIMEOUT
 
-    cluster = EngineProcessCluster(kind="engine_kv", groups=64, seed=41)
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=64, seed=41, data_dir=data_dir
+    )
     node = None
     try:
         cluster.start()
@@ -43,18 +59,37 @@ def bench(n_clerks: int = 16, ops_per_clerk: int = 50) -> dict:
 
         lat_acc = []
 
+        def ops_for(i):
+            out = []
+            for j in range(ops_per_clerk):
+                if j % 3 == 2:
+                    out.append(("Get", f"k{i}-{j % 5}", ""))
+                else:
+                    out.append(("Put", f"k{i}-{j % 5}", f"v{j}"))
+            return out
+
         def clerk_driver(i):
             ck = EngineClerk(sched, end)
-            for j in range(ops_per_clerk):
+            for op, key, value in ops_for(i):
                 t0 = time.perf_counter()
-                if j % 3 == 2:
-                    yield from ck.get(f"k{i}-{j % 5}")
+                if op == "Get":
+                    yield from ck.get(key)
                 else:
-                    yield from ck.put(f"k{i}-{j % 5}", f"v{j}")
+                    yield from ck.put(key, value)
                 lat_acc.append(time.perf_counter() - t0)
 
+        def framed_driver(i):
+            ck = PipelinedClerk(sched, end)
+            ops = ops_for(i)
+            for s in range(0, len(ops), frame):
+                t0 = time.perf_counter()
+                yield from ck.run_batch(ops[s:s + frame])
+                # Frame latency covers every op in it.
+                lat_acc.append(time.perf_counter() - t0)
+
+        driver = framed_driver if frame else clerk_driver
         t0 = time.perf_counter()
-        futs = [sched.spawn(clerk_driver(i)) for i in range(n_clerks)]
+        futs = [sched.spawn(driver(i)) for i in range(n_clerks)]
         for f in futs:
             assert sched.wait(f, 600.0) is not TIMEOUT
         elapsed = time.perf_counter() - t0
@@ -62,6 +97,7 @@ def bench(n_clerks: int = 16, ops_per_clerk: int = 50) -> dict:
         return {
             "clerks": n_clerks,
             "ops": total,
+            "frame": frame,
             "ops_per_sec": round(total / elapsed, 1),
             "mean_latency_ms": round(
                 1e3 * sum(lat_acc) / max(1, len(lat_acc)), 2
@@ -76,7 +112,18 @@ def bench(n_clerks: int = 16, ops_per_clerk: int = 50) -> dict:
 def main(argv) -> None:
     n_clerks = int(argv[1]) if len(argv) > 1 else 16
     ops = int(argv[2]) if len(argv) > 2 else 50
-    print(json.dumps(bench(n_clerks, ops)), flush=True)
+    frame = int(argv[3]) if len(argv) > 3 else 64
+    per_op = bench(n_clerks, ops, frame=0)
+    framed = bench(n_clerks, ops, frame=frame)
+    print(
+        json.dumps({
+            **per_op,
+            "framed_ops_per_sec": framed["ops_per_sec"],
+            "framed_mean_latency_ms": framed["mean_latency_ms"],
+            "frame": frame,
+        }),
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

@@ -9,10 +9,18 @@ hardware).  This rig measures the same serial request/reply loop on:
   * ``native`` — the C++ epoll transport over real loopback sockets,
                  which the reference has no equivalent of
 
+A third line reports the HOST FLOOR: ``loopback_floor.c`` (raw C TCP
+ping-pong between two threads, no Python, no codec) is the kernel
+syscall + scheduler-wake cost any userspace RPC on this box pays per
+serial round trip — the native path's µs/RPC is judged against it
+(whatever sits above the floor is the framework's own codec/dispatch
+overhead, the part we can optimize).
+
 Usage::
 
-    python -m benchmarks.transport_echo            # both, JSON lines
+    python -m benchmarks.transport_echo            # all, JSON lines
     python -m benchmarks.transport_echo native     # one path
+    python -m benchmarks.transport_echo floor      # C floor only
 
 Each line: {"path": ..., "n": ..., "us_per_rpc": ..., "vs_ref_22us": ...}
 """
@@ -97,6 +105,35 @@ def bench_native(n: int = 20_000) -> float:
         server.close()
 
 
+def bench_floor(n: int = 20_000):
+    """Build + run the raw C loopback ping-pong (loopback_floor.c);
+    returns (min_us, median_us) per RTT, or None when no C compiler is
+    available (the floor line is then skipped)."""
+    import os
+    import subprocess
+    import tempfile
+
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "loopback_floor.c")
+    exe = os.path.join(tempfile.gettempdir(), "mrt_loopback_floor")
+    try:
+        if not os.path.exists(exe) or (
+            os.path.getmtime(exe) < os.path.getmtime(src)
+        ):
+            subprocess.run(
+                ["cc", "-O2", "-o", exe, src, "-lpthread"],
+                check=True, capture_output=True,
+            )
+        out = subprocess.run(
+            [exe, str(n)], check=True, capture_output=True, text=True,
+            timeout=120,
+        ).stdout
+        blob = json.loads(out)
+        return blob["us_per_rtt"], blob["us_per_rtt_median"]
+    except Exception:
+        return None
+
+
 def main(argv: list[str]) -> None:
     which = argv[1] if len(argv) > 1 else "both"
     runs = []
@@ -104,8 +141,12 @@ def main(argv: list[str]) -> None:
         runs.append(("sim", 100_000, bench_sim))
     if which in ("native", "both"):
         runs.append(("native", 20_000, bench_native))
+    if which in ("floor", "both"):
+        runs.append(("loopback_floor_c", 20_000, bench_floor))
     for name, n, fn in runs:
         out = fn(n)
+        if out is None:
+            continue  # no C toolchain: skip the floor line
         lo, med = out if isinstance(out, tuple) else (out, out)
         print(
             json.dumps(

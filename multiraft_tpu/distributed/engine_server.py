@@ -50,6 +50,7 @@ __all__ = [
     "EngineKVService",
     "EngineShardKVService",
     "EngineClerk",
+    "PipelinedClerk",
     "EngineShardNetClerk",
     "EngineFleetClerk",
     "serve_engine_kv",
@@ -299,6 +300,105 @@ class EngineKVService:
             if rounds > max_rounds:
                 raise RuntimeError("WAL replay did not converge")
         return len(recs)
+
+    # Largest multi-op frame one RPC may carry (bounds the per-pump
+    # submit burst a single frame can impose).
+    MAX_BATCH = 1024
+
+    def batch(self, args_list):
+        """Multi-op frame: one codec envelope carries a clerk's whole
+        pipelined batch, applied in one pump (BENCHMARKS' named fix for
+        the per-op RPC overhead dominating the serving path).  Writes
+        are all submitted up front — they coalesce into the next device
+        step together; Gets answer from the applied frontier after the
+        frame's writes resolve, so a pipelined read sees its own
+        frame's preceding writes.  Per-client order within the frame is
+        preserved on resubmit (failures retry as an order-preserving
+        subset; sessions are per group, so cross-group interleaving
+        cannot trip dedup)."""
+        if len(args_list) > self.MAX_BATCH:
+            return [
+                EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
+            ] * len(args_list)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            replies = [None] * len(args_list)
+            # STRICTLY one in-flight write per (client, group) — the
+            # same discipline as replay_wal: submitting a client's cmd
+            # N and N+1 to one group concurrently lets an eviction
+            # commit N+1 first, after which the resubmitted N is
+            # dedup-swallowed and its acked mutation silently lost.
+            # Writes to DIFFERENT groups pipeline freely (sessions are
+            # per group).
+            queues: dict = {}
+            for i, a in enumerate(args_list):
+                if a.op != "Get":
+                    key = (a.client_id, route_group(a.key, self.G))
+                    queues.setdefault(key, []).append((i, a))
+            tickets: dict = {}  # frame index -> resolved-OK ticket
+            heads: dict = {}    # (client, group) -> (i, ticket)
+            while queues and self.sched.now < deadline:
+                for qk in list(queues):
+                    if qk not in heads:
+                        i, a = queues[qk][0]
+                        heads[qk] = (i, self.kv.submit(
+                            qk[1],
+                            KVOp(op=_OPCODE[a.op], key=a.key,
+                                 value=a.value, client_id=a.client_id,
+                                 command_id=a.command_id),
+                        ))
+                progressed = False
+                for qk, (i, t) in list(heads.items()):
+                    if not t.done:
+                        continue
+                    if t.failed:
+                        del heads[qk]  # resubmit next round, same ids
+                        continue
+                    tickets[i] = t
+                    queues[qk].pop(0)
+                    del heads[qk]
+                    if not queues[qk]:
+                        del queues[qk]
+                    progressed = True
+                if queues and not progressed:
+                    yield 0.002
+            # Durable mode: one group fsync covers the whole frame —
+            # a write acks OK only once its apply-time WAL record is
+            # synced (like command(); an unsynced write at the
+            # deadline answers ErrTimeout, never a false durable ack).
+            synced_ok = set(tickets)
+            while self._dur is not None:
+                pending = [
+                    i for i in synced_ok
+                    if (s := self._write_seqs.get(
+                        (args_list[i].client_id,
+                         args_list[i].command_id)
+                    )) is not None and not self._dur.synced(s)
+                ]
+                if not pending:
+                    break
+                if self.sched.now >= deadline:
+                    synced_ok -= set(pending)
+                    break
+                yield 0.002
+            for i, a in enumerate(args_list):
+                if a.op == "Get":
+                    replies[i] = EngineCmdReply(
+                        err=OK,
+                        value=self.kv.get(
+                            route_group(a.key, self.G), a.key
+                        ).value,
+                    )
+                else:
+                    ok = i in synced_ok
+                    replies[i] = EngineCmdReply(
+                        err=OK if ok else ERR_TIMEOUT,
+                        value=tickets[i].value if ok else "",
+                    )
+            return replies
+
+        return run()
 
     def command(self, args: EngineCmdArgs):
         g = route_group(args.key, self.G)
@@ -919,6 +1019,56 @@ class EngineClerk:
 
     def append(self, key: str, value: str):
         return self._command("Append", key, value)
+
+
+class PipelinedClerk(EngineClerk):
+    """Clerk that ships a whole batch of ops as ONE ``batch`` frame —
+    the reference clerk's serial loop (kvraft/client.go:47-71) widened
+    for the engine's coalescing front door: the server applies the
+    frame in one pump, so per-op RPC overhead amortizes ~frame-fold.
+    Whole-frame retry is dedup-safe (same client/command ids)."""
+
+    # Mirror of EngineKVService.MAX_BATCH: oversized op lists split
+    # into compliant frames client-side (the server's rejection is
+    # permanent, so retrying an oversized frame would spin forever).
+    MAX_FRAME = 1024
+
+    def run_batch(self, ops):
+        """ops = [(op, key, value), ...] → list of values (Gets) in
+        order.  Generator (spawn on the scheduler)."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_frame(ops[s:s + self.MAX_FRAME])
+            out.extend(part)
+        return out
+
+    def _one_frame(self, ops):
+        frame = []
+        for op, key, value in ops:
+            if op != "Get":
+                self.command_id += 1
+            frame.append(
+                EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=self.client_id,
+                    command_id=self.command_id,
+                )
+            )
+        while True:
+            fut: Future = self.end.call(f"{self.service}.batch", frame)
+            reply = yield self.sched.with_timeout(fut, 10.0)
+            if reply is not None and reply is not TIMEOUT and any(
+                r.err.startswith("ErrBatchTooLarge") for r in reply
+            ):
+                # Permanent: the server's cap shrank below ours.
+                raise ValueError(reply[0].err)
+            if (
+                reply is None
+                or reply is TIMEOUT
+                or any(r.err != OK for r in reply)
+            ):
+                continue  # lost/partial frame: retry whole (dedup-safe)
+            return [r.value for r in reply]
 
 
 class EngineShardNetClerk(EngineClerk):

@@ -882,6 +882,34 @@ tick = functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))(
     tick_impl
 )
 
+# Per-tick record fields the traced bench loop stacks (i32[n_ticks, G]
+# each): the ingest/commit frontiers and accept terms from which the
+# bench reconstructs per-entry commit latency (measured, not modeled)
+# and per-sampled-group operation histories for porcupine.
+TRACE_KEYS = ("ing_hi", "accepted", "accept_term", "commit")
+
+
+def make_traced_body(cfg: EngineConfig, new_cmds: jnp.ndarray, key: jax.Array):
+    """The traced scan body shared by :func:`run_ticks_traced` and the
+    mesh variant (engine/mesh.py) — one place derives the TRACE_KEYS
+    record from the tick metrics, so the two bench paths can never
+    desynchronize."""
+
+    def body(carry, i):
+        st, mb = carry
+        st, mb, m = tick_impl(cfg, st, mb, new_cmds, jax.random.fold_in(key, i))
+        rec = {
+            # Last index after this tick's ingest at the accepting
+            # leader; 0 on no-accept ticks (host takes a running max).
+            "ing_hi": m["start_index"] + m["accepted"],
+            "accepted": m["accepted"],
+            "accept_term": m["accept_term"],
+            "commit": m["commit_index"],
+        }
+        return (st, mb), rec
+
+    return body
+
 
 @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(1, 2))
 def run_ticks(
@@ -911,3 +939,33 @@ def run_ticks(
         body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
     )
     return state, inbox
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(1, 2))
+def run_ticks_traced(
+    cfg: EngineConfig,
+    state: EngineState,
+    inbox: Mailbox,
+    n_ticks: int,
+    ingest_per_tick: int,
+    key: jax.Array,
+) -> Tuple[EngineState, Mailbox, Dict[str, jnp.ndarray]]:
+    """:func:`run_ticks` plus a per-tick record of the per-group
+    ingest/commit frontiers and accept terms (``TRACE_KEYS``, each
+    i32[n_ticks, G]) — the raw material for the bench's MEASURED
+    commit-latency distribution and its porcupine verification of
+    sampled groups (reconstructing each sampled group's operation
+    history from what the device actually did, kvraft-style post-hoc
+    checking of the flagship run; reference: kvraft test harness
+    porcupine pass over the real op history).
+
+    Still device-resident and scan-fused: the records are four [G]
+    vectors appended to HBM per tick — noise against the tick's own
+    traffic (the bench gates on <=2% throughput cost vs the untraced
+    loop)."""
+    new_cmds = jnp.full((cfg.G,), ingest_per_tick, jnp.int32)
+    body = make_traced_body(cfg, new_cmds, key)
+    (state, inbox), rec = jax.lax.scan(
+        body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
+    )
+    return state, inbox, rec

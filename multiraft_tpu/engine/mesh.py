@@ -34,7 +34,14 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .core import METRIC_KEYS, EngineConfig, EngineState, Mailbox, tick_impl
+from .core import (
+    METRIC_KEYS,
+    TRACE_KEYS,
+    EngineConfig,
+    EngineState,
+    Mailbox,
+    tick_impl,
+)
 
 __all__ = [
     "group_pspec",
@@ -150,6 +157,43 @@ def make_sharded_run_ticks(
             mesh=mesh,
             in_specs=(state_specs, inbox_specs, P()),
             out_specs=(state_specs, inbox_specs),
+        )
+    )
+
+
+def make_sharded_run_ticks_traced(
+    cfg: EngineConfig, mesh: Mesh, n_ticks: int, ingest_per_tick: int
+):
+    """``make_sharded_run_ticks`` + the per-tick trace records of
+    ``core.run_ticks_traced`` (frontiers/accept terms, [n_ticks, G]
+    sharded on the groups axis) — the bench's verified mode on a mesh,
+    same zero-collective recipe."""
+    lcfg = _local_cfg(cfg, mesh)
+
+    def local_run(state, inbox, key):
+        from .core import make_traced_body
+
+        new_cmds = jnp.full((lcfg.G,), ingest_per_tick, jnp.int32)
+        body = make_traced_body(lcfg, new_cmds, key)
+        (state, inbox), rec = jax.lax.scan(
+            body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
+        )
+        return state, inbox, rec
+
+    state_specs = EngineState(
+        **{
+            f: (P() if f == "tick_no" else P("groups"))
+            for f in EngineState._fields
+        }
+    )
+    inbox_specs = Mailbox(**{f: P("groups") for f in Mailbox._fields})
+    rec_specs = {k: P(None, "groups") for k in TRACE_KEYS}
+    return jax.jit(
+        shard_map(
+            local_run,
+            mesh=mesh,
+            in_specs=(state_specs, inbox_specs, P()),
+            out_specs=(state_specs, inbox_specs, rec_specs),
         )
     )
 

@@ -365,8 +365,9 @@ def check_operations(
     the result is UNKNOWN (the reference's convention, treated by the
     test suite as "probably fine, too expensive to prove",
     kvraft/test_test.go:379-381).  ``parallel`` forces the process-pool
-    path on/off (default: auto — pools kick in at
-    ≥8 partitions on multi-core hosts)."""
+    path on/off (default: auto — pools kick in for ≥2 partitions once
+    the op counts clear the thresholds in ``_check_parallel``, on
+    fork-safe multi-core hosts)."""
     deadline = _time.monotonic() + timeout if timeout is not None else None
     verdict, _, _ = _check_partitions(
         model, model.partitions(history), deadline, False, parallel

@@ -717,6 +717,102 @@ def test_engine_fleet_cross_process_migration():
 
 
 @needs_native
+def test_engine_kv_batch_frames(tmp_path):
+    """Multi-op frames: one ``batch`` RPC carries a clerk's pipelined
+    ops, the server applies them in one pump, Gets inside the frame see
+    the frame's preceding writes, and re-sending a frame (the clerk's
+    whole-frame retry) stays exactly-once through session dedup."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.distributed.engine_server import PipelinedClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=16, seed=5)
+    cli = None
+    try:
+        cluster.start()
+        cli = RpcNode()
+        sched = cli.sched
+        end = cli.client_end(cluster.host, cluster.port)
+        ck = PipelinedClerk(sched, end)
+
+        ops = []
+        for i in range(20):
+            ops.append(("Append", f"bk{i % 4}", f"[{i}]"))
+        ops.append(("Get", "bk0", ""))
+
+        vals = sched.wait(sched.spawn(ck.run_batch(ops)), 60.0)
+        assert vals is not TIMEOUT
+        # The in-frame Get sees the frame's own appends to bk0.
+        assert vals[-1] == "[0][4][8][12][16]"
+
+        frame2 = sched.wait(
+            sched.spawn(ck.run_batch([("Get", "bk1", "")])), 60.0
+        )
+
+        # Whole-frame retry (same client/command ids) must not
+        # double-apply: re-run the first frame with the SAME ids by
+        # rolling the session counter back.
+        ck.command_id -= sum(1 for op, *_ in ops if op != "Get")
+        vals2 = sched.wait(sched.spawn(ck.run_batch(ops)), 60.0)
+        assert vals2 is not TIMEOUT
+        assert vals2[-1] == "[0][4][8][12][16]", (
+            "duplicate frame double-applied appends"
+        )
+        assert frame2 == ["[1][5][9][13][17]"]
+    finally:
+        if cli is not None:
+            cli.close()
+        cluster.shutdown()
+
+
+@needs_native
+def test_engine_kv_batch_frames_durable(tmp_path):
+    """Framed writes on the durable server: the frame ack gates on the
+    group fsync; kill -9 + restart recovers every framed write."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.distributed.engine_server import PipelinedClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=16, seed=6,
+        data_dir=str(tmp_path / "framed"), checkpoint_every_s=3600.0,
+    )
+    cli = None
+    try:
+        cluster.start()
+        cli = RpcNode()
+        sched = cli.sched
+        end = cli.client_end(cluster.host, cluster.port)
+        ck = PipelinedClerk(sched, end)
+        ops = [("Append", f"dk{i % 3}", f"[{i}]") for i in range(12)]
+        assert sched.wait(sched.spawn(ck.run_batch(ops)), 60.0) is not TIMEOUT
+        cli.close()
+        cli = None
+
+        cluster.kill()
+        cluster.start()  # WAL replay (no checkpoint taken)
+
+        cli = RpcNode()
+        end = cli.client_end(cluster.host, cluster.port)
+        ck2 = PipelinedClerk(cli.sched, end)
+        vals = cli.sched.wait(
+            cli.sched.spawn(ck2.run_batch(
+                [("Get", "dk0", ""), ("Get", "dk1", ""), ("Get", "dk2", "")]
+            )),
+            60.0,
+        )
+        assert vals == ["[0][3][6][9]", "[1][4][7][10]", "[2][5][8][11]"], (
+            f"framed writes lost across kill -9: {vals}"
+        )
+    finally:
+        if cli is not None:
+            cli.close()
+        cluster.shutdown()
+
+
+@needs_native
 def test_engine_kv_durable_restart(tmp_path):
     """kill -9 a DURABLE engine KV server; restart on the same data_dir:
     every acknowledged write survives — some via the checkpoint, the
