@@ -23,8 +23,10 @@ the verdict to UNKNOWN.
 The linearized set is a Python int bitmask (arbitrary width — the
 bitset.go equivalent); a C++ fast path for the DFS lives in
 ``multiraft_tpu/porcupine/native`` with this implementation as fallback
-and oracle (verbose mode always uses the Python DFS — the native path
-returns verdicts only).
+and oracle.  Both plain and VERBOSE checks ride the native path for
+the KV model (the C++ pass computes verdict and computePartial
+evidence together, like the reference's one DFS); the Python DFS runs
+when the toolchain is unavailable or a model supplies no native hooks.
 """
 
 from __future__ import annotations
@@ -233,12 +235,15 @@ def _worker(
     idx, model, part, remaining, compute_partial = args
     deadline = _time.monotonic() + remaining if remaining is not None else None
     res = None
-    if model.native_check is not None and not compute_partial:
+    partials: List[List[int]] = []
+    if compute_partial and model.native_check_verbose is not None:
+        out = model.native_check_verbose(part, deadline)
+        if out is not None:
+            res, partials = out
+    elif model.native_check is not None and not compute_partial:
         res = model.native_check(part, deadline)
     if res is None:
         res, partials = _check_single(model, part, deadline, compute_partial)
-    else:
-        partials = []
     return idx, res, partials
 
 

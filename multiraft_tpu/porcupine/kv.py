@@ -79,26 +79,23 @@ def _describe(inp: KvInput, out: KvOutput) -> str:
 _NATIVE_STEPS_PER_SEC = 20_000_000
 
 
-def _native_check(part: List[Operation], deadline=None):
-    """C++ DFS fast path for one per-key partition (falls back to the
-    Python DFS on None).  The step budget is derived from the remaining
-    wall-clock deadline — unlimited when no timeout was requested, so an
-    ILLEGAL verdict can never be masked as UNKNOWN by an arbitrary
-    budget."""
+def _native_args(part: List[Operation], deadline):
+    """Shared marshalling for the plain/verbose native entry points:
+    the interleaved event order + parallel op arrays + step budget.
+    Returns None when the deadline already expired, or "malformed"
+    for a ret-before-call history — the caller falls back to the
+    Python DFS, whose entry builder raises the proper ValueError
+    (the C++ builder would dereference a missing call entry)."""
     import time as _time
 
-    from .checker import CheckResult  # local import to avoid a cycle
-    from .native import check_kv_partition_native
-
-    n = len(part)
-    if n == 0 or n > 62:
-        return None
+    if any(op.ret < op.call for op in part):
+        return "malformed"
     if deadline is None:
         max_steps = 0  # unlimited: exhaustive, like the Python DFS
     else:
         remaining = deadline - _time.monotonic()
         if remaining <= 0:
-            return CheckResult.UNKNOWN
+            return None
         max_steps = int(remaining * _NATIVE_STEPS_PER_SEC)
     events = []
     for i, op in enumerate(part):
@@ -109,10 +106,64 @@ def _native_check(part: List[Operation], deadline=None):
     kinds = [op.input.op for op in part]
     values = [op.input.value for op in part]
     outputs = [op.output.value for op in part]
-    rc = check_kv_partition_native(ev, kinds, values, outputs, max_steps=max_steps)
-    if rc is None or rc == 3:
+    return ev, kinds, values, outputs, max_steps
+
+
+def _rc_result(rc):
+    from .checker import CheckResult
+
+    return {0: CheckResult.ILLEGAL, 1: CheckResult.OK,
+            2: CheckResult.UNKNOWN}[rc]
+
+
+def _native_check(part: List[Operation], deadline=None):
+    """C++ DFS fast path for one per-key partition (falls back to the
+    Python DFS on None).  The step budget is derived from the remaining
+    wall-clock deadline — unlimited when no timeout was requested, so an
+    ILLEGAL verdict can never be masked as UNKNOWN by an arbitrary
+    budget.  No partition-size cap: the native memo is hash-based, not
+    a fixed-width bitset."""
+    from .checker import CheckResult  # local import to avoid a cycle
+    from .native import check_kv_partition_native
+
+    if len(part) == 0:
         return None
-    return {0: CheckResult.ILLEGAL, 1: CheckResult.OK, 2: CheckResult.UNKNOWN}[rc]
+    args = _native_args(part, deadline)
+    if args == "malformed":
+        return None  # Python DFS raises the proper ValueError
+    if args is None:
+        return CheckResult.UNKNOWN
+    ev, kinds, values, outputs, max_steps = args
+    rc = check_kv_partition_native(ev, kinds, values, outputs, max_steps=max_steps)
+    if rc is None:
+        return None
+    return _rc_result(rc)
+
+
+def _native_check_verbose(part: List[Operation], deadline=None):
+    """Verbose C++ fast path: ``(verdict, partials)`` with the
+    reference's computePartial output (porcupine/checker.go:219-234) —
+    so a large FAILING history debugs at the same speed the plain
+    check caught it (round-2 verdict: the evidence pass must not be
+    orders slower than the checking pass)."""
+    from .checker import CheckResult
+    from .native import check_kv_partition_native_verbose
+
+    if len(part) == 0:
+        return None
+    args = _native_args(part, deadline)
+    if args == "malformed":
+        return None
+    if args is None:
+        return CheckResult.UNKNOWN, []
+    ev, kinds, values, outputs, max_steps = args
+    out = check_kv_partition_native_verbose(
+        ev, kinds, values, outputs, max_steps=max_steps
+    )
+    if out is None:
+        return None
+    rc, partials = out
+    return _rc_result(rc), partials
 
 
 kv_model = Model(
@@ -121,8 +172,11 @@ kv_model = Model(
     partition=_partition,
     describe_operation=_describe,
     native_check=_native_check,
+    native_check_verbose=_native_check_verbose,
 )
 
 # Pure-Python variant (oracle for differential tests of the native DFS);
 # derived from kv_model so the two can never drift apart.
-kv_model_py = dataclasses.replace(kv_model, native_check=None)
+kv_model_py = dataclasses.replace(
+    kv_model, native_check=None, native_check_verbose=None
+)

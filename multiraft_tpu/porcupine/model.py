@@ -56,6 +56,14 @@ class Model:
     # time.monotonic() instant or None for unbounded.  Used by the KV
     # model's C++ checker (porcupine/native).
     native_check: Optional[Callable[[List[Operation], Optional[float]], Any]] = None
+    # Verbose twin: fn(partition, deadline) -> (CheckResult, partials)
+    # | None — same DFS, additionally returning the computePartial
+    # evidence (op-id sequences) so check_operations_verbose runs at
+    # native speed too (reference: porcupine/checker.go:179-253, one
+    # pass computes both).
+    native_check_verbose: Optional[
+        Callable[[List[Operation], Optional[float]], Any]
+    ] = None
 
     def partitions(self, history: List[Operation]) -> List[List[Operation]]:
         if self.partition is None:

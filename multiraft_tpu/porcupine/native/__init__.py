@@ -2,16 +2,19 @@
 
 Builds ``libporcupine.so`` from ``checker.cpp`` on first use (g++ -O2;
 no pybind11 in this image — plain C ABI + ctypes) and exposes
-:func:`check_kv_partition_native`.  Falls back to the Python DFS when
-the toolchain is unavailable or the partition exceeds the native
-bitset width (>62 ops).
+:func:`check_kv_partition_native` (verdict only) and
+:func:`check_kv_partition_native_verbose` (verdict + partial
+linearizations, the reference's computePartial).  Falls back to the
+Python DFS when the toolchain is unavailable.  Partition size is
+unbounded — the C++ DFS memoizes through a 128-bit hash, not a
+fixed-width bitset.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from ...utils.native_build import build_and_load
 
@@ -21,6 +24,18 @@ _SO = os.path.join(_HERE, "libporcupine.so")
 
 _lib = None
 _build_failed = False
+
+_COMMON_ARGS = [
+    ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.c_int64,
+]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -32,17 +47,14 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = build_and_load(_SRC, _SO)
         lib.check_kv_partition.restype = ctypes.c_int
-        lib.check_kv_partition.argtypes = [
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_uint8),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
+        lib.check_kv_partition.argtypes = list(_COMMON_ARGS)
+        lib.check_kv_partition_verbose.restype = ctypes.c_int
+        lib.check_kv_partition_verbose.argtypes = list(_COMMON_ARGS) + [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.mrt_buf_free.restype = None
+        lib.mrt_buf_free.argtypes = [ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
         return lib
     except Exception:
@@ -54,17 +66,8 @@ def native_available() -> bool:
     return _load() is not None
 
 
-def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps=0):
-    """Run the C++ DFS on one pre-sorted partition.
-
-    events: list of (op_id, is_return) in time order.
-    Returns 1 linearizable / 0 illegal / 2 budget exhausted / None if
-    native path unavailable (caller falls back to Python).
-    """
-    lib = _load()
+def _marshal(events, op_kinds, op_values, op_outputs):
     n = len(op_kinds)
-    if lib is None or n > 62:
-        return None
     ev_op = (ctypes.c_int32 * len(events))(*[e[0] for e in events])
     ev_ret = (ctypes.c_uint8 * len(events))(*[1 if e[1] else 0 for e in events])
     kinds = (ctypes.c_int32 * n)(*op_kinds)
@@ -74,14 +77,56 @@ def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps
     out_ptrs = (ctypes.c_char_p * n)(*outs)
     val_lens = (ctypes.c_int32 * n)(*[len(v) for v in vals])
     out_lens = (ctypes.c_int32 * n)(*[len(o) for o in outs])
-    return lib.check_kv_partition(
-        n,
-        ev_op,
-        ev_ret,
-        kinds,
-        ctypes.cast(val_ptrs, ctypes.POINTER(ctypes.c_char_p)),
-        val_lens,
-        ctypes.cast(out_ptrs, ctypes.POINTER(ctypes.c_char_p)),
-        out_lens,
-        max_steps,
+    # Keep the bytes objects alive until the call returns.
+    keepalive = (vals, outs)
+    return (
+        n, ev_op, ev_ret, kinds,
+        ctypes.cast(val_ptrs, ctypes.POINTER(ctypes.c_char_p)), val_lens,
+        ctypes.cast(out_ptrs, ctypes.POINTER(ctypes.c_char_p)), out_lens,
+    ), keepalive
+
+
+def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps=0):
+    """Run the C++ DFS on one pre-sorted partition.
+
+    events: list of (op_id, is_return) in time order.
+    Returns 1 linearizable / 0 illegal / 2 budget exhausted / None if
+    native path unavailable (caller falls back to Python).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    args, _keep = _marshal(events, op_kinds, op_values, op_outputs)
+    return lib.check_kv_partition(*args, max_steps)
+
+
+def check_kv_partition_native_verbose(
+    events, op_kinds, op_values, op_outputs, max_steps=0
+) -> Optional[Tuple[int, List[List[int]]]]:
+    """Verbose C++ DFS: returns ``(rc, partials)`` where partials is
+    the reference computePartial output — op-id sequences, the single
+    full linearization on OK, the distinct longest linearizable
+    prefixes otherwise.  None = native path unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    args, _keep = _marshal(events, op_kinds, op_values, op_outputs)
+    buf = ctypes.POINTER(ctypes.c_int32)()
+    buf_len = ctypes.c_int64(0)
+    rc = lib.check_kv_partition_verbose(
+        *args, max_steps, ctypes.byref(buf), ctypes.byref(buf_len)
     )
+    partials: List[List[int]] = []
+    if buf and buf_len.value > 0:
+        try:
+            flat = buf[: buf_len.value]
+            n_seqs = flat[0]
+            w = 1
+            for _ in range(n_seqs):
+                ln = flat[w]
+                w += 1
+                partials.append(list(flat[w: w + ln]))
+                w += ln
+        finally:
+            lib.mrt_buf_free(buf)
+    return rc, partials

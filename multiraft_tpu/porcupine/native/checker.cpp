@@ -3,17 +3,36 @@
 //
 // Same Wing–Gong/Lowe algorithm as the Python fallback
 // (multiraft_tpu/porcupine/checker.py; reference: porcupine/checker.go:
-// 140-253): doubly-linked entry list, lift/unlift, (linearized-bitset,
-// state) memo cache.  Specialised to the KV per-key partition model
-// (reference: models/kv.go:40-54) where a partition's automaton state is
-// just the key's current string value; the memo cache keys on
-// (bitset, value bytes).
+// 140-253): doubly-linked entry list, lift/unlift, (linearized-set,
+// state) memoization, and — in the verbose entry point — the
+// reference's computePartial (checker.go:219-234): the distinct
+// longest linearizable prefixes covering each operation, captured at
+// every backtrack, for the visualizer.
 //
-// Exposed via a tiny C ABI for ctypes (no pybind11 in this image):
-//   check_kv_partition(n, op_kinds, call_order, ret_order, outputs, ...)
-// Returns 1 = linearizable, 0 = not, 2 = step budget exhausted (UNKNOWN).
+// Specialised to the KV per-key partition model (reference:
+// models/kv.go:40-54) where a partition's automaton state is the
+// key's current string value.  Two representation choices make this
+// scale to 100k-op partitions where the generic formulation cannot:
+//
+//  * The PATH state is one growable byte buffer with per-frame undo
+//    (append saves a length; put saves the replaced value), so the
+//    current value is always exact — Get compares bytes, never a
+//    hash.
+//  * The MEMO stores a 128-bit hash of (linearized-set, value):
+//    a Zobrist hash over op-ids (one xor per step) mixed with an
+//    incrementally-maintained polynomial hash of the value.  Memory
+//    per memo entry is O(1) instead of O(|value|); a hash collision
+//    could only over-prune (flip a true OK to ILLEGAL) with
+//    probability ~2^-128 per explored pair — negligible against the
+//    machine's own soft-error rate, and the failure mode is loud
+//    (a spurious ILLEGAL gets investigated), never a silent pass.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image).
+// Return codes: 1 = linearizable, 0 = not, 2 = budget exhausted
+// (UNKNOWN).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <unordered_set>
@@ -34,10 +53,179 @@ constexpr int kGet = 0;
 constexpr int kPut = 1;
 constexpr int kAppend = 2;
 
-struct Frame {
-  Entry* call;
-  // Saved value-state: an index into the `states` vector (append-only).
-  int saved_state;
+constexpr uint64_t kP1 = 0x100000001b3ull;        // poly bases (odd)
+constexpr uint64_t kP2 = 0xda942042e4dd58b5ull;
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Key128 {
+  uint64_t a, b;
+  bool operator==(const Key128& o) const { return a == o.a && b == o.b; }
+};
+struct Key128Hash {
+  size_t operator()(const Key128& k) const {
+    return static_cast<size_t>(k.a ^ (k.b * 0x9e3779b97f4a7c15ull));
+  }
+};
+
+// Polynomial hash helpers over byte strings.
+inline void poly_absorb(uint64_t& h1, uint64_t& h2, const uint8_t* p,
+                        int32_t len) {
+  for (int32_t i = 0; i < len; i++) {
+    h1 = h1 * kP1 + p[i] + 1;
+    h2 = h2 * kP2 + p[i] + 1;
+  }
+}
+
+struct Checker {
+  int32_t n;
+  const int32_t* op_kind;
+  const uint8_t* const* op_value;
+  const int32_t* op_value_len;
+  const uint8_t* const* op_output;
+  const int32_t* op_output_len;
+
+  std::vector<Entry> pool;
+  Entry* head;
+
+  // Exact current value + per-frame undo.
+  std::string cur;
+  uint64_t vh1 = 0, vh2 = 0;  // incremental value hash
+  uint64_t zob = 0;           // Zobrist hash of the linearized set
+  std::vector<uint64_t> zkeys;
+
+  struct Frame {
+    Entry* call;
+    uint8_t kind;          // op kind (undo discriminator)
+    uint32_t old_len;      // append undo
+    std::string old_value; // put undo (the replaced value)
+    uint64_t old_vh1, old_vh2;
+  };
+  std::vector<Frame> stack;
+  std::unordered_set<Key128, Key128Hash> memo;
+
+  void build(const int32_t* ev_op, const uint8_t* ev_is_ret) {
+    const int64_t n_events = 2 * static_cast<int64_t>(n);
+    pool.resize(n_events + 1);
+    std::vector<Entry*> call_of(n, nullptr);
+    head = &pool[0];
+    head->op = -1;
+    head->is_return = false;
+    head->prev = nullptr;
+    Entry* tail = head;
+    for (int64_t i = 0; i < n_events; i++) {
+      Entry* e = &pool[i + 1];
+      e->op = ev_op[i];
+      e->is_return = ev_is_ret[i] != 0;
+      e->match = nullptr;
+      if (!e->is_return) {
+        call_of[e->op] = e;
+      } else {
+        call_of[e->op]->match = e;
+      }
+      tail->next = e;
+      e->prev = tail;
+      tail = e;
+    }
+    tail->next = nullptr;
+    zkeys.resize(n);
+    for (int32_t i = 0; i < n; i++) zkeys[i] = splitmix64(0xC0FFEE ^ i);
+    stack.reserve(n);
+  }
+
+  static void lift(Entry* call) {
+    Entry* ret = call->match;
+    call->prev->next = call->next;
+    if (call->next) call->next->prev = call->prev;
+    ret->prev->next = ret->next;
+    if (ret->next) ret->next->prev = ret->prev;
+  }
+  static void unlift(Entry* call) {
+    Entry* ret = call->match;
+    ret->prev->next = ret;
+    if (ret->next) ret->next->prev = ret;
+    call->prev->next = call;
+    if (call->next) call->next->prev = call;
+  }
+
+  // Try to linearize `op` next: returns whether the model step is
+  // legal, and (on true) fills the would-be post-state hash WITHOUT
+  // mutating, so the memo can be consulted first.
+  bool step_ok(int op, uint64_t& nvh1, uint64_t& nvh2) const {
+    switch (op_kind[op]) {
+      case kGet: {
+        const int32_t olen = op_output_len[op];
+        if (static_cast<size_t>(olen) != cur.size()) return false;
+        if (olen && std::memcmp(op_output[op], cur.data(), olen) != 0)
+          return false;
+        nvh1 = vh1;
+        nvh2 = vh2;
+        return true;
+      }
+      case kPut: {
+        nvh1 = 0;
+        nvh2 = 0;
+        poly_absorb(nvh1, nvh2, op_value[op], op_value_len[op]);
+        return true;
+      }
+      case kAppend: {
+        nvh1 = vh1;
+        nvh2 = vh2;
+        poly_absorb(nvh1, nvh2, op_value[op], op_value_len[op]);
+        return true;
+      }
+      default:
+        return false;
+    }
+  }
+
+  void apply(Entry* call, uint64_t nvh1, uint64_t nvh2) {
+    const int op = call->op;
+    Frame f;
+    f.call = call;
+    f.kind = static_cast<uint8_t>(op_kind[op]);
+    f.old_vh1 = vh1;
+    f.old_vh2 = vh2;
+    f.old_len = static_cast<uint32_t>(cur.size());
+    if (f.kind == kPut) {
+      f.old_value.swap(cur);
+      cur.assign(reinterpret_cast<const char*>(op_value[op]),
+                 op_value_len[op]);
+    } else if (f.kind == kAppend) {
+      cur.append(reinterpret_cast<const char*>(op_value[op]),
+                 op_value_len[op]);
+    }
+    vh1 = nvh1;
+    vh2 = nvh2;
+    zob ^= zkeys[op];
+    stack.push_back(std::move(f));
+    lift(call);
+  }
+
+  Entry* backtrack() {
+    Frame& f = stack.back();
+    if (f.kind == kPut) {
+      cur.swap(f.old_value);
+    } else if (f.kind == kAppend) {
+      cur.resize(f.old_len);
+    }
+    vh1 = f.old_vh1;
+    vh2 = f.old_vh2;
+    zob ^= zkeys[f.call->op];
+    unlift(f.call);
+    Entry* resume = f.call->next;
+    stack.pop_back();
+    return resume;
+  }
+
+  Key128 memo_key(uint64_t nzob, uint64_t nvh1, uint64_t nvh2) const {
+    return Key128{splitmix64(nzob ^ nvh1), splitmix64(nzob * kP2 ^ nvh2)};
+  }
 };
 
 }  // namespace
@@ -51,6 +239,132 @@ extern "C" {
 // op_kind[j], op_value/op_value_len [j] — the put/append argument utf-8
 // op_output/op_output_len [j]           — get's observed value
 // max_steps — DFS step budget (0 = unlimited)
+//
+// Verbose form additionally returns the partial linearizations
+// (reference computePartial): *out_buf = int32 array
+// [n_seqs, len_0, ops_0..., len_1, ops_1...] (caller frees via
+// mrt_buf_free).  On OK the single full linearization is returned.
+static int check_impl(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    const int32_t* op_kind,
+    const uint8_t* const* op_value,
+    const int32_t* op_value_len,
+    const uint8_t* const* op_output,
+    const int32_t* op_output_len,
+    int64_t max_steps,
+    bool compute_partial,
+    int32_t** out_buf,
+    int64_t* out_len) {
+  if (out_buf) {
+    *out_buf = nullptr;
+    *out_len = 0;
+  }
+  if (n == 0) return 1;
+
+  Checker c;
+  c.n = n;
+  c.op_kind = op_kind;
+  c.op_value = op_value;
+  c.op_value_len = op_value_len;
+  c.op_output = op_output;
+  c.op_output_len = op_output_len;
+  c.build(ev_op, ev_is_ret);
+
+  // computePartial bookkeeping: longest[op] = index into `seqs` of the
+  // longest linearizable prefix covering op (shared, lazily
+  // materialized per backtrack — the reference's lazy-seq trick).
+  std::vector<int32_t> longest;
+  std::vector<std::vector<int32_t>> seqs;
+  if (compute_partial) longest.assign(n, -1);
+
+  Entry* entry = c.head->next;
+  int64_t steps = 0;
+  int verdict = -1;
+  while (c.head->next != nullptr) {
+    if (max_steps > 0 && ++steps > max_steps) {
+      verdict = 2;
+      break;
+    }
+    if (!entry->is_return) {
+      uint64_t nvh1, nvh2;
+      bool advanced = false;
+      if (c.step_ok(entry->op, nvh1, nvh2)) {
+        const uint64_t nzob = c.zob ^ c.zkeys[entry->op];
+        if (c.memo.insert(c.memo_key(nzob, nvh1, nvh2)).second) {
+          c.apply(entry, nvh1, nvh2);
+          entry = c.head->next;
+          advanced = true;
+        }
+      }
+      if (!advanced) entry = entry->next;
+    } else {
+      if (c.stack.empty()) {
+        verdict = 0;
+        break;
+      }
+      if (compute_partial) {
+        int32_t seq_idx = -1;
+        const size_t depth = c.stack.size();
+        for (const auto& f : c.stack) {
+          const int op = f.call->op;
+          if (longest[op] < 0 ||
+              seqs[longest[op]].size() < depth) {
+            if (seq_idx < 0) {
+              std::vector<int32_t> s;
+              s.reserve(depth);
+              for (const auto& g : c.stack) s.push_back(g.call->op);
+              seqs.push_back(std::move(s));
+              seq_idx = static_cast<int32_t>(seqs.size()) - 1;
+            }
+            longest[op] = seq_idx;
+          }
+        }
+      }
+      entry = c.backtrack();
+    }
+  }
+  if (verdict < 0) verdict = 1;
+
+  if (compute_partial && out_buf) {
+    std::vector<int32_t> full;
+    std::vector<const std::vector<int32_t>*> outs;
+    if (verdict == 1) {
+      // Full linearization from the final stack.
+      for (const auto& f : c.stack) full.push_back(f.call->op);
+      outs.push_back(&full);
+    } else {
+      // Identity-distinct longest prefixes, emitted in
+      // FIRST-REFERENCING-OP order — exactly the Python oracle's
+      // dedup (`for seq in longest: uniq[id(seq)] = seq`, insertion-
+      // ordered), so native and fallback produce identical evidence.
+      std::vector<char> emitted(seqs.size(), 0);
+      for (int32_t i = 0; i < n; i++) {
+        const int32_t s = longest[i];
+        if (s >= 0 && !emitted[s]) {
+          emitted[s] = 1;
+          outs.push_back(&seqs[s]);
+        }
+      }
+    }
+    int64_t total = 1;
+    for (const auto* s : outs) total += 1 + static_cast<int64_t>(s->size());
+    int32_t* buf =
+        static_cast<int32_t*>(std::malloc(total * sizeof(int32_t)));
+    if (buf == nullptr) return verdict;  // partials dropped, verdict kept
+    int64_t w = 0;
+    buf[w++] = static_cast<int32_t>(outs.size());
+    for (const auto* s : outs) {
+      buf[w++] = static_cast<int32_t>(s->size());
+      for (int32_t v : *s) buf[w++] = v;
+    }
+    *out_buf = buf;
+    *out_len = w;
+  }
+  return verdict;
+}
+
 int check_kv_partition(
     int32_t n,
     const int32_t* ev_op,
@@ -61,130 +375,28 @@ int check_kv_partition(
     const uint8_t* const* op_output,
     const int32_t* op_output_len,
     int64_t max_steps) {
-  if (n == 0) return 1;
-  if (n > 62) {
-    // Bitset is a uint64 here; larger partitions fall back to Python.
-    return 3;
-  }
-  const int64_t n_events = 2 * static_cast<int64_t>(n);
-
-  // Build the linked list.
-  std::vector<Entry> pool(n_events + 1);
-  std::vector<Entry*> call_of(n, nullptr);
-  Entry* head = &pool[0];
-  head->op = -1;
-  head->is_return = false;
-  head->prev = nullptr;
-  Entry* tail = head;
-  for (int64_t i = 0; i < n_events; i++) {
-    Entry* e = &pool[i + 1];
-    e->op = ev_op[i];
-    e->is_return = ev_is_ret[i] != 0;
-    e->match = nullptr;
-    if (!e->is_return) {
-      call_of[e->op] = e;
-    } else {
-      call_of[e->op]->match = e;
-    }
-    tail->next = e;
-    e->prev = tail;
-    tail = e;
-  }
-  tail->next = nullptr;
-
-  auto lift = [](Entry* call) {
-    Entry* ret = call->match;
-    call->prev->next = call->next;
-    if (call->next) call->next->prev = call->prev;
-    ret->prev->next = ret->next;
-    if (ret->next) ret->next->prev = ret->prev;
-  };
-  auto unlift = [](Entry* call) {
-    Entry* ret = call->match;
-    ret->prev->next = ret;
-    if (ret->next) ret->next->prev = ret;
-    call->prev->next = call;
-    if (call->next) call->next->prev = call;
-  };
-
-  auto value_of = [&](int op) {
-    return std::string(reinterpret_cast<const char*>(op_value[op]),
-                       op_value_len[op]);
-  };
-  auto output_of = [&](int op) {
-    return std::string(reinterpret_cast<const char*>(op_output[op]),
-                       op_output_len[op]);
-  };
-
-  // step: returns {ok, new_state} given current value (by index).
-  std::vector<std::string> states;
-  states.emplace_back("");  // initial value
-  int cur_state = 0;
-
-  uint64_t linearized = 0;
-  std::unordered_set<std::string> cache;
-  std::vector<Frame> stack;
-  stack.reserve(n);
-
-  auto cache_key = [&](uint64_t mask, const std::string& val) {
-    std::string k;
-    k.reserve(8 + val.size());
-    k.append(reinterpret_cast<const char*>(&mask), 8);
-    k.append(val);
-    return k;
-  };
-
-  Entry* entry = head->next;
-  int64_t steps = 0;
-  while (head->next != nullptr) {
-    if (max_steps > 0 && ++steps > max_steps) return 2;
-    if (!entry->is_return) {
-      const int op = entry->op;
-      bool ok = false;
-      std::string new_val;
-      const std::string& cur = states[cur_state];
-      switch (op_kind[op]) {
-        case kGet:
-          ok = output_of(op) == cur;
-          if (ok) new_val = cur;
-          break;
-        case kPut:
-          ok = true;
-          new_val = value_of(op);
-          break;
-        case kAppend:
-          ok = true;
-          new_val = cur + value_of(op);
-          break;
-        default:
-          return 0;
-      }
-      bool advanced = false;
-      if (ok) {
-        const uint64_t new_mask = linearized | (1ull << op);
-        std::string key = cache_key(new_mask, new_val);
-        if (cache.insert(std::move(key)).second) {
-          stack.push_back({entry, cur_state});
-          states.push_back(std::move(new_val));
-          cur_state = static_cast<int>(states.size()) - 1;
-          linearized = new_mask;
-          lift(entry);
-          entry = head->next;
-          advanced = true;
-        }
-      }
-      if (!advanced) entry = entry->next;
-    } else {
-      if (stack.empty()) return 0;
-      Frame f = stack.back();
-      stack.pop_back();
-      cur_state = f.saved_state;
-      linearized &= ~(1ull << f.call->op);
-      unlift(f.call);
-      entry = f.call->next;
-    }
-  }
-  return 1;
+  return check_impl(n, ev_op, ev_is_ret, op_kind, op_value, op_value_len,
+                    op_output, op_output_len, max_steps, false, nullptr,
+                    nullptr);
 }
+
+int check_kv_partition_verbose(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    const int32_t* op_kind,
+    const uint8_t* const* op_value,
+    const int32_t* op_value_len,
+    const uint8_t* const* op_output,
+    const int32_t* op_output_len,
+    int64_t max_steps,
+    int32_t** out_buf,
+    int64_t* out_len) {
+  return check_impl(n, ev_op, ev_is_ret, op_kind, op_value, op_value_len,
+                    op_output, op_output_len, max_steps, true, out_buf,
+                    out_len);
+}
+
+void mrt_buf_free(int32_t* buf) { std::free(buf); }
 
 }  // extern "C"

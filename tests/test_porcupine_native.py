@@ -108,9 +108,106 @@ def test_native_handles_heavy_concurrency_fast():
     assert dt < 20.0
 
 
-def test_large_partition_falls_back_to_python():
+def test_large_partition_stays_native():
+    """No 62-op bitset cap anymore: the hash-memo DFS takes arbitrary
+    partition sizes (the real kvraft/bench histories are thousands of
+    ops, where the old cap silently fell back to the Python DFS)."""
     h = [
         Operation(i, KvInput(op=OP_PUT, key="k", value=str(i)), i, KvOutput(), i + 0.5)
-        for i in range(70)  # > 62: native punts
+        for i in range(70)
     ]
     assert check_operations(kv_model, h, timeout=5.0) is CheckResult.OK
+
+
+def test_bench_scale_history_is_fast_native():
+    """A bench-shaped history (tens of thousands of appends with
+    ~3-tick overlap windows + a final read) must check in seconds via
+    the native DFS — this is what makes the headline bench's
+    porcupine pass affordable (round-2 verdict item)."""
+    import time
+
+    n = 30_000
+    h = []
+    for i in range(n):
+        h.append(
+            Operation(
+                0, KvInput(op=OP_APPEND, key="k", value=f"[{i}]"),
+                float(i), KvOutput(), float(i + 3) + 0.5,
+            )
+        )
+    h.append(
+        Operation(1, KvInput(op=OP_GET, key="k"), float(n + 10),
+                  KvOutput(value="".join(f"[{i}]" for i in range(n))),
+                  float(n + 11))
+    )
+    t0 = time.monotonic()
+    res = check_operations(kv_model, h, timeout=60.0)
+    dt = time.monotonic() - t0
+    assert res is CheckResult.OK
+    assert dt < 10.0, f"native large-history check took {dt:.1f}s"
+
+
+def test_verbose_native_matches_python_partials():
+    """check_operations_verbose rides the native DFS now (round-2
+    verdict: the evidence pass must not be orders slower than the
+    checking pass).  Parity: verdict AND partial linearizations must
+    match the Python oracle on failing histories — both DFSs explore
+    in the same order, so the computePartial output is identical."""
+    from multiraft_tpu.porcupine.checker import check_operations_verbose
+
+    rng = random.Random(7)
+    compared = 0
+    for trial in range(30):
+        h = _random_history(rng, 3, rng.randrange(4, 14), mutate=True)
+        vn, info_n = check_operations_verbose(kv_model, h, timeout=10.0)
+        vp, info_p = check_operations_verbose(kv_model_py, h, timeout=10.0)
+        if CheckResult.UNKNOWN in (vn, vp):
+            continue
+        assert vn == vp, f"trial {trial}: {vn} != {vp}"
+        # ORDERED equality: both DFSs explore identically and emit
+        # partials in first-referencing-op order, so the evidence is
+        # byte-identical with or without the native lib.
+        n_parts = [list(map(list, p)) for p in info_n.partials]
+        p_parts = [list(map(list, p)) for p in info_p.partials]
+        assert n_parts == p_parts, (
+            f"trial {trial}: partials diverge\n{info_n.partials}\n"
+            f"{info_p.partials}"
+        )
+        compared += 1
+    assert compared >= 20
+
+
+def test_verbose_native_large_failing_history_fast():
+    """The exact round-2 complaint: on a LARGE failing history, the
+    debugging (verbose) pass used to fall back to the Python DFS and
+    run orders slower than the native check that caught it.  Now both
+    ride the same C++ pass.
+
+    The appends are sequential (non-overlapping) so illegality is
+    provable in linear time — proving ILLEGAL over heavily-overlapping
+    ops is exponential for ANY porcupine implementation (that is what
+    the timeout-as-UNKNOWN convention exists for)."""
+    import time
+
+    n = 20_000
+    h = []
+    for i in range(n):
+        h.append(
+            Operation(
+                0, KvInput(op=OP_APPEND, key="k", value=f"[{i}]"),
+                float(i), KvOutput(), float(i) + 0.5,
+            )
+        )
+    # A read that contradicts the appends: ILLEGAL.
+    h.append(
+        Operation(1, KvInput(op=OP_GET, key="k"), float(n + 10),
+                  KvOutput(value="NOT-THE-VALUE"), float(n + 11))
+    )
+    from multiraft_tpu.porcupine.checker import check_operations_verbose
+
+    t0 = time.monotonic()
+    verdict, info = check_operations_verbose(kv_model, h, timeout=60.0)
+    dt = time.monotonic() - t0
+    assert verdict is CheckResult.ILLEGAL
+    assert info.partials and info.partials[0], "no evidence captured"
+    assert dt < 15.0, f"verbose failing-history pass took {dt:.1f}s"
