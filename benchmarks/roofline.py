@@ -44,14 +44,23 @@ def bytes_per_tick(G: int, P: int, L: int, E: int, passes_log: float = 2.0):
     return (state + (passes_log - 1) * log) + mailbox + state + mailbox
 
 
-def measure(cfg, n_ticks: int = 200, reps: int = 3) -> float:
+def measure(cfg, n_ticks: int = 200, reps: int = 3):
+    """(best_s_per_tick, commits_per_sec_at_best).  The timing fence is
+    a scalar COMMIT READBACK, not block_until_ready — through the axon
+    tunnel the latter can return before the scan finishes (observed:
+    650x-too-fast "measurements"), while a value readback must wait,
+    and doubles as proof the chunk really committed work."""
     import jax
+    import jax.numpy as jnp
 
     from multiraft_tpu.engine.core import (
         empty_mailbox,
         init_state,
         run_ticks,
     )
+
+    def commits(st):
+        return int(jnp.max(st.commit, axis=1).sum())  # forces the sync
 
     key = jax.random.PRNGKey(5)
     state = init_state(cfg, key)
@@ -60,16 +69,22 @@ def measure(cfg, n_ticks: int = 200, reps: int = 3) -> float:
     state, inbox = run_ticks(
         cfg, state, inbox, n_ticks, cfg.INGEST, jax.random.fold_in(key, 1)
     )  # compile loaded + fill
-    jax.block_until_ready(state.term)
+    c0 = commits(state)
     best = float("inf")
+    rate = 0.0
     for r in range(reps):
         t0 = time.perf_counter()
         state, inbox = run_ticks(
             cfg, state, inbox, n_ticks, cfg.INGEST, jax.random.fold_in(key, 2 + r)
         )
-        jax.block_until_ready(state.term)
-        best = min(best, (time.perf_counter() - t0) / n_ticks)
-    return best
+        c1 = commits(state)
+        dt = time.perf_counter() - t0
+        assert c1 > c0, "no commits in a timed chunk — measurement invalid"
+        if dt / n_ticks < best:
+            best = dt / n_ticks
+            rate = (c1 - c0) / dt
+        c0 = c1
+    return best, rate
 
 
 def main(argv) -> None:
@@ -89,9 +104,13 @@ def main(argv) -> None:
         dict(L=64, E=8, INGEST=8),
         dict(L=112, E=8, INGEST=8),
         dict(L=224, E=8, INGEST=8),
-        # operating points: the bench's 28/112 vs neighbors.
+        # operating points: the bench's 28/112 vs neighbors — maps the
+        # E-cliff (32/128 doubles tick time for +11% bytes: a compile/
+        # shape cliff, not bandwidth).
         dict(L=80, E=20, INGEST=20),
+        dict(L=96, E=24, INGEST=24),
         dict(L=112, E=28, INGEST=28),
+        dict(L=120, E=30, INGEST=30),
         dict(L=128, E=32, INGEST=32),
     ]
     for s in sweeps:
@@ -99,8 +118,8 @@ def main(argv) -> None:
             G=G, P=3, HB_TICKS=9,
             use_pallas=(platform == "tpu"), **s,
         )
-        ms = measure(cfg) * 1e3
-        commits_s = s["INGEST"] * G / (ms * 1e-3)
+        per_tick, commits_s = measure(cfg)
+        ms = per_tick * 1e3
         b2 = bytes_per_tick(G, 3, s["L"], s["E"], passes_log=2.0)
         print(
             json.dumps({

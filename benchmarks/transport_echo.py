@@ -5,9 +5,13 @@ serial RPCs through in-process labrpc (reference:
 labrpc/test_test.go:568-597, "about 22 microseconds per RPC" on 2016
 hardware).  This rig measures the same serial request/reply loop on:
 
-  * ``sim``    — the virtual-time network (in-process, like labrpc)
-  * ``native`` — the C++ epoll transport over real loopback sockets,
-                 which the reference has no equivalent of
+  * ``sim``     — the virtual-time network (in-process, like labrpc)
+  * ``native``  — the C++ epoll transport over real loopback sockets
+                  (client + server in one process, two loop threads)
+  * ``native2`` — same, with the echo server in its OWN OS process
+                  (emitted as path "native_2proc"; the deployment
+                  shape — on a 1-core host it pays a full context
+                  switch each way)
 
 A third line reports the HOST FLOOR: ``loopback_floor.c`` (raw C TCP
 ping-pong between two threads, no Python, no codec) is the kernel
@@ -20,6 +24,7 @@ Usage::
 
     python -m benchmarks.transport_echo            # all, JSON lines
     python -m benchmarks.transport_echo native     # one path
+    python -m benchmarks.transport_echo native2    # 2-process form
     python -m benchmarks.transport_echo floor      # C floor only
 
 Each line: {"path": ..., "n": ..., "us_per_rpc": ..., "vs_ref_22us": ...}
@@ -61,9 +66,35 @@ def bench_sim(n: int = 100_000) -> float:
     return (time.perf_counter() - t0) / n * 1e6
 
 
+def _serial_echo(client, end, n: int):
+    """Shared measurement core for both native forms: warmup, then
+    serial RPCs from a coroutine on the loop thread — the analog of the
+    reference's single-goroutine benchmark loop.  Batched min + median:
+    on a shared VM, ambient load swings a batch 2×, and min is the
+    standard noise-robust estimator for serial latency."""
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    for i in range(200):
+        assert client.sched.wait(end.call("Echo.shout", i), 5.0) == ("echo", i)
+    batches = 5
+    per = max(1, n // batches)
+
+    def driver():
+        for i in range(per):
+            yield end.call("Echo.shout", i)
+
+    samples = []
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        fut = client.sched.spawn(driver())
+        assert client.sched.wait(fut, 300.0) is not TIMEOUT
+        samples.append((time.perf_counter() - t0) / per * 1e6)
+    samples.sort()
+    return samples[0], samples[len(samples) // 2]
+
+
 def bench_native(n: int = 20_000) -> float:
     from multiraft_tpu.distributed.tcp import RpcNode
-    from multiraft_tpu.sim.scheduler import TIMEOUT
 
     class Echo:
         def shout(self, args):
@@ -74,35 +105,50 @@ def bench_native(n: int = 20_000) -> float:
     try:
         server.add_service("Echo", Echo())
         end = client.client_end("127.0.0.1", server.port)
-        # Warm up: first call pays connect + codec import costs.
-        for i in range(200):
-            assert client.sched.wait(end.call("Echo.shout", i), 5.0) == ("echo", i)
-
-        # Serial RPCs issued from a coroutine on the loop thread — the
-        # analog of the reference's single-goroutine benchmark loop
-        # (its client goroutine and labrpc share the Go runtime; here
-        # the clerk coroutine and the reactor share the loop thread).
-        # Run in batches and report min + median: on a shared VM,
-        # ambient load swings a batch 2×, and min is the standard
-        # noise-robust estimator for serial latency.
-        batches = 5
-        per = max(1, n // batches)
-
-        def driver():
-            for i in range(per):
-                yield end.call("Echo.shout", i)
-
-        samples = []
-        for _ in range(batches):
-            t0 = time.perf_counter()
-            fut = client.sched.spawn(driver())
-            assert client.sched.wait(fut, 300.0) is not TIMEOUT
-            samples.append((time.perf_counter() - t0) / per * 1e6)
-        samples.sort()
-        return samples[0], samples[len(samples) // 2]
+        return _serial_echo(client, end, n)
     finally:
         client.close()
         server.close()
+
+
+def bench_native_2proc(n: int = 20_000):
+    """The deployment-shaped variant: echo SERVER in its own OS
+    process, so the client's and server's loop threads do not share a
+    GIL (the single-process form above makes every wake contend for
+    one interpreter lock — real clusters never pay that)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [_sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from multiraft_tpu.distributed.tcp import RpcNode\n"
+            "class Echo:\n"
+            "    def shout(self, args):\n"
+            "        return ('echo', args)\n"
+            "node = RpcNode(listen=True)\n"
+            "node.add_service('Echo', Echo())\n"
+            "print(node.port, flush=True)\n"
+            "import time\n"
+            "time.sleep(3600)\n"
+        ) % repo],
+        stdout=subprocess.PIPE, text=True,
+    )
+    client = None
+    try:
+        port = int(child.stdout.readline())
+        client = RpcNode()
+        end = client.client_end("127.0.0.1", port)
+        return _serial_echo(client, end, n)
+    finally:
+        if client is not None:
+            client.close()
+        child.kill()
+        child.wait()
 
 
 def bench_floor(n: int = 20_000):
@@ -141,6 +187,8 @@ def main(argv: list[str]) -> None:
         runs.append(("sim", 100_000, bench_sim))
     if which in ("native", "both"):
         runs.append(("native", 20_000, bench_native))
+    if which in ("native2", "both"):
+        runs.append(("native_2proc", 20_000, bench_native_2proc))
     if which in ("floor", "both"):
         runs.append(("loopback_floor_c", 20_000, bench_floor))
     for name, n, fn in runs:

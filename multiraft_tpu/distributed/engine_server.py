@@ -324,45 +324,61 @@ class EngineKVService:
         def run():
             deadline = self.sched.now + self.DEADLINE_S
             replies = [None] * len(args_list)
-            # STRICTLY one in-flight write per (client, group) — the
-            # same discipline as replay_wal: submitting a client's cmd
-            # N and N+1 to one group concurrently lets an eviction
-            # commit N+1 first, after which the resubmitted N is
-            # dedup-swallowed and its acked mutation silently lost.
-            # Writes to DIFFERENT groups pipeline freely (sessions are
-            # per group).
-            queues: dict = {}
+            # Chains: a client's writes to ONE group must apply in
+            # order (same-client dedup + same-key cross-op order).
+            # FIFO backlog makes the whole chain safe to pipeline AT
+            # ONCE: bindings land in submission order, and a leader-
+            # change truncation can only fail a contiguous SUFFIX of
+            # the chain.  The one hazard is resubmitting a failed
+            # member while later members are still in flight (an
+            # orphan sweep can fail out of order, and an inverted
+            # rebinding lets the session table swallow the earlier
+            # cmd) — so a chain with failures WAITS until every member
+            # resolves, then resubmits from the first failure onward,
+            # in order.  Chains to different groups pipeline freely.
+            chains: dict = {}
             for i, a in enumerate(args_list):
                 if a.op != "Get":
                     key = (a.client_id, route_group(a.key, self.G))
-                    queues.setdefault(key, []).append((i, a))
-            tickets: dict = {}  # frame index -> resolved-OK ticket
-            heads: dict = {}    # (client, group) -> (i, ticket)
-            while queues and self.sched.now < deadline:
-                for qk in list(queues):
-                    if qk not in heads:
-                        i, a = queues[qk][0]
-                        heads[qk] = (i, self.kv.submit(
-                            qk[1],
-                            KVOp(op=_OPCODE[a.op], key=a.key,
-                                 value=a.value, client_id=a.client_id,
-                                 command_id=a.command_id),
-                        ))
+                    chains.setdefault(key, []).append((i, a))
+
+            def submit(a):
+                return self.kv.submit(
+                    route_group(a.key, self.G),
+                    KVOp(op=_OPCODE[a.op], key=a.key, value=a.value,
+                         client_id=a.client_id, command_id=a.command_id),
+                )
+
+            tickets: dict = {}  # frame index -> latest ticket
+            for members in chains.values():
+                for i, a in members:
+                    tickets[i] = submit(a)
+            pending = set(chains)
+            while pending and self.sched.now < deadline:
                 progressed = False
-                for qk, (i, t) in list(heads.items()):
-                    if not t.done:
+                for qk in list(pending):
+                    members = chains[qk]
+                    if not all(tickets[i].done for i, _ in members):
                         continue
-                    if t.failed:
-                        del heads[qk]  # resubmit next round, same ids
+                    first_bad = next(
+                        (k for k, (i, _) in enumerate(members)
+                         if tickets[i].failed),
+                        None,
+                    )
+                    if first_bad is None:
+                        pending.discard(qk)
+                        progressed = True
                         continue
-                    tickets[i] = t
-                    queues[qk].pop(0)
-                    del heads[qk]
-                    if not queues[qk]:
-                        del queues[qk]
-                    progressed = True
-                if queues and not progressed:
+                    # Resubmit the suffix in order (dedup makes any
+                    # already-applied member a no-op resolve).
+                    for i, a in members[first_bad:]:
+                        tickets[i] = submit(a)
+                if pending and not progressed:
                     yield 0.002
+            tickets = {
+                i: t for i, t in tickets.items()
+                if t.done and not t.failed
+            }
             # Durable mode: one group fsync covers the whole frame —
             # a write acks OK only once its apply-time WAL record is
             # synced (like command(); an unsynced write at the

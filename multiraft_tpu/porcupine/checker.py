@@ -213,6 +213,22 @@ def _check_single(
             entry = top.next
     if verdict is None:
         verdict = CheckResult.OK
+    if (
+        compute_partial
+        and verdict is CheckResult.UNKNOWN
+        and calls
+    ):
+        # Timeout mid-descent: the live stack is a linearizable prefix
+        # no backtrack recorded — capture it so the evidence is never
+        # empty for exactly the runs verbose mode exists to debug
+        # (mirrored by the native DFS).
+        seq = None
+        for e, _ in calls:
+            cur = longest[e.op_id]
+            if cur is None or len(calls) > len(cur):
+                if seq is None:
+                    seq = [c.op_id for c, _ in calls]
+                longest[e.op_id] = seq
     partials: List[List[int]] = []
     if compute_partial:
         if verdict is CheckResult.OK:

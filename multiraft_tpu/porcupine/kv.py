@@ -91,12 +91,20 @@ def _native_args(part: List[Operation], deadline):
     if any(op.ret < op.call for op in part):
         return "malformed"
     if deadline is None:
-        max_steps = 0  # unlimited: exhaustive, like the Python DFS
+        max_steps = 0   # unlimited: exhaustive, like the Python DFS
+        max_wall = 0.0
     else:
         remaining = deadline - _time.monotonic()
         if remaining <= 0:
             return None
-        max_steps = int(remaining * _NATIVE_STEPS_PER_SEC)
+        # max(1, ·): int() of a sub-50ns remainder would truncate to
+        # the 0 = UNLIMITED sentinel and turn an expired deadline into
+        # an exhaustive search.  The wall clock is the real bound (the
+        # C++ loop checks it every 8k steps — verbose backtracks cost
+        # O(depth), so a step budget alone under-counts); the step
+        # budget stays as a belt for clock-free callers.
+        max_steps = max(1, int(remaining * _NATIVE_STEPS_PER_SEC))
+        max_wall = remaining
     events = []
     for i, op in enumerate(part):
         events.append((op.call, 0, i))
@@ -106,7 +114,7 @@ def _native_args(part: List[Operation], deadline):
     kinds = [op.input.op for op in part]
     values = [op.input.value for op in part]
     outputs = [op.output.value for op in part]
-    return ev, kinds, values, outputs, max_steps
+    return ev, kinds, values, outputs, max_steps, max_wall
 
 
 def _rc_result(rc):
@@ -133,8 +141,11 @@ def _native_check(part: List[Operation], deadline=None):
         return None  # Python DFS raises the proper ValueError
     if args is None:
         return CheckResult.UNKNOWN
-    ev, kinds, values, outputs, max_steps = args
-    rc = check_kv_partition_native(ev, kinds, values, outputs, max_steps=max_steps)
+    ev, kinds, values, outputs, max_steps, max_wall = args
+    rc = check_kv_partition_native(
+        ev, kinds, values, outputs, max_steps=max_steps,
+        max_wall_s=max_wall,
+    )
     if rc is None:
         return None
     return _rc_result(rc)
@@ -156,9 +167,10 @@ def _native_check_verbose(part: List[Operation], deadline=None):
         return None
     if args is None:
         return CheckResult.UNKNOWN, []
-    ev, kinds, values, outputs, max_steps = args
+    ev, kinds, values, outputs, max_steps, max_wall = args
     out = check_kv_partition_native_verbose(
-        ev, kinds, values, outputs, max_steps=max_steps
+        ev, kinds, values, outputs, max_steps=max_steps,
+        max_wall_s=max_wall,
     )
     if out is None:
         return None

@@ -34,7 +34,8 @@ _COMMON_ARGS = [
     ctypes.POINTER(ctypes.c_int32),
     ctypes.POINTER(ctypes.c_char_p),
     ctypes.POINTER(ctypes.c_int32),
-    ctypes.c_int64,
+    ctypes.c_int64,   # max_steps (0 = unlimited)
+    ctypes.c_double,  # max_wall_s (0 = unlimited); checked in-loop
 ]
 
 
@@ -86,7 +87,9 @@ def _marshal(events, op_kinds, op_values, op_outputs):
     ), keepalive
 
 
-def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps=0):
+def check_kv_partition_native(
+    events, op_kinds, op_values, op_outputs, max_steps=0, max_wall_s=0.0
+):
     """Run the C++ DFS on one pre-sorted partition.
 
     events: list of (op_id, is_return) in time order.
@@ -97,11 +100,11 @@ def check_kv_partition_native(events, op_kinds, op_values, op_outputs, max_steps
     if lib is None:
         return None
     args, _keep = _marshal(events, op_kinds, op_values, op_outputs)
-    return lib.check_kv_partition(*args, max_steps)
+    return lib.check_kv_partition(*args, max_steps, max_wall_s)
 
 
 def check_kv_partition_native_verbose(
-    events, op_kinds, op_values, op_outputs, max_steps=0
+    events, op_kinds, op_values, op_outputs, max_steps=0, max_wall_s=0.0
 ) -> Optional[Tuple[int, List[List[int]]]]:
     """Verbose C++ DFS: returns ``(rc, partials)`` where partials is
     the reference computePartial output — op-id sequences, the single
@@ -114,7 +117,7 @@ def check_kv_partition_native_verbose(
     buf = ctypes.POINTER(ctypes.c_int32)()
     buf_len = ctypes.c_int64(0)
     rc = lib.check_kv_partition_verbose(
-        *args, max_steps, ctypes.byref(buf), ctypes.byref(buf_len)
+        *args, max_steps, max_wall_s, ctypes.byref(buf), ctypes.byref(buf_len)
     )
     partials: List[List[int]] = []
     if buf and buf_len.value > 0:

@@ -18,14 +18,15 @@
 //    (append saves a length; put saves the replaced value), so the
 //    current value is always exact — Get compares bytes, never a
 //    hash.
-//  * The MEMO stores a 128-bit hash of (linearized-set, value):
-//    a Zobrist hash over op-ids (one xor per step) mixed with an
-//    incrementally-maintained polynomial hash of the value.  Memory
-//    per memo entry is O(1) instead of O(|value|); a hash collision
-//    could only over-prune (flip a true OK to ILLEGAL) with
-//    probability ~2^-128 per explored pair — negligible against the
-//    machine's own soft-error rate, and the failure mode is loud
-//    (a spurious ILLEGAL gets investigated), never a silent pass.
+//  * The MEMO stores a 128-bit hash of (linearized-set, value): TWO
+//    independent Zobrist hashes over op-ids (one xor each per step)
+//    mixed with two independent polynomial hashes of the value — the
+//    two words share no state, so the collision bound is a genuine
+//    ~2^-128 per explored pair.  Memory per memo entry is O(1)
+//    instead of O(|value|); a collision could only over-prune (flip
+//    a true OK to ILLEGAL) — negligible odds, and the failure mode
+//    is loud (a spurious ILLEGAL gets investigated), never a silent
+//    pass.
 //
 // Exposed via a C ABI for ctypes (no pybind11 in this image).
 // Return codes: 1 = linearizable, 0 = not, 2 = budget exhausted
@@ -34,6 +35,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <string>
 #include <unordered_set>
 #include <vector>
@@ -96,8 +98,8 @@ struct Checker {
   // Exact current value + per-frame undo.
   std::string cur;
   uint64_t vh1 = 0, vh2 = 0;  // incremental value hash
-  uint64_t zob = 0;           // Zobrist hash of the linearized set
-  std::vector<uint64_t> zkeys;
+  uint64_t zob = 0, zob2 = 0; // independent Zobrist set hashes
+  std::vector<uint64_t> zkeys, zkeys2;
 
   struct Frame {
     Entry* call;
@@ -134,7 +136,11 @@ struct Checker {
     }
     tail->next = nullptr;
     zkeys.resize(n);
-    for (int32_t i = 0; i < n; i++) zkeys[i] = splitmix64(0xC0FFEE ^ i);
+    zkeys2.resize(n);
+    for (int32_t i = 0; i < n; i++) {
+      zkeys[i] = splitmix64(0xC0FFEE ^ i);
+      zkeys2[i] = splitmix64(0xB00B1E5ull + 0x9E37ull * i);
+    }
     stack.reserve(n);
   }
 
@@ -203,6 +209,7 @@ struct Checker {
     vh1 = nvh1;
     vh2 = nvh2;
     zob ^= zkeys[op];
+    zob2 ^= zkeys2[op];
     stack.push_back(std::move(f));
     lift(call);
   }
@@ -217,16 +224,26 @@ struct Checker {
     vh1 = f.old_vh1;
     vh2 = f.old_vh2;
     zob ^= zkeys[f.call->op];
+    zob2 ^= zkeys2[f.call->op];
     unlift(f.call);
     Entry* resume = f.call->next;
     stack.pop_back();
     return resume;
   }
 
-  Key128 memo_key(uint64_t nzob, uint64_t nvh1, uint64_t nvh2) const {
-    return Key128{splitmix64(nzob ^ nvh1), splitmix64(nzob * kP2 ^ nvh2)};
+  Key128 memo_key(uint64_t nzob, uint64_t nzob2, uint64_t nvh1,
+                  uint64_t nvh2) const {
+    // Two fully independent 64-bit words (separate Zobrist tables,
+    // separate polynomial bases) — a real 128-bit collision bound.
+    return Key128{splitmix64(nzob ^ nvh1), splitmix64(nzob2 ^ nvh2)};
   }
 };
+
+inline double mono_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
 
 }  // namespace
 
@@ -254,6 +271,7 @@ static int check_impl(
     const uint8_t* const* op_output,
     const int32_t* op_output_len,
     int64_t max_steps,
+    double max_wall_s,
     bool compute_partial,
     int32_t** out_buf,
     int64_t* out_len) {
@@ -279,11 +297,24 @@ static int check_impl(
   std::vector<std::vector<int32_t>> seqs;
   if (compute_partial) longest.assign(n, -1);
 
+  // Wall-clock deadline checked every 8192 steps — the step budget
+  // alone under-counts verbose mode (each backtrack's computePartial
+  // capture is O(stack depth)), and the timeout-as-UNKNOWN convention
+  // must bound WALL time (Python DFS parity: checker.py's
+  // steps % 4096 check).
+  const double wall_deadline =
+      max_wall_s > 0 ? mono_s() + max_wall_s : 0.0;
   Entry* entry = c.head->next;
   int64_t steps = 0;
   int verdict = -1;
   while (c.head->next != nullptr) {
-    if (max_steps > 0 && ++steps > max_steps) {
+    ++steps;
+    if (max_steps > 0 && steps > max_steps) {
+      verdict = 2;
+      break;
+    }
+    if (wall_deadline > 0 && (steps & 8191) == 0 &&
+        mono_s() > wall_deadline) {
       verdict = 2;
       break;
     }
@@ -292,7 +323,8 @@ static int check_impl(
       bool advanced = false;
       if (c.step_ok(entry->op, nvh1, nvh2)) {
         const uint64_t nzob = c.zob ^ c.zkeys[entry->op];
-        if (c.memo.insert(c.memo_key(nzob, nvh1, nvh2)).second) {
+        const uint64_t nzob2 = c.zob2 ^ c.zkeys2[entry->op];
+        if (c.memo.insert(c.memo_key(nzob, nzob2, nvh1, nvh2)).second) {
           c.apply(entry, nvh1, nvh2);
           entry = c.head->next;
           advanced = true;
@@ -326,6 +358,28 @@ static int check_impl(
     }
   }
   if (verdict < 0) verdict = 1;
+
+  if (compute_partial && verdict == 2 && !c.stack.empty()) {
+    // Budget/deadline expired mid-descent: the LIVE stack is a
+    // linearizable prefix no backtrack recorded yet — capture it so
+    // the evidence is never empty for exactly the runs verbose mode
+    // exists to debug.
+    int32_t seq_idx = -1;
+    const size_t depth = c.stack.size();
+    for (const auto& f : c.stack) {
+      const int op = f.call->op;
+      if (longest[op] < 0 || seqs[longest[op]].size() < depth) {
+        if (seq_idx < 0) {
+          std::vector<int32_t> s;
+          s.reserve(depth);
+          for (const auto& g : c.stack) s.push_back(g.call->op);
+          seqs.push_back(std::move(s));
+          seq_idx = static_cast<int32_t>(seqs.size()) - 1;
+        }
+        longest[op] = seq_idx;
+      }
+    }
+  }
 
   if (compute_partial && out_buf) {
     std::vector<int32_t> full;
@@ -374,10 +428,11 @@ int check_kv_partition(
     const int32_t* op_value_len,
     const uint8_t* const* op_output,
     const int32_t* op_output_len,
-    int64_t max_steps) {
+    int64_t max_steps,
+    double max_wall_s) {
   return check_impl(n, ev_op, ev_is_ret, op_kind, op_value, op_value_len,
-                    op_output, op_output_len, max_steps, false, nullptr,
-                    nullptr);
+                    op_output, op_output_len, max_steps, max_wall_s,
+                    false, nullptr, nullptr);
 }
 
 int check_kv_partition_verbose(
@@ -390,11 +445,12 @@ int check_kv_partition_verbose(
     const uint8_t* const* op_output,
     const int32_t* op_output_len,
     int64_t max_steps,
+    double max_wall_s,
     int32_t** out_buf,
     int64_t* out_len) {
   return check_impl(n, ev_op, ev_is_ret, op_kind, op_value, op_value_len,
-                    op_output, op_output_len, max_steps, true, out_buf,
-                    out_len);
+                    op_output, op_output_len, max_steps, max_wall_s,
+                    true, out_buf, out_len);
 }
 
 void mrt_buf_free(int32_t* buf) { std::free(buf); }

@@ -177,6 +177,37 @@ def test_verbose_native_matches_python_partials():
     assert compared >= 20
 
 
+def test_verbose_timeout_bounds_wall_clock_with_evidence():
+    """A heavily-overlapping failing history is exponential to refute;
+    the timeout must bound WALL time in the native verbose path too
+    (the step budget alone under-counts O(depth) backtrack captures),
+    and the UNKNOWN verdict must still carry evidence — the live
+    descent's prefix at expiry."""
+    import time
+
+    from multiraft_tpu.porcupine.checker import check_operations_verbose
+
+    n = 400
+    h = [
+        Operation(i, KvInput(op=OP_APPEND, key="k", value=f"[{i}]"), 0.0,
+                  KvOutput(), 1000.0)
+        for i in range(n)
+    ]
+    h.append(
+        Operation(n, KvInput(op=OP_GET, key="k"), 1001.0,
+                  KvOutput(value="WRONG"), 1002.0)
+    )
+    t0 = time.monotonic()
+    verdict, info = check_operations_verbose(kv_model, h, timeout=3.0)
+    dt = time.monotonic() - t0
+    assert verdict in (CheckResult.UNKNOWN, CheckResult.ILLEGAL)
+    assert dt < 12.0, f"timeout did not bound wall clock: {dt:.1f}s"
+    if verdict is CheckResult.UNKNOWN:
+        assert info.partials and info.partials[0], (
+            "UNKNOWN verdict carried no partial evidence"
+        )
+
+
 def test_verbose_native_large_failing_history_fast():
     """The exact round-2 complaint: on a LARGE failing history, the
     debugging (verbose) pass used to fall back to the Python DFS and
