@@ -79,10 +79,12 @@ def main() -> None:
         os.environ.get("MULTIRAFT_BENCH_PALLAS", default_pallas) == "1"
     )
     # Operating point, re-tuned round 2: E=INGEST=28 with L=112 is
-    # ~35% over 20/80 at G=10k (median 220M vs 164M on the shared
-    # chip) — more ingest per tick at essentially the same tick time,
-    # so p99 (3 ticks) is unchanged.  The next step up (32/128)
-    # collapses to ~60M: the ring crosses into HBM-bound territory.
+    # ~35% over 20/80 at G=10k — more ingest per tick at essentially
+    # the same tick time.  The next step up (32/128) collapses (~2×
+    # the tick time for +11% bytes) — a compile/shape cliff, NOT
+    # bandwidth: the round-3 roofline (benchmarks/roofline.py,
+    # BENCHMARKS.md "Roofline") measured the tick at 6-11% of HBM
+    # bandwidth and nearly flat in L.
     cfg = EngineConfig(
         G=G, P=P, L=112, E=28, INGEST=28, HB_TICKS=9,
         use_pallas=use_pallas,

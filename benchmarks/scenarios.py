@@ -286,11 +286,11 @@ def bench_sweep() -> Dict:
     points = {}
     for P in peer_counts:
         for G in [g for g in (1000, 10000, 100000) if g <= gmax]:
-            # Per-scale operating point: at 100k groups the working
-            # set is HBM-bandwidth-bound and the leaner 16/64 ring
-            # wins; at <=10k the round-2 retune (28/112, _cfg's
-            # default) wins ~35% over the old 20/80 — see bench.py's
-            # operating-point note.
+            # Per-scale operating point (measured, not modeled — the
+            # round-3 roofline showed the tick is NOT bandwidth-bound):
+            # at 100k groups the leaner 16/64 ring wins; at <=10k the
+            # round-2 retune (28/112, _cfg's default) wins ~35% over
+            # the old 20/80 — see BENCHMARKS.md "Roofline".
             cfg = (
                 _cfg(G=G, P=P, L=64, E=16, ingest=16)
                 if G >= 100000
