@@ -309,6 +309,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             host=spec.get("host", "127.0.0.1"),
             seed=spec.get("seed", 0),
             delay_elections=spec.get("delay_elections", 0),
+            data_dir=spec.get("data_dir"),
+            snapshot_every_s=spec.get("snapshot_every_s", 30.0),
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
@@ -535,6 +537,8 @@ class SplitProcessCluster:
         host: str = "127.0.0.1",
         seed: int = 0,
         delay_elections: Optional[Sequence[int]] = None,
+        data_dir: Optional[str] = None,
+        snapshot_every_s: float = 30.0,
     ) -> None:
         from . import engine_server  # noqa: F401  (codec registration)
         from . import split_server  # noqa: F401
@@ -543,7 +547,7 @@ class SplitProcessCluster:
         self.ports = _reserve_ports(n_procs, host)
         self.specs = []
         for i in range(n_procs):
-            self.specs.append({
+            spec = {
                 "kind": "split_kv",
                 "me": i,
                 "host": host,
@@ -555,8 +559,28 @@ class SplitProcessCluster:
                     int(delay_elections[i]) if delay_elections else 0
                 ),
                 "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
-            })
+            }
+            if data_dir is not None:
+                # Durable peer identity (SplitPersistence): kill(i) +
+                # start(i) REJOINS from the persisted term/vote/log.
+                spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
+                spec["snapshot_every_s"] = snapshot_every_s
+            self.specs.append(spec)
+        self.durable = data_dir is not None
+        self._killed: set = set()
         self.procs: List[Optional[subprocess.Popen]] = [None] * n_procs
+
+    def start(self, i: int) -> None:
+        assert self.procs[i] is None or self.procs[i].poll() is not None
+        # Restarting a previously-killed member is only safe in durable
+        # mode — a fresh-state restart under an old peer identity can
+        # double-vote (engine/split.py crash-model note).
+        assert self.durable or i not in self._killed, (
+            f"process {i} was killed; a non-durable split peer must "
+            "stay dead (pass data_dir= for safe rejoin)"
+        )
+        self.procs[i] = _launch_server(self.specs[i], f"split-{i}")
+        _check_ready(self.procs[i], f"split-{i}", timeout=300.0)
 
     def start_all(self) -> None:
         for i, spec in enumerate(self.specs):
@@ -565,14 +589,16 @@ class SplitProcessCluster:
             _check_ready(p, f"split-{i}", timeout=300.0)
 
     def kill(self, i: int) -> None:
-        """SIGKILL process ``i`` — its owned peer slots are gone (no
-        restart path: a split peer must not rejoin with fresh state,
-        see engine/split.py's double-vote note)."""
+        """SIGKILL process ``i``.  Durable mode: :meth:`start` rejoins
+        it from its data_dir.  Non-durable: it must stay dead — a split
+        peer restarted with fresh state can double-vote (see
+        engine/split.py's crash-model note)."""
         p = self.procs[i]
         if p is not None and p.poll() is None:
             p.kill()
             p.wait()
         self.procs[i] = None
+        self._killed.add(i)
 
     def clerk(self) -> "BlockingSplitClerk":
         return BlockingSplitClerk(self.ports, host=self.host)

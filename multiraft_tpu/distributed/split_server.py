@@ -21,6 +21,8 @@ across processes).
 from __future__ import annotations
 
 import itertools
+import os
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 from ..engine.core import EngineConfig
@@ -42,6 +44,7 @@ from .tcp import RpcNode
 
 __all__ = [
     "ERR_WRONG_LEADER",
+    "SplitPersistence",
     "SplitKVService",
     "SplitNetClerk",
     "serve_split_kv",
@@ -50,6 +53,242 @@ __all__ = [
 ERR_WRONG_LEADER = "ErrWrongLeader"
 
 _OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
+
+# Raft columns a split process must persist for its owned slots (the
+# reference's Persister contract — term/vote/log survive a crash,
+# raft/persister.go — at engine-slice granularity).
+_RAFT_COLS = ("term", "voted_for", "base", "base_term", "log_len",
+              "log_term")
+
+
+class SplitPersistence:
+    """Per-process durability for split-group peers: safe crash +
+    REJOIN under the same peer identity.
+
+    Raft's persistence rules, mapped to the slab-exchange runtime: a
+    peer must never emit a message reflecting state it could forget —
+    a forgotten term/vote double-votes, a forgotten acked log entry
+    un-commits acknowledged writes.  Slabs leave once per pump, so the
+    whole contract collapses to ONE invariant: **fsync the owned
+    slots' raft slice before this pump's slabs are extracted/sent**
+    (``SplitKVService._pump_loop`` orders pump → ``after_pump()`` →
+    extract/send).  A crash between append and fsync tears the tail
+    record — and no slab for that pump was sent, so the restored
+    (previous-pump) state is exactly what the world saw.
+
+    On disk: an atomic SNAPSHOT (service state + live payload
+    candidates + raft slice; superseding) plus a WAL of per-pump
+    records — ``raft`` (full owned slice; the LAST one wins),
+    ``pay`` (new payload candidates), ``app`` (applied (g, idx, term)
+    — the service-state redo log).  Recovery = snapshot + last raft
+    record + pay union + app replay; volatile columns (role, commit,
+    applied, votes, timers) restart fresh, commit/applied rewound to
+    base (the restart_replica discipline — commit knowledge is
+    volatile in Raft)."""
+
+    def __init__(self, data_dir: str, kv, peering,
+                 snapshot_every_s: float = 30.0, fsync: bool = True) -> None:
+        import pickle
+
+        from .wal import WriteAheadLog
+
+        os.makedirs(data_dir, exist_ok=True)
+        self._pickle = pickle
+        self.snap_path = os.path.join(data_dir, "split.snap")
+        self.wal = WriteAheadLog(os.path.join(data_dir, "split.wal"),
+                                 fsync=fsync)
+        self.kv = kv
+        self.peering = peering
+        self.every = snapshot_every_s
+        self._last_snap = time.monotonic()
+        self._new_pays: list = []
+        self._new_apps: list = []
+        self._last_slice = None   # idle dedup: last persisted raft slice
+        self._need_snapshot = False
+        # App records carry (g, idx, term, wire|None): term >= 0 →
+        # replay resolves the candidate; term -1 (fallback apply) →
+        # the op rides IN the record so replay reproduces exactly what
+        # the live path applied, never a silent skip.
+        kv.on_applied = lambda g, idx, term, payload: (
+            self._new_apps.append((
+                g, idx, term,
+                kv.export_payload(payload)
+                if term < 0 and payload is not None else None,
+            ))
+        )
+        peering.on_candidate = lambda g, idx, term, payload: (
+            self._new_pays.append(
+                (g, idx, term, kv.export_payload(payload))
+            )
+        )
+        # An InstallSnapshot blob replaced service state whose device
+        # base jumped with it: the next after_pump MUST checkpoint
+        # before fsyncing that raft slice, or a crash in the window
+        # restores base past a service state that never saw the blob.
+        kv.on_snapshot_installed = (
+            lambda g: setattr(self, "_need_snapshot", True)
+        )
+
+    # -- write path --------------------------------------------------------
+
+    def _raft_slice(self) -> dict:
+        import jax
+        import numpy as np
+
+        st = self.kv.driver.state
+        gi = self.peering._g_index
+        out = jax.device_get(
+            {f: getattr(st, f)[gi] for f in _RAFT_COLS}
+        )
+        return {f: np.asarray(v) for f, v in out.items()}
+
+    def after_pump(self) -> None:
+        """Persist this pump's effects and fsync — called BEFORE the
+        pump's slabs are extracted/sent (the one invariant)."""
+        import numpy as np
+
+        if self._need_snapshot:
+            # Installed-snapshot service state must hit disk before the
+            # raft slice whose base jumped with it.
+            self._need_snapshot = False
+            self.snapshot()
+        slice_ = self._raft_slice()
+        if (
+            not self._new_pays
+            and not self._new_apps
+            and self._last_slice is not None
+            and all(
+                np.array_equal(slice_[f], self._last_slice[f])
+                for f in _RAFT_COLS
+            )
+        ):
+            return  # idle pump: nothing new to make durable, no fsync
+        rec = ("pump", slice_, self._new_pays, self._new_apps)
+        self._new_pays = []
+        self._new_apps = []
+        self._last_slice = slice_
+        self.wal.append(self._pickle.dumps(rec, protocol=4))
+        self.wal.sync()
+        if self.every > 0 and (
+            time.monotonic() - self._last_snap >= self.every
+        ):
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        import numpy as np
+
+        gs = self.peering.split_gs
+        blob = {
+            "svc": {
+                g: (
+                    self.kv.applied_upto[g],
+                    dict(self.kv.data[g]),
+                    dict(self.kv.sessions[g]),
+                )
+                for g in gs
+            },
+            "cands": [
+                (g, idx, term, self.kv.export_payload(p))
+                for (g, idx), by_term in self.peering._cands.items()
+                for term, p in by_term.items()
+            ],
+            "raft": self._raft_slice(),
+        }
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            self._pickle.dump(blob, f, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        dfd = os.open(os.path.dirname(self.snap_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        # A crash between replace and rotate leaves redundant WAL
+        # records — raft records supersede and app replay dedups on
+        # applied_upto, so replay is merely redundant, never wrong.
+        self.wal.rotate()
+        self._last_snap = time.monotonic()
+        for g in gs:
+            self.peering.gc_floor[g] = self.kv.applied_upto[g]
+
+    # -- recovery ----------------------------------------------------------
+
+    def load_and_install(self) -> bool:
+        """Restore the previous incarnation's persisted state into the
+        (freshly built) driver/service/peering.  Returns False when no
+        prior state exists (first boot).  Must run BEFORE the first
+        tick — pre-restore state must never act."""
+        import numpy as np
+
+        blob = None
+        if os.path.exists(self.snap_path):
+            with open(self.snap_path, "rb") as f:
+                blob = self._pickle.load(f)
+        raft = blob["raft"] if blob else None
+        pays = list(blob["cands"]) if blob else []
+        apps: list = []
+        for body in self.wal.replay():
+            kind, slice_, rec_pays, rec_apps = self._pickle.loads(body)
+            raft = slice_  # last record wins
+            pays.extend(rec_pays)
+            apps.extend(rec_apps)
+        if raft is None:
+            return False
+
+        kv, peering = self.kv, self.peering
+        drv = kv.driver
+        # 1. Device columns for OWNED slots (+ commit/applied rewound
+        #    to base; volatile leadership state stays fresh).
+        host = {
+            f: np.asarray(getattr(drv.state, f)).copy()
+            for f in _RAFT_COLS + ("commit", "applied")
+        }
+        for gi, g in enumerate(peering.split_gs):
+            for p in peering._owned[g]:
+                for f in _RAFT_COLS:
+                    host[f][g, p] = raft[f][gi, p]
+                host["commit"][g, p] = raft["base"][gi, p]
+                host["applied"][g, p] = raft["base"][gi, p]
+        import jax.numpy as jnp
+
+        drv.state = drv.state._replace(
+            **{f: jnp.asarray(v) for f, v in host.items()}
+        )
+        # 2. Service state from the snapshot.
+        if blob:
+            for g, (upto, data, sessions) in blob["svc"].items():
+                kv.data[g] = dict(data)
+                kv.sessions[g] = dict(sessions)
+                kv.applied_upto[g] = upto
+        # 3. Payload candidates (snapshot + WAL increments).
+        for g, idx, term, wire in pays:
+            payload = kv.import_payload(wire)
+            peering._cands.setdefault((g, idx), {})[term] = payload
+            if (g, idx) not in drv.payloads:
+                drv.payloads[(g, idx)] = payload
+        # 4. Service-state redo: applied entries since the snapshot,
+        #    in commit order, exact by (idx, term) — fallback applies
+        #    (term -1) carry their op in the record itself.
+        from ..engine.kv import apply_kv_op
+
+        for g, idx, term, wire in apps:
+            if idx <= kv.applied_upto[g]:
+                continue  # already inside the snapshot
+            payload = None
+            if term >= 0:
+                payload = peering._cands.get((g, idx), {}).get(term)
+            elif wire is not None:
+                payload = kv.import_payload(wire)
+            if payload is not None:
+                # Same apply function as the live path (engine/kv.py)
+                # — recovery can never drift from serving semantics.
+                apply_kv_op(kv.data[g], kv.sessions[g], payload[0])
+            kv.applied_upto[g] = idx
+        for g in peering.split_gs:
+            peering.gc_floor[g] = kv.applied_upto[g]
+        return True
 
 
 class SplitKVService:
@@ -70,6 +309,7 @@ class SplitKVService:
         peering: SplitPeering,
         peer_ends: Dict[int, object],  # proc index -> TcpClientEnd
         pump_interval: float = 0.002,
+        persistence: Optional[SplitPersistence] = None,
     ) -> None:
         self.sched = sched
         self.kv = kv
@@ -78,6 +318,7 @@ class SplitKVService:
         self.G = kv.driver.cfg.G
         self._interval = pump_interval
         self._stopped = False
+        self._persist = persistence
         sched.call_soon(self._pump_loop)
 
     def stop(self) -> None:
@@ -87,6 +328,10 @@ class SplitKVService:
         if self._stopped:
             return
         self.kv.pump(1)
+        if self._persist is not None:
+            # THE persistence invariant: the pump's raft slice is
+            # fsynced before any of its slabs leave the process.
+            self._persist.after_pump()
         for proc, slab in self.peering.extract().items():
             end = self.peer_ends.get(proc)
             if end is not None:
@@ -200,6 +445,8 @@ def serve_split_kv(
     host: str = "127.0.0.1",
     seed: int = 0,
     delay_elections: int = 0,
+    data_dir: Optional[str] = None,
+    snapshot_every_s: float = 30.0,
 ) -> RpcNode:
     """Bring up one split-KV process: engine over ``G`` groups, peer
     slots placed per ``owners`` (see :class:`SplitSpec` — every process
@@ -210,7 +457,15 @@ def serve_split_kv(
     leadership (tests park leaders on a chosen process; a real rollout
     can spread them).  Readiness prints before leaders exist: elections
     converge once the peers are up, and clerks retry ErrWrongLeader
-    until then."""
+    until then.
+
+    With ``data_dir`` the process is DURABLE under its peer identity
+    (:class:`SplitPersistence`): a kill -9'd process may be restarted
+    on the same dir and REJOINS the cluster safely — its persisted
+    term/vote/log make double-votes and acked-entry loss impossible
+    (the reference's Persister-carryover crash model,
+    raft/config.go:113-142).  Without it, a killed process must stay
+    dead (fresh state under an old identity can double-vote)."""
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
 
@@ -224,6 +479,13 @@ def serve_split_kv(
                 int(g): list(o) for g, o in owners.items()
             })
         )
+        persist = None
+        if data_dir is not None:
+            persist = SplitPersistence(
+                data_dir, kv, peering, snapshot_every_s=snapshot_every_s
+            )
+            # BEFORE any tick: pre-restore state must never act.
+            persist.load_and_install()
         if delay_elections:
             driver.state = driver.state._replace(
                 elect_dl=driver.state.elect_dl + int(delay_elections)
@@ -238,7 +500,8 @@ def serve_split_kv(
             for p, (h, pt) in peer_addrs.items()
             if int(p) != me
         }
-        return SplitKVService(sched, kv, peering, ends)
+        return SplitKVService(sched, kv, peering, ends,
+                              persistence=persist)
 
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("SplitKV", svc)

@@ -24,7 +24,7 @@ from ..porcupine.model import Operation
 from .frontier import FrontierService
 from .host import EngineDriver
 
-__all__ = ["KVOp", "Ticket", "BatchedKV"]
+__all__ = ["KVOp", "Ticket", "BatchedKV", "apply_kv_op"]
 
 
 @dataclasses.dataclass
@@ -40,6 +40,32 @@ class KVOp:
     # firehose and in-process tests).
     client_id: int = 0
     command_id: int = 0
+
+
+def apply_kv_op(kv: Dict[str, str], sessions: Dict[int, int], op: KVOp):
+    """The kvraft apply semantics (dup-check + mutate + session
+    update) as one shared function — the live apply path and the
+    split-persistence recovery replay both use it, so the two can
+    never drift (reference: kvraft/server.go:98-128).  Returns
+    ``(output, dup)``."""
+    dup = (
+        op.op != OP_GET
+        and op.command_id > 0
+        and sessions.get(op.client_id, 0) >= op.command_id
+    )
+    if op.op == OP_GET:
+        out = kv.get(op.key, "")
+    elif dup:
+        out = ""  # duplicate write: resolve, skip the apply
+    elif op.op == OP_PUT:
+        kv[op.key] = op.value
+        out = ""
+    else:
+        kv[op.key] = kv.get(op.key, "") + op.value
+        out = ""
+    if op.op != OP_GET and op.command_id > 0 and not dup:
+        sessions[op.client_id] = op.command_id
+    return out, dup
 
 
 @dataclasses.dataclass
@@ -150,24 +176,8 @@ class BatchedKV(FrontierService):
         if payload is None:
             return  # command lost to a leader change before binding
         op, ticket = payload
-        kv = self.data[g]
-        dup = (
-            op.op != OP_GET
-            and op.command_id > 0
-            and self.sessions[g].get(op.client_id, 0) >= op.command_id
-        )
-        if op.op == OP_GET:
-            out = kv.get(op.key, "")
-        elif dup:
-            out = ""  # duplicate write: resolve the ticket, skip the apply
-        elif op.op == OP_PUT:
-            kv[op.key] = op.value
-            out = ""
-        else:
-            kv[op.key] = kv.get(op.key, "") + op.value
-            out = ""
+        out, dup = apply_kv_op(self.data[g], self.sessions[g], op)
         if op.op != OP_GET and op.command_id > 0 and not dup:
-            self.sessions[g][op.client_id] = op.command_id
             if self.on_write is not None:
                 self.on_write(g, op)
         if ticket is not None and not ticket.done:

@@ -45,13 +45,18 @@ exactly its owned slots; if the surviving processes hold a quorum of a
 group, the group keeps electing and committing, and every acknowledged
 write is intact from replication alone — no WAL replay.
 
-Known limitation (documented, deliberate): a killed process must NOT be
-restarted with fresh state under the same peer identity — a Raft peer
-that forgets its term/vote can double-vote (the reference always
-carries the Persister across restarts, raft/config.go:113-142).
-Re-seating a lost process requires either per-process persistence of
-its slots' term/vote/log or a membership change; both are future work —
-the deliverable here is that the *surviving* quorum needs neither.
+Crash model: a killed process must NOT be restarted with FRESH state
+under the same peer identity — a Raft peer that forgets its term/vote
+can double-vote (the reference always carries the Persister across
+restarts, raft/config.go:113-142).  Two supported modes:
+
+* non-durable — a lost process stays lost; the surviving quorum keeps
+  the group available with every acked write intact;
+* durable (``distributed/split_server.SplitPersistence``) — each
+  process fsyncs its owned slots' term/vote/log BEFORE each pump's
+  slabs leave, so kill -9 + restart on the same data_dir REJOINS
+  safely (the Persister-carryover crash model, at engine-slice
+  granularity).
 
 This is the fault-tolerance serving path, not the 100k-group bench
 path: slab extraction costs one small host readback per tick, so split
@@ -154,6 +159,14 @@ class SplitPeering:
         # still sees bindings.
         self._cands: Dict[Tuple[int, int], Dict[int, Any]] = {}
         driver.on_payload_bound = self._on_local_bound
+        # Persistence hook (distributed/split_server.SplitPersistence):
+        # fired for every NEW candidate — (g, idx, term, payload) —
+        # so the WAL can re-materialize commands on restart.
+        self.on_candidate = None
+        # Extra GC floor per group (the persistence snapshot frontier):
+        # candidates above the ring floor may still be needed to replay
+        # service state from the last snapshot.
+        self.gc_floor: Dict[int, int] = {}
         # Mask remote slots dead BEFORE any tick: they belong to peers.
         alive = np.asarray(driver.state.alive).copy()
         for g in self.split_gs:
@@ -171,9 +184,11 @@ class SplitPeering:
 
     def _on_local_bound(self, g: int, idx: int, term: int) -> None:
         if g in self.spec.owners:
-            self._cands.setdefault((g, idx), {})[term] = (
-                self.driver.payloads[(g, idx)]
-            )
+            payload = self.driver.payloads[(g, idx)]
+            cands = self._cands.setdefault((g, idx), {})
+            if term not in cands and self.on_candidate is not None:
+                self.on_candidate(g, idx, term, payload)
+            cands[term] = payload
 
     def _ring_view(self):
         """Host copy of (log_term, base, base_term, commit) for the
@@ -206,19 +221,26 @@ class SplitPeering:
         return None  # not committed at any owned replica yet
 
     def resolve(self, g: int, idx: int, fallback: Any) -> Any:
-        """Payload to apply for committed ``(g, idx)``: the candidate
-        whose term matches the device's committed entry.  Falls back to
-        the representative binding when no candidates were tracked
-        (non-split group, or a payload that arrived without churn)."""
+        """Payload to apply for committed ``(g, idx)`` — see
+        :meth:`resolve_with_term`."""
+        return self.resolve_with_term(g, idx, fallback)[0]
+
+    def resolve_with_term(self, g: int, idx: int, fallback: Any):
+        """(payload, term) to apply for committed ``(g, idx)``: the
+        candidate whose term matches the device's committed entry.
+        Falls back to the representative binding (term None) when no
+        candidates were tracked (non-split group, or a payload that
+        arrived without churn)."""
         cands = self._cands.get((g, idx))
         if not cands:
-            return fallback
+            return fallback, None
         if len(cands) == 1:
-            return next(iter(cands.values()))
+            term, payload = next(iter(cands.items()))
+            return payload, term
         term = self.committed_term(g, idx)
         if term is not None and term in cands:
-            return cands[term]
-        return fallback
+            return cands[term], term
+        return fallback, None
 
     # -- outbound ---------------------------------------------------------
 
@@ -305,6 +327,8 @@ class SplitPeering:
             cands = self._cands.setdefault((g, idx), {})
             if term not in cands:
                 cands[term] = self.service.import_payload(wire)
+                if self.on_candidate is not None:
+                    self.on_candidate(g, idx, term, cands[term])
             if (g, idx) not in self.driver.payloads:
                 # Representative for the base machinery; resolve()
                 # picks the term-correct candidate at apply time.
@@ -343,6 +367,9 @@ class SplitPeering:
         st = self.driver.np_state()
         for g in self.split_gs:
             floor = int(min(st["base"][g, p] for p in self._owned[g]))
+            # Persistence holds candidates back to its snapshot
+            # frontier (service-state replay needs their commands).
+            floor = min(floor, self.gc_floor.get(g, floor))
             self._drop_below(g, floor, evict=False)
 
     def _drop_below(self, g: int, floor: int, evict: bool = True) -> None:
@@ -399,6 +426,13 @@ class SplitKV(BatchedKV):
         self.retain_payloads = True
         self.peering: Optional[SplitPeering] = None  # set by SplitPeering
         self._flush_countdown = 16
+        # Persistence hooks.  on_applied: (g, idx, term, payload) for
+        # every applied entry of a split group (term -1 = fallback
+        # apply; the payload itself then carries the op for the WAL) —
+        # the service-state redo log.  on_snapshot_installed: a peer's
+        # InstallSnapshot blob just replaced group state.
+        self.on_applied = None
+        self.on_snapshot_installed = None
 
     # -- wire adapters (used by SplitPeering) ------------------------------
 
@@ -428,12 +462,24 @@ class SplitKV(BatchedKV):
         self.data[g] = dict(blob["data"])
         self.sessions[g] = dict(blob["sessions"])
         self.applied_upto[g] = upto
+        if self.on_snapshot_installed is not None:
+            # Persistence must capture this state before the next
+            # pump's raft slice (whose base jumped with it) is fsynced
+            # — else a crash in the window restores base past a service
+            # state that never saw the blob.
+            self.on_snapshot_installed(g)
 
     # -- apply: term-arbitrated payload choice ------------------------------
 
     def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
         if self.peering is not None and g in self.peering.spec.owners:
-            payload = self.peering.resolve(g, idx, payload)
+            payload, term = self.peering.resolve_with_term(g, idx, payload)
+            super()._apply(g, idx, payload, now)
+            if self.on_applied is not None:
+                self.on_applied(
+                    g, idx, -1 if term is None else term, payload
+                )
+            return
         super()._apply(g, idx, payload, now)
 
     def _pre_sweep(self) -> None:

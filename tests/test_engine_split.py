@@ -25,9 +25,12 @@ from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET, OP_PUT
 
 
 class Side:
-    """One 'process': driver + service + peering."""
+    """One 'process': driver + service + peering (+ opt. persistence)."""
 
-    def __init__(self, me, owners, G, seed, delay_elections=0):
+    def __init__(self, me, owners, G, seed, delay_elections=0,
+                 data_dir=None):
+        from multiraft_tpu.distributed.split_server import SplitPersistence
+
         cfg = EngineConfig(G=G, P=3, L=32, E=8, INGEST=8,
                            host_paced_compaction=True)
         self.driver = EngineDriver(cfg, seed=seed)
@@ -35,6 +38,13 @@ class Side:
         self.peering = SplitPeering(
             self.driver, self.kv, SplitSpec(me=me, owners=owners)
         )
+        self.persist = None
+        if data_dir is not None:
+            self.persist = SplitPersistence(
+                data_dir, self.kv, self.peering,
+                snapshot_every_s=0.0, fsync=False,
+            )
+            self.persist.load_and_install()
         self.me = me
         self.alive = True
         if delay_elections:
@@ -55,14 +65,18 @@ def make_pair(owners, G=2, delay_on=None, delay=200):
 
 
 def pump(sides, rounds=1, cut=False):
-    """One round = each live side ticks once, then its boundary slabs
-    are delivered to the other live side (``cut`` drops them all — a
-    full partition between the processes)."""
+    """One round = each live side ticks once, persists (when durable),
+    then its boundary slabs are delivered to the other live side
+    (``cut`` drops them all — a full partition between the
+    processes).  Persist-before-send is the production invariant
+    (SplitKVService._pump_loop)."""
     for _ in range(rounds):
         for side in sides:
             if not side.alive:
                 continue
             side.kv.pump(1)
+            if side.persist is not None:
+                side.persist.after_pump()
             slabs = side.peering.extract()
             if cut:
                 continue
@@ -222,6 +236,100 @@ def test_submit_local_rejects_non_leader_process():
     assert follower.kv.submit_local(
         0, KVOp(op=OP_PUT, key="x", value="y")
     ) is None
+
+
+def test_split_persistence_crash_and_rejoin():
+    """The reference's full per-server crash model (Persister
+    carryover, raft/config.go:113-142) for split peers: a killed
+    process RESTARTS from its persisted term/vote/log under the same
+    peer identity, rejoins, catches up, and the group serves on — with
+    writes acked both before the crash and during the outage intact.
+    The restored term/vote also make the double-vote hazard of a
+    fresh-state restart impossible (persist-before-send invariant)."""
+    import tempfile
+
+    dirs = [tempfile.mkdtemp(prefix=f"splitp{i}-") for i in range(2)]
+    owners = {0: [0, 1, 1], 1: [1, 0, 0]}
+    sides = [
+        Side(0, owners, 2, seed=11, data_dir=dirs[0]),
+        Side(1, owners, 2, seed=22, data_dir=dirs[1], delay_elections=200),
+    ]
+    settle_leaders(sides, G=2)
+    for i in range(4):
+        for g in (0, 1):
+            run_op(sides, g, KVOp(op=OP_APPEND, key="k", value=f"[a{i}]"))
+
+    # CRASH side 0 (leader of group 1 by majority; minority of group 0).
+    sides[0].alive = False
+    # Group 0 fails over to side 1's quorum and keeps going; group 1
+    # has lost its quorum (side 0 owned 2 of 3) and stalls — correctly.
+    for _ in range(600):
+        pump(sides, 1)
+        if sides[1].kv.local_leader(0) is not None:
+            break
+    during = []
+    for i in range(3):
+        run_op(sides, 0, KVOp(op=OP_APPEND, key="k", value=f"[b{i}]"))
+        during.append(f"[b{i}]")
+
+    # RESTART side 0 from its data_dir (fresh driver, persisted state).
+    sides[0] = Side(0, owners, 2, seed=33, data_dir=dirs[0])
+    # It rejoins: group 1 regains quorum and elects; group 0's restored
+    # replica catches up from the current leader.
+    settle_leaders(sides, G=2, max_rounds=800)
+    run_op(sides, 0, KVOp(op=OP_APPEND, key="k", value="[post0]"))
+    run_op(sides, 1, KVOp(op=OP_APPEND, key="k", value="[post1]"))
+    for _ in range(200):
+        pump(sides, 1)
+        if all(
+            sides[0].kv.data[g] == sides[1].kv.data[g] for g in (0, 1)
+        ):
+            break
+    want0 = "".join(f"[a{i}]" for i in range(4)) + "".join(during) + "[post0]"
+    want1 = "".join(f"[a{i}]" for i in range(4)) + "[post1]"
+    assert sides[1].kv.data[0]["k"] == want0, sides[1].kv.data[0]
+    assert sides[0].kv.data[0]["k"] == want0, (
+        "restarted side did not converge on group 0"
+    )
+    assert sides[0].kv.data[1]["k"] == want1, (
+        "writes lost across the crash of group 1's majority owner"
+    )
+    assert sides[1].kv.data[1]["k"] == want1
+
+
+def test_split_persistence_restores_term_and_vote():
+    """Directly verify the Persister contract: after a crash, the
+    restored owned slots carry their pre-crash term and log — not
+    fresh state (a term-0 rebirth is exactly the double-vote
+    hazard)."""
+    import tempfile
+
+    import numpy as np
+
+    d = tempfile.mkdtemp(prefix="splitpv-")
+    owners = {0: [0, 1, 1]}
+    sides = [
+        Side(0, owners, 1, seed=5, data_dir=d),
+        Side(1, owners, 1, seed=6, delay_elections=200),
+    ]
+    settle_leaders(sides, G=1)
+    run_op(sides, 0, KVOp(op=OP_PUT, key="k", value="v"))
+    pump(sides, 5)
+    st_before = {
+        f: np.asarray(getattr(sides[0].driver.state, f))[0, 0]
+        for f in ("term", "voted_for", "log_len", "base")
+    }
+    assert int(st_before["term"]) > 0
+
+    revived = Side(0, owners, 1, seed=99, data_dir=d)
+    st_after = {
+        f: np.asarray(getattr(revived.driver.state, f))[0, 0]
+        for f in ("term", "voted_for", "log_len", "base")
+    }
+    for f, v in st_before.items():
+        assert int(st_after[f]) == int(v), (
+            f"{f} not restored: {st_after[f]} != {v}"
+        )
 
 
 def test_lost_leadership_flushes_foreign_backlog():

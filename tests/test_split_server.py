@@ -70,6 +70,56 @@ class TestSplitProcessCluster:
         finally:
             cluster.shutdown()
 
+    def test_durable_kill9_restart_rejoins(self, tmp_path):
+        """The full reference crash model over sockets: a SIGKILLed
+        split process restarts from its data_dir (persisted term/vote/
+        log — SplitPersistence) and REJOINS under its peer identity.
+        Acked writes from before the crash, during the outage, and
+        after the rejoin all survive; then the OTHER process (the
+        majority owner) is killed and restarted too — every
+        acknowledged write intact across both crash/restart cycles."""
+        from multiraft_tpu.distributed.cluster import SplitProcessCluster
+
+        G = 2
+        owners = {g: [0, 1, 1] for g in range(G)}
+        cluster = SplitProcessCluster(
+            owners, n_procs=2, groups=G,
+            delay_elections=[0, 300],
+            data_dir=str(tmp_path / "durable-split"),
+            snapshot_every_s=5.0,
+        )
+        try:
+            cluster.start_all()
+            clerk = cluster.clerk()
+            acked = {f"k{i}": [] for i in range(4)}
+
+            def load(tag, rounds):
+                for r in range(rounds):
+                    for k in acked:
+                        piece = f"[{tag}{r}]"
+                        clerk.append(k, piece, timeout=60.0)
+                        acked[k].append(piece)
+
+            load("a", 2)
+            cluster.kill(0)   # minority owner (held the leaders)
+            load("b", 2)      # survivors keep serving
+            cluster.start(0)  # REJOIN from persisted state
+            load("c", 2)
+
+            cluster.kill(1)   # majority owner: groups stall...
+            cluster.start(1)  # ...and recover on restart
+            load("d", 2)
+
+            for k, pieces in acked.items():
+                got = clerk.get(k, timeout=60.0)
+                assert got == "".join(pieces), (
+                    f"{k}: diverged across crash/restart cycles: "
+                    f"{got!r} != {''.join(pieces)!r}"
+                )
+            clerk.close()
+        finally:
+            cluster.shutdown()
+
     def test_kill9_majority_owner_stalls_until_nothing_lost(self):
         """Sanity inverse: killing the MAJORITY owner (2 of 3 slots)
         must stall the groups (no quorum — correctness over
