@@ -583,6 +583,13 @@ class SplitProcessCluster:
         _check_ready(self.procs[i], f"split-{i}", timeout=300.0)
 
     def start_all(self) -> None:
+        # Same double-vote guard as start(): relaunching a previously
+        # killed member with fresh state is only safe in durable mode.
+        assert self.durable or not self._killed, (
+            f"processes {sorted(self._killed)} were killed; a "
+            "non-durable split peer must stay dead (pass data_dir= "
+            "for safe rejoin)"
+        )
         for i, spec in enumerate(self.specs):
             self.procs[i] = _launch_server(spec, f"split-{i}")
         for i, p in enumerate(self.procs):

@@ -51,6 +51,7 @@ __all__ = [
     "EngineShardKVService",
     "EngineClerk",
     "PipelinedClerk",
+    "PipelinedFleetClerk",
     "EngineShardNetClerk",
     "EngineFleetClerk",
     "serve_engine_kv",
@@ -144,6 +145,27 @@ def route_group(key: str, G: int) -> int:
     """Deterministic key→group routing shared by every process (a
     stable hash — Python's builtin is salted per process)."""
     return zlib.crc32(key.encode()) % G
+
+
+def _await_frame_synced(sched, dur, write_seqs, ok, args_list, deadline):
+    """Durable frame-ack gate shared by both services' ``batch``
+    handlers (yield-from inside the handler generator): every write in
+    ``ok`` must have its apply-time WAL record fsynced before it may
+    ack OK; at the deadline, unsynced writes are DROPPED from ``ok``
+    (they answer ErrTimeout — never a false durable ack)."""
+    while dur is not None:
+        pend = [
+            i for i in ok
+            if (s := write_seqs.get(
+                (args_list[i].client_id, args_list[i].command_id)
+            )) is not None and not dur.synced(s)
+        ]
+        if not pend:
+            break
+        if sched.now >= deadline:
+            ok -= set(pend)
+            break
+        yield 0.002
 
 
 def _make_mesh(n_devices: int):
@@ -379,25 +401,13 @@ class EngineKVService:
                 i: t for i, t in tickets.items()
                 if t.done and not t.failed
             }
-            # Durable mode: one group fsync covers the whole frame —
-            # a write acks OK only once its apply-time WAL record is
-            # synced (like command(); an unsynced write at the
-            # deadline answers ErrTimeout, never a false durable ack).
+            # Durable mode: one group fsync covers the whole frame
+            # (shared gate — see _await_frame_synced).
             synced_ok = set(tickets)
-            while self._dur is not None:
-                pending = [
-                    i for i in synced_ok
-                    if (s := self._write_seqs.get(
-                        (args_list[i].client_id,
-                         args_list[i].command_id)
-                    )) is not None and not self._dur.synced(s)
-                ]
-                if not pending:
-                    break
-                if self.sched.now >= deadline:
-                    synced_ok -= set(pending)
-                    break
-                yield 0.002
+            yield from _await_frame_synced(
+                self.sched, self._dur, self._write_seqs, synced_ok,
+                args_list, deadline,
+            )
             for i, a in enumerate(args_list):
                 if a.op == "Get":
                     replies[i] = EngineCmdReply(
@@ -889,6 +899,117 @@ class EngineShardKVService:
             sh.data[key] = sh.data.get(key, "") + value
         sh.latest[cid] = cmd
 
+    # Largest multi-op frame one RPC may carry (see EngineKVService).
+    MAX_BATCH = 1024
+
+    def batch(self, args_list):
+        """Multi-op frame for the SHARDED service.  Chains key on
+        (client, shard) — a shard's dedup table travels with it and
+        same-key ops share a shard — and run STRICTLY one op in flight
+        each, the reference clerk's serial discipline
+        (shardkv/client.go:68-129): pipelining within a chain is
+        unsafe here because an away-and-back shard migration can let a
+        later op apply while an earlier one bounced ErrWrongGroup, and
+        the earlier op's retry then dedup-swallows into a false OK.
+        The frame's parallelism comes from chains to DIFFERENT shards
+        pipelining freely.  In fleet mode, ops whose shard a peer
+        process owns answer ErrWrongGroup per-op so the fleet clerk
+        re-frames them to the owner."""
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        if len(args_list) > self.MAX_BATCH:
+            return [
+                EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
+            ] * len(args_list)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            replies = [None] * len(args_list)
+            chains: dict = {}
+            for i, a in enumerate(args_list):
+                if a.op == "Get":
+                    continue
+                chains.setdefault(
+                    (a.client_id, key2shard(a.key)), []
+                ).append(i)
+
+            def submit(a):
+                cfg = self.skv.query_latest()
+                gid = cfg.shards[key2shard(a.key)]
+                if gid not in self.skv.reps:
+                    return None  # peer-owned (or unassigned) shard
+                return self.skv.submit(
+                    gid, a.op, a.key, a.value,
+                    client_id=a.client_id, command_id=a.command_id,
+                )
+
+            tickets: dict = {}   # frame idx -> resolved-OK ticket
+            wrong: set = set()   # frame idx -> answer ErrWrongGroup
+            heads: dict = {}     # chain -> (frame idx, live ticket)
+            cursor = {qk: 0 for qk in chains}
+            pending = set(chains)
+            while pending and self.sched.now < deadline:
+                progressed = False
+                for qk in list(pending):
+                    members = chains[qk]
+                    if qk not in heads:
+                        i = members[cursor[qk]]
+                        t = submit(args_list[i])
+                        if t is None:
+                            if self._fleet:
+                                # Peer-owned: the whole remaining chain
+                                # belongs to that peer — punt it.
+                                for j in members[cursor[qk]:]:
+                                    wrong.add(j)
+                                pending.discard(qk)
+                                progressed = True
+                            continue  # non-fleet: config moving; wait
+                        heads[qk] = (i, t)
+                        continue
+                    i, t = heads[qk]
+                    if not t.done:
+                        continue
+                    del heads[qk]
+                    if t.failed or t.err == ERR_WRONG_GROUP:
+                        continue  # resubmit next round (dedup-safe)
+                    tickets[i] = t
+                    cursor[qk] += 1
+                    progressed = True
+                    if cursor[qk] >= len(members):
+                        pending.discard(qk)
+                if pending and not progressed:
+                    yield 0.002
+            # Durable frame ack (shared gate — see _await_frame_synced).
+            ok = {
+                i for i, t in tickets.items()
+                if t.done and not t.failed and t.err == OK
+            }
+            yield from _await_frame_synced(
+                self.sched, self._dur, self._write_seqs, ok,
+                args_list, deadline,
+            )
+            for i, a in enumerate(args_list):
+                if a.op == "Get":
+                    t = self.skv.get_fast(a.key)
+                    if t.err == ERR_WRONG_GROUP:
+                        replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
+                    else:
+                        replies[i] = EngineCmdReply(
+                            err=OK, value=t.value if t.err == OK else ""
+                        )
+                elif i in wrong:
+                    replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
+                elif i in ok:
+                    replies[i] = EngineCmdReply(
+                        err=OK, value=tickets[i].value
+                    )
+                else:
+                    replies[i] = EngineCmdReply(err=ERR_TIMEOUT)
+            return replies
+
+        return run()
+
     def command(self, args: EngineCmdArgs):
         from ..engine.shardkv import ERR_WRONG_GROUP
         from ..services.shardkv import key2shard
@@ -1155,6 +1276,80 @@ class EngineFleetClerk:
 
     def append(self, key: str, value: str):
         return self._command("Append", key, value)
+
+
+class PipelinedFleetClerk(EngineFleetClerk):
+    """Multi-op frames over a sharded fleet: each round partitions the
+    remaining ops by owning process (key→shard→gid→end from the
+    replicated config) and ships one ``batch`` frame per process; ops
+    answered ErrWrongGroup (shard mid-migration / stale routing)
+    re-frame to the new owner next round.  Order safety: a frame's
+    chains fully resolve server-side before it answers, so re-framed
+    retries can never interleave with in-flight ops."""
+
+    def run_batch(self, ops):
+        """ops = [(op, key, value), ...] → list of values in order."""
+        from ..services.shardkv import key2shard
+
+        frame_args = []
+        for op, key, value in ops:
+            if op != "Get":
+                self.command_id += 1
+            frame_args.append(
+                EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=self.client_id,
+                    command_id=self.command_id,
+                )
+            )
+        results = [None] * len(ops)
+        todo = list(range(len(ops)))
+        while todo:
+            cfg = self._cfg
+            if cfg is None:
+                cfg = yield from self._refresh_config()
+            by_end: dict = {}
+            unrouted = []
+            for i in todo:
+                gid = cfg[1][key2shard(frame_args[i].key)]
+                end = self.ends.get(gid)
+                if end is None:
+                    unrouted.append(i)
+                else:
+                    by_end.setdefault(end, []).append(i)
+            retry = list(unrouted)
+            # Dispatch every process's frames FIRST (split at the
+            # server's cap — retrying an oversized frame would spin
+            # forever), then collect: wall-clock is the slowest frame,
+            # not the sum.
+            flights = []
+            for end, idxs in by_end.items():
+                for s in range(0, len(idxs), PipelinedClerk.MAX_FRAME):
+                    part = idxs[s:s + PipelinedClerk.MAX_FRAME]
+                    flights.append((part, end.call(
+                        "EngineShardKV.batch",
+                        [frame_args[i] for i in part],
+                    )))
+            for part, fut in flights:
+                reply = yield self.sched.with_timeout(fut, 10.0)
+                if reply is None or reply is TIMEOUT:
+                    retry.extend(part)
+                    continue
+                if any(
+                    r.err.startswith("ErrBatchTooLarge") for r in reply
+                ):
+                    # Permanent: the server's cap shrank below ours.
+                    raise ValueError(reply[0].err)
+                for i, r in zip(part, reply):
+                    if r.err == OK:
+                        results[i] = r.value
+                    else:
+                        retry.append(i)
+            todo = sorted(retry)
+            if todo:
+                self._cfg = None  # routing moved: re-query
+                yield self.sched.sleep(0.02)
+        return results
 
 
 def serve_engine_kv(

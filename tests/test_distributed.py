@@ -813,6 +813,66 @@ def test_engine_kv_batch_frames_durable(tmp_path):
 
 
 @needs_native
+def test_engine_fleet_batch_frames():
+    """Multi-op frames on the SHARDED fleet: one run_batch spans keys
+    owned by both processes (the clerk partitions by config and ships
+    one frame per process), values verified, and a routing change
+    between frames (join) re-partitions the next batch correctly.
+    Chains run serially per (client, shard) — the reference clerk's
+    discipline — so a frame replay under the same ids stays
+    exactly-once."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+    from multiraft_tpu.distributed.engine_server import PipelinedFleetClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    fleet = EngineFleetCluster([[1], [2]], seed=47)
+    cli = None
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        cli = RpcNode()
+        sched = cli.sched
+        ends = {
+            g: cli.client_end(*addr)
+            for g, addr in fleet.owner_addrs.items()
+        }
+        ck = PipelinedFleetClerk(sched, ends)
+
+        keys = [chr(97 + i) for i in range(12)]
+        ops = [("Append", k, f"<{j}>") for j, k in enumerate(keys)]
+        ops += [("Get", k, "") for k in keys]
+        vals = sched.wait(sched.spawn(ck.run_batch(ops)), 120.0)
+        assert vals is not TIMEOUT
+        assert vals[len(keys):] == [f"<{j}>" for j in range(len(keys))]
+
+        # Routing change: gid 2 joins, ~half the shards migrate to
+        # process 1; the next batch re-partitions against the new
+        # config (frames bounce ErrWrongGroup until migration lands,
+        # then re-route).
+        fleet.admin("join", [2])
+        ops2 = [("Append", k, f"[{j}]") for j, k in enumerate(keys)]
+        ops2 += [("Get", k, "") for k in keys]
+        vals2 = sched.wait(sched.spawn(ck.run_batch(ops2)), 180.0)
+        assert vals2 is not TIMEOUT
+        assert vals2[len(keys):] == [
+            f"<{j}>[{j}]" for j in range(len(keys))
+        ], vals2[len(keys):]
+
+        # Whole-batch replay under the SAME command ids: exactly-once.
+        ck.command_id -= len(keys)
+        vals3 = sched.wait(sched.spawn(ck.run_batch(ops2)), 120.0)
+        assert vals3 is not TIMEOUT
+        assert vals3[len(keys):] == [
+            f"<{j}>[{j}]" for j in range(len(keys))
+        ], "frame replay double-applied"
+    finally:
+        if cli is not None:
+            cli.close()
+        fleet.shutdown()
+
+
+@needs_native
 def test_engine_kv_durable_restart(tmp_path):
     """kill -9 a DURABLE engine KV server; restart on the same data_dir:
     every acknowledged write survives — some via the checkpoint, the
