@@ -8,17 +8,22 @@ tick's boundary mailbox lanes (votes, appends, replies — plus entry
 payloads and snapshot blobs) ship between the processes as slabs,
 while consensus inside each chip stays zero-collective.
 
-The payoff this example demonstrates live: initial leaders are parked
-on process 0 (the MINORITY owner), a workload runs, and process 0 is
-SIGKILLed mid-session.  Process 1's two peers elect among themselves
-and keep serving — every acknowledged write intact from REPLICATION
-alone (the killed process had no disk state at all; reference analog:
-per-server crash with the rest of the cluster serving on,
-raft/config.go:113-142).
+Two acts, demonstrated live:
+
+1. Initial leaders are parked on process 0 (the MINORITY owner), a
+   workload runs, and process 0 is SIGKILLed mid-session.  Process 1's
+   two peers elect among themselves and keep serving — every
+   acknowledged write intact from REPLICATION alone.
+2. The cluster is DURABLE (SplitPersistence: each process fsyncs its
+   owned slots' term/vote/log before each pump's slabs leave), so the
+   killed process RESTARTS on its data dir and REJOINS under the same
+   peer identity — the reference's Persister-carryover crash model
+   (raft/config.go:113-142) at engine scale.
 """
 
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -30,8 +35,11 @@ def main() -> None:
     owners = {g: [0, 1, 1] for g in range(G)}  # slot 0 ↔ proc 0; 1,2 ↔ proc 1
     cluster = SplitProcessCluster(
         owners, n_procs=2, groups=G, delay_elections=[0, 300],
+        data_dir=tempfile.mkdtemp(prefix="split-demo-"),
+        snapshot_every_s=5.0,
     )
-    print("starting 2 engine processes sharing every group's peers 1/2...")
+    print("starting 2 durable engine processes sharing every group's "
+          "peers 1/2...")
     cluster.start_all()
     try:
         clerk = cluster.clerk()
@@ -51,8 +59,19 @@ def main() -> None:
             want = "".join(f"[{i}]" for i in range(12) if i % 4 == k)
             assert val == want, (k, val, want)
             print(f"  key-{k} = {val}  (every acked write intact)")
+        print("act 1 OK: process loss tolerated with zero data loss")
+
+        print("restarting process 0 from its data dir (persisted "
+              "term/vote/log -> safe rejoin)...")
+        cluster.start(0)
+        for i in range(12, 16):
+            clerk.append(f"key-{i % 4}", f"[{i}]", timeout=60.0)
+        val = clerk.get("key-0", timeout=60.0)
+        want = "".join(f"[{i}]" for i in range(16) if i % 4 == 0)
+        assert val == want, (val, want)
+        print(f"  key-0 = {val}")
         clerk.close()
-        print("OK: process loss tolerated with zero data loss, no disk")
+        print("act 2 OK: killed process rejoined under its own identity")
     finally:
         cluster.shutdown()
 

@@ -1287,8 +1287,23 @@ class PipelinedFleetClerk(EngineFleetClerk):
     chains fully resolve server-side before it answers, so re-framed
     retries can never interleave with in-flight ops."""
 
+    # Ops per sequential WINDOW.  An oversized batch must NOT split
+    # into concurrently-in-flight frames: a (client, shard) chain
+    # spanning two live frames breaks the serial-chain discipline the
+    # server's dedup safety rests on (op N+1 applying while op N is
+    # unresolved lets N's retry dedup-swallow into a false OK).  Each
+    # window fully resolves before the next ships.
+    MAX_FRAME = 1024
+
     def run_batch(self, ops):
         """ops = [(op, key, value), ...] → list of values in order."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_window(ops[s:s + self.MAX_FRAME])
+            out.extend(part)
+        return out
+
+    def _one_window(self, ops):
         from ..services.shardkv import key2shard
 
         frame_args = []
@@ -1318,18 +1333,17 @@ class PipelinedFleetClerk(EngineFleetClerk):
                 else:
                     by_end.setdefault(end, []).append(i)
             retry = list(unrouted)
-            # Dispatch every process's frames FIRST (split at the
-            # server's cap — retrying an oversized frame would spin
-            # forever), then collect: wall-clock is the slowest frame,
-            # not the sum.
-            flights = []
-            for end, idxs in by_end.items():
-                for s in range(0, len(idxs), PipelinedClerk.MAX_FRAME):
-                    part = idxs[s:s + PipelinedClerk.MAX_FRAME]
-                    flights.append((part, end.call(
-                        "EngineShardKV.batch",
-                        [frame_args[i] for i in part],
-                    )))
+            # Dispatch every process's frame FIRST, then collect:
+            # wall-clock is the slowest frame, not the sum.  (Frames
+            # are per-process partitions of one ≤MAX_FRAME window, so
+            # none can exceed the server's cap.)
+            flights = [
+                (idxs, end.call(
+                    "EngineShardKV.batch",
+                    [frame_args[i] for i in idxs],
+                ))
+                for end, idxs in by_end.items()
+            ]
             for part, fut in flights:
                 reply = yield self.sched.with_timeout(fut, 10.0)
                 if reply is None or reply is TIMEOUT:

@@ -352,6 +352,87 @@ class SplitKVService:
 
     # -- client-facing -----------------------------------------------------
 
+    MAX_BATCH = 1024
+
+    def batch(self, args_list):
+        """Multi-op frame on the split server (same chain discipline
+        as EngineKVService.batch — split groups carry plain-KV
+        semantics, so per-(client, group) chains pipeline whole, with
+        suffix-only resubmission after full-chain resolution).  A
+        group without a local leader answers ErrWrongLeader per-op;
+        the clerk re-frames those at the peer process."""
+        if len(args_list) > self.MAX_BATCH:
+            return [
+                EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
+            ] * len(args_list)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            replies = [None] * len(args_list)
+            chains: dict = {}
+            for i, a in enumerate(args_list):
+                chains.setdefault(
+                    (a.client_id, route_group(a.key, self.G)), []
+                ).append(i)
+
+            def submit(a):
+                return self.kv.submit_local(
+                    route_group(a.key, self.G),
+                    KVOp(op=_OPCODE[a.op], key=a.key, value=a.value,
+                         client_id=a.client_id, command_id=a.command_id),
+                )
+
+            tickets: dict = {}
+            wrong: set = set()
+            pending = set(chains)
+            while pending and self.sched.now < deadline:
+                progressed = False
+                for qk in list(pending):
+                    members = chains[qk]
+                    sub = [i for i in members if i in tickets]
+                    if any(not tickets[i].done for i in sub):
+                        continue  # wait for the whole chain
+                    k_bad = next(
+                        (k for k, i in enumerate(members)
+                         if i not in tickets or tickets[i].failed),
+                        None,
+                    )
+                    if k_bad is None:
+                        pending.discard(qk)
+                        progressed = True
+                        continue
+                    if self.kv.local_leader(qk[1]) is None:
+                        # The leader lives at a peer process: punt the
+                        # unresolved members to the clerk.
+                        for i in members[k_bad:]:
+                            if i not in tickets or tickets[i].failed:
+                                wrong.add(i)
+                                tickets.pop(i, None)
+                        pending.discard(qk)
+                        progressed = True
+                        continue
+                    ok = True
+                    for i in members[k_bad:]:
+                        t = submit(args_list[i])
+                        if t is None:
+                            ok = False
+                            break  # leadership just moved; re-check
+                        tickets[i] = t
+                    progressed = progressed or ok
+                if pending and not progressed:
+                    yield 0.002
+            for i, a in enumerate(args_list):
+                t = tickets.get(i)
+                if i in wrong or t is None:
+                    replies[i] = EngineCmdReply(err=ERR_WRONG_LEADER)
+                elif t.done and not t.failed:
+                    replies[i] = EngineCmdReply(err=OK, value=t.value)
+                else:
+                    replies[i] = EngineCmdReply(err=ERR_TIMEOUT)
+            return replies
+
+        return run()
+
     def command(self, args: EngineCmdArgs):
         g = route_group(args.key, self.G)
 
@@ -434,6 +515,65 @@ class SplitNetClerk:
 
     def append(self, key: str, value: str):
         return self._command("Append", key, value)
+
+    # Sequential-window cap: an oversized batch must not split a
+    # (client, group) chain across frames whose resolutions can
+    # interleave (a timed-out chain-tail op retried after a later
+    # frame applied the chain's next op dedup-swallows into a false
+    # OK).  Windows run strictly one after another.
+    MAX_FRAME = 1024
+
+    def run_batch(self, ops):
+        """Multi-op frames against the split cluster: each ≤MAX_FRAME
+        window ships whole to one process; ops answered ErrWrongLeader
+        (their group's leader lives elsewhere) re-frame to the next
+        process; a window fully resolves before the next ships.
+        Generator (spawn on the scheduler)."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_window(ops[s:s + self.MAX_FRAME])
+            out.extend(part)
+        return out
+
+    def _one_window(self, ops):
+        frame = []
+        for op, key, value in ops:
+            if op != "Get":
+                self.command_id += 1
+            frame.append(
+                EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=self.client_id,
+                    command_id=self.command_id,
+                )
+            )
+        results = [None] * len(ops)
+        todo = list(range(len(ops)))
+        i_end = 0
+        while todo:
+            end = self.ends[i_end % len(self.ends)]
+            fut: Future = end.call(
+                "SplitKV.batch", [frame[i] for i in todo]
+            )
+            reply = yield self.sched.with_timeout(fut, 10.0)
+            retry = []
+            if reply is None or reply is TIMEOUT:
+                retry = list(todo)
+            else:
+                if any(
+                    r.err.startswith("ErrBatchTooLarge") for r in reply
+                ):
+                    raise ValueError(reply[0].err)
+                for i, r in zip(todo, reply):
+                    if r.err == OK:
+                        results[i] = r.value
+                    else:
+                        retry.append(i)
+            if retry:
+                i_end += 1  # rotate: those groups lead elsewhere
+                yield self.sched.sleep(0.02)
+            todo = sorted(retry)
+        return results
 
 
 def serve_split_kv(

@@ -70,6 +70,48 @@ class TestSplitProcessCluster:
         finally:
             cluster.shutdown()
 
+    def test_batch_frames_across_processes(self):
+        """Multi-op frames on the split cluster: a frame lands on one
+        process, ops whose groups lead elsewhere bounce ErrWrongLeader
+        and re-frame to the peer — every op resolves exactly-once."""
+        from multiraft_tpu.distributed.cluster import SplitProcessCluster
+        from multiraft_tpu.distributed.split_server import SplitNetClerk
+        from multiraft_tpu.distributed.tcp import RpcNode
+        from multiraft_tpu.sim.scheduler import TIMEOUT
+
+        G = 4
+        owners = {g: [0, 1, 1] for g in range(G)}
+        cluster = SplitProcessCluster(
+            owners, n_procs=2, groups=G, delay_elections=[0, 300],
+        )
+        cli = None
+        try:
+            cluster.start_all()
+            cli = RpcNode()
+            sched = cli.sched
+            ends = [
+                cli.client_end(cluster.host, p) for p in cluster.ports
+            ]
+            ck = SplitNetClerk(sched, ends)
+            keys = [f"bk{i}" for i in range(8)]
+            ops = [("Append", k, f"<{j}>") for j, k in enumerate(keys)]
+            ops += [("Get", k, "") for k in keys]
+            vals = sched.wait(sched.spawn(ck.run_batch(ops)), 120.0)
+            assert vals is not TIMEOUT
+            assert vals[len(keys):] == [f"<{j}>" for j in range(len(keys))]
+
+            # Whole-batch replay under the same ids: exactly-once.
+            ck.command_id -= len(keys)
+            vals2 = sched.wait(sched.spawn(ck.run_batch(ops)), 120.0)
+            assert vals2 is not TIMEOUT
+            assert vals2[len(keys):] == [
+                f"<{j}>" for j in range(len(keys))
+            ], "frame replay double-applied on the split path"
+        finally:
+            if cli is not None:
+                cli.close()
+            cluster.shutdown()
+
     def test_durable_kill9_restart_rejoins(self, tmp_path):
         """The full reference crash model over sockets: a SIGKILLed
         split process restarts from its data_dir (persisted term/vote/
