@@ -6,12 +6,15 @@ over the timed chunks.  Two consumers:
 
 * :func:`latency_histogram` — the MEASURED per-entry commit-latency
   distribution, in ticks, exact for every entry committed in the
-  window.  Overlap algebra on the frontier curves: the entries
-  ingested at tick ``s`` and committed at tick ``t`` are the interval
-  intersection ``(I[s-1], I[s]] ∩ (C[t-1], C[t]]``, so a handful of
-  vectorized passes (one per latency value) count 40M+ entries
-  exactly, no per-entry loop.  This replaces the bench's former
-  3-ticks-by-assumption p99 model with data.
+  window.  Calm groups (no leader rebind) are counted by overlap
+  algebra on the frontier curves: the entries ingested at tick ``s``
+  and committed at tick ``t`` are the interval intersection
+  ``(I[s-1], I[s]] ∩ (C[t-1], C[t]]``, so a handful of vectorized
+  passes count 40M+ entries exactly, no per-entry loop.  CHURNED
+  groups (a mid-window leader change rebinds indices, breaking the
+  monotone-frontier assumption) are detected vectorized and measured
+  exactly per entry from their accept-event bindings — nothing is
+  silently dropped; the residual ``unaccounted`` count is reported.
 
 * :func:`verify_sampled_groups` — the north star's "porcupine-verified
   on sampled shards" applied to the flagship run itself (reference
@@ -19,12 +22,20 @@ over the timed chunks.  Two consumers:
   kvraft/test_test.go:365-381).  Each sampled group's operation
   history is reconstructed from what the device recorded — every
   accepted command becomes an Append whose call time is its ingest
-  tick and return time its commit tick — cross-checked against the
-  final device ring (the reconstruction must agree with the log's
-  terms, entry for entry), then checked with the same porcupine
-  checker + KV model the service tests use.  Frontier invariants
-  (commit monotone, commit ≤ ingest) are asserted over ALL groups,
-  not just the sample.
+  tick and return time its commit tick.  Leader rebinds are resolved
+  from the accept-term records: an index bound at two terms is
+  arbitrated against the final device ring where the ring still covers
+  it, and conservatively widened to its earliest binding otherwise
+  (reported, never silently skipped).  The reconstruction is
+  cross-checked entry-for-entry against the final device ring, then
+  checked with the same porcupine checker + KV model the service
+  tests use.  The first ``n_multi`` sampled groups are reconstructed
+  as MULTI-CLIENT histories — entries round-robined over ``n_clients``
+  logical clients with per-client sequential call flooring — so the
+  DFS must genuinely arbitrate the interleaving (the histories have
+  real linearization choice, not a single admissible order).
+  Frontier invariants (commit monotone, commit ≤ ingest) are asserted
+  over ALL groups, not just the sample.
 
 The records are the run's own telemetry, so this verifies the actual
 timed execution — not a separate small run standing in for it.
@@ -36,7 +47,12 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["concat_records", "latency_histogram", "verify_sampled_groups"]
+__all__ = [
+    "concat_records",
+    "detect_churn",
+    "latency_histogram",
+    "verify_sampled_groups",
+]
 
 
 def concat_records(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -71,41 +87,164 @@ def _frontiers(
     return I, C
 
 
+def detect_churn(
+    rec: Dict[str, np.ndarray], seed_last: np.ndarray
+) -> np.ndarray:
+    """bool[G]: groups where some accept window did NOT extend the
+    previous ingest frontier — a leader change rebound indices
+    mid-window.  Fully vectorized (one forward-fill over the tick
+    axis), so the 10k-group bench pays no per-group scan."""
+    ing_hi = np.asarray(rec["ing_hi"], np.int64)
+    acc = np.asarray(rec["accepted"], np.int64)
+    N, G = ing_hi.shape
+    rows = np.arange(N, dtype=np.int64)[:, None]
+    idx = np.where(acc > 0, rows, np.int64(-1))
+    last_idx = np.maximum.accumulate(idx, axis=0)
+    prev_idx = np.vstack([np.full((1, G), -1, np.int64), last_idx[:-1]])
+    prev_end = np.take_along_axis(ing_hi, np.clip(prev_idx, 0, None), axis=0)
+    prev_end = np.where(
+        prev_idx >= 0, prev_end, np.asarray(seed_last, np.int64)[None, :]
+    )
+    churn_tick = (acc > 0) & (ing_hi - acc != prev_end)
+    return churn_tick.any(axis=0)
+
+
+def _group_accepts(
+    rec: Dict[str, np.ndarray], g: int
+) -> List[Tuple[int, int, int, int]]:
+    """Group ``g``'s accept events, in tick order:
+    ``(tick, start, end, term)`` — indices ``start+1..end`` were bound
+    at ``tick`` with ``term``.  A later event overlapping an earlier
+    one is a leader rebind (the later binding supersedes unless the
+    ring proves the earlier branch won — see the arbitration in
+    :func:`verify_sampled_groups`)."""
+    acc = np.asarray(rec["accepted"], np.int64)[:, g]
+    ing = np.asarray(rec["ing_hi"], np.int64)[:, g]
+    terms = np.asarray(rec["accept_term"], np.int64)[:, g]
+    out = []
+    for t in np.nonzero(acc > 0)[0]:
+        a = int(acc[t])
+        end = int(ing[t])
+        out.append((int(t), end - a, end, int(terms[t])))
+    return out
+
+
+def _bindings_from_accepts(
+    accepts: List[Tuple[int, int, int, int]], origin: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Dense per-index binding arrays over offsets ``1..size`` from
+    ``origin`` (= the window-open commit frontier; leader completeness
+    guarantees no accept window starts below it): last binding
+    tick+term, first binding tick, and a multi-bound flag."""
+    size = max((e[2] for e in accepts), default=origin) - origin
+    size = max(size, 0)
+    bind_tick = np.full(size + 1, -1, np.int64)
+    bind_term = np.full(size + 1, -1, np.int64)
+    first_tick = np.full(size + 1, -1, np.int64)
+    multi = np.zeros(size + 1, bool)
+    for t, start, end, term in accepts:
+        lo = max(start + 1 - origin, 1)
+        hi = end - origin
+        if hi < lo:
+            continue
+        sl = slice(lo, hi + 1)
+        prev = bind_tick[sl] >= 0
+        multi[sl] |= prev & (bind_term[sl] != term)
+        np.copyto(first_tick[sl], t, where=~prev)
+        bind_tick[sl] = t
+        bind_term[sl] = term
+    return bind_tick, bind_term, first_tick, multi
+
+
+def _churned_group_latencies(
+    rec: Dict[str, np.ndarray],
+    seed_commit: np.ndarray,
+    g: int,
+    C: np.ndarray,
+) -> Tuple[np.ndarray, int, int]:
+    """Exact per-entry latencies (ticks) for a churned group: each
+    committed index's ingest tick is its LAST binding (the branch that
+    won; a superseded binding's entry was truncated and re-accepted).
+    Returns (latencies, pre_window_count, rebound_count)."""
+    origin = int(seed_commit[g])
+    accepts = _group_accepts(rec, g)
+    bind_tick, _, _, multi = _bindings_from_accepts(accepts, origin)
+    c_final = int(C[-1, g])
+    n_committed = min(c_final - origin, len(bind_tick) - 1)
+    if n_committed <= 0:
+        return np.zeros(0, np.int64), 0, 0
+    off = np.arange(1, n_committed + 1)
+    bt = bind_tick[off]
+    idxs = origin + off
+    t_c = np.searchsorted(C[:, g], idxs, side="left")
+    known = bt >= 0
+    lat = t_c[known] - bt[known]
+    # A non-positive latency is impossible for a correct binding
+    # (ingest runs after commit advance within a tick), so it marks a
+    # mis-attributed binding — drop it to ``unaccounted`` (via the
+    # caller's residual) rather than deflating the histogram.
+    lat = lat[lat >= 1]
+    pre = int((~known).sum())
+    rebound = int(multi[off][known].sum())
+    return lat, pre, rebound
+
+
 def latency_histogram(
     rec: Dict[str, np.ndarray],
     seed_last: np.ndarray,
     seed_commit: np.ndarray,
-    max_ticks: int = 64,
+    max_ticks: int = 256,
 ) -> Dict[str, object]:
     """Exact ingest→commit latency histogram (ticks) for every entry
     both ingested and committed inside the window; entries ingested
     before the window are counted separately (their ingest tick is
-    unknown) and entries still in flight at window end are excluded."""
+    unknown) and entries still in flight at window end are excluded.
+    Calm groups go through the vectorized overlap algebra; churned
+    groups (leader rebinds) are measured exactly from their accept
+    bindings — faulted runs lose no coverage."""
     I, C = _frontiers(rec, seed_last, seed_commit)
     N = I.shape[0]
     seed_last = np.asarray(seed_last, np.int64)
     seed_commit = np.asarray(seed_commit, np.int64)
-    Iprev = np.vstack([seed_last[None, :], I[:-1]])
-    Cprev = np.vstack([seed_commit[None, :], C[:-1]])
+    churned = detect_churn(rec, seed_last)
+    calm = ~churned
+    # Churned columns flattened to their seeds contribute zero to the
+    # overlap algebra; they are counted exactly below instead.
+    Ic = np.where(calm[None, :], I, seed_last[None, :])
+    Cc = np.where(calm[None, :], C, seed_commit[None, :])
+    Iprev = np.vstack([seed_last[None, :], Ic[:-1]])
+    Cprev = np.vstack([seed_commit[None, :], Cc[:-1]])
+    committed_calm = int((Cc[-1] - seed_commit).sum())
+    pre_window = int(
+        np.clip(np.minimum(Cc[-1], seed_last) - seed_commit, 0, None).sum()
+    )
     hist: Dict[int, int] = {}
+    counted = 0
+    target_calm = committed_calm - pre_window
     for k in range(1, min(max_ticks, N) + 1):
         t = np.arange(k, N)
         lo = np.maximum(Iprev[t - k], Cprev[t])
-        hi = np.minimum(I[t - k], C[t])
+        hi = np.minimum(Ic[t - k], Cc[t])
         n = int(np.clip(hi - lo, 0, None).sum())
         if n:
             hist[k] = n
+            counted += n
+        if counted >= target_calm:
+            break  # every calm in-window entry accounted — stop early
+    rebound_entries = 0
+    for g in np.nonzero(churned)[0]:
+        lat, pre, reb = _churned_group_latencies(rec, seed_commit, int(g), C)
+        pre_window += pre
+        rebound_entries += reb
+        if lat.size:
+            for k, n in zip(*np.unique(lat, return_counts=True)):
+                hist[int(k)] = hist.get(int(k), 0) + int(n)
+                counted += int(n)
     committed_total = int((C[-1] - seed_commit).sum())
-    pre_window = int(
-        np.clip(np.minimum(C[-1], seed_last) - seed_commit, 0, None).sum()
-    )
-    counted = sum(hist.values())
-    # Entries the overlap algebra could not place: latency beyond
-    # max_ticks, or groups whose leader changed mid-window (a rebind
-    # makes the running-max ingest frontier mislabel ticks).  Reported,
-    # not asserted — one churned group must not abort the whole bench
-    # after the timed chunks already ran (the sampled-group verifier
-    # reports churn explicitly).
+    # Entries the algebra could not place: latency beyond max_ticks
+    # only (churned groups are now measured exactly).  Reported, not
+    # asserted — the bench JSON surfaces it so silent coverage loss is
+    # impossible.
     unaccounted = committed_total - pre_window - counted
     total = max(counted, 1)
     cum = 0
@@ -122,6 +261,8 @@ def latency_histogram(
         "entries": counted,
         "pre_window_commits": pre_window,
         "unaccounted": int(unaccounted),
+        "churned_groups": int(churned.sum()),
+        "rebound_entries": int(rebound_entries),
         "p50_ticks": int(p50),
         "p99_ticks": int(p99),
     }
@@ -135,10 +276,20 @@ def verify_sampled_groups(
     final_state,
     cfg,
     budget_s: float = 240.0,
+    n_multi: int = 8,
+    n_clients: int = 4,
 ) -> Dict[str, object]:
     """Reconstruct each sampled group's operation history from the
     device records, cross-check it against the final device ring, and
     porcupine-check it.  Returns a summary dict for the bench JSON.
+
+    Churned groups are verified, not skipped: rebinds resolve from the
+    accept-term records (ring-arbitrated where the ring still covers
+    the index; conservatively widened to the earliest binding and
+    counted as ``ambiguous_entries`` otherwise).  The first
+    ``n_multi`` groups get multi-client histories (``n_clients``
+    logical clients, per-client sequential call flooring) so the DFS
+    must arbitrate genuinely overlapping operations.
 
     ``budget_s`` bounds the TOTAL checking wall-clock: groups not
     reached in budget report UNKNOWN (the porcupine timeout
@@ -150,9 +301,6 @@ def verify_sampled_groups(
     t_end = _time.monotonic() + budget_s
 
     I, C = _frontiers(rec, seed_last, seed_commit)
-    ing_hi = np.asarray(rec["ing_hi"], np.int64)
-    acc = np.asarray(rec["accepted"], np.int64)
-    terms = np.asarray(rec["accept_term"], np.int64)
     st = {
         "log_term": np.asarray(final_state.log_term),
         "base": np.asarray(final_state.base),
@@ -164,66 +312,105 @@ def verify_sampled_groups(
     N = I.shape[0]
     ok = 0
     unknown = 0
-    skipped_churn = 0
+    churned_groups = 0
+    ambiguous = 0
+    arbitrated = 0
     ring_checked = 0
+    multi_groups = 0
+    max_concurrency = 0
     results = []
-    for g in sample:
+    for j, g in enumerate(sample):
         if _time.monotonic() >= t_end:
             unknown += 1
             results.append((g, "budget-unknown"))
             continue
-        # Per-index (ingest tick, term) assignments from the accept
-        # records.  A tick whose accept window does not extend the
-        # previous frontier means a leader change rebound indices —
-        # possible under faults, not expected in the fault-free bench;
-        # such a group is reported, not silently mis-reconstructed.
-        entries: Dict[int, Tuple[int, int]] = {}
-        last = int(seed_last[g])
-        churn = False
-        for t in range(N):
-            a = int(acc[t, g])
-            if a == 0:
-                continue
-            start = int(ing_hi[t, g]) - a
-            if start != last:
-                churn = True
-                break
-            for off in range(a):
-                entries[start + 1 + off] = (t, int(terms[t, g]))
-            last = start + a
-        if churn:
-            skipped_churn += 1
-            results.append((g, "churn-skip"))
-            continue
+        origin = int(seed_commit[g])
+        accepts = _group_accepts(rec, g)
+        bind_tick, bind_term, first_tick, multi = _bindings_from_accepts(
+            accepts, origin
+        )
+        if multi.any():
+            churned_groups += 1
 
         # Cross-check the reconstruction against the device's own log:
-        # the final ring's window must carry exactly the terms the
-        # records predicted, entry for entry.
+        # every ring-covered bound index must carry a term the records
+        # predicted.  Where an index was bound at two terms, the ring
+        # is the arbiter — the matching binding's tick becomes the
+        # call time (figure-8 revival: the FIRST branch can win).
         p = _leader_slot(st, g)
         base = int(st["base"][g, p])
-        lo = max(base + 1, int(seed_last[g]) + 1)
-        hi = base + int(st["log_len"][g, p])
-        for idx in range(lo, hi + 1):
-            if idx in entries:
-                got = int(st["log_term"][g, p, idx % cfg.L])
-                want = entries[idx][1]
-                assert got == want, (
-                    f"group {g}: reconstructed term {want} != device "
-                    f"ring term {got} at index {idx}"
-                )
+        ring_hi = base + int(st["log_len"][g, p])
+        chosen_tick = bind_tick.copy()
+        for idx in range(max(base + 1, origin + 1), ring_hi + 1):
+            o = idx - origin
+            if o >= len(bind_tick) or bind_tick[o] < 0:
+                continue
+            got = int(st["log_term"][g, p, idx % cfg.L])
+            if got == int(bind_term[o]):
                 ring_checked += 1
+                continue
+            # Scan this index's accept events for a binding at the
+            # ring's term (arbitration among >2 bindings).
+            cand = [
+                t for (t, s_, e_, tm) in accepts
+                if s_ < idx <= e_ and tm == got
+            ]
+            assert cand, (
+                f"group {g}: no recorded binding matches device "
+                f"ring term {got} at index {idx} (reconstructed term "
+                f"{int(bind_term[o])})"
+            )
+            chosen_tick[o] = cand[-1]
+            arbitrated += 1
+            ring_checked += 1
 
-        # Build the porcupine history: window-committed appends with
-        # their real (ingest, commit) tick intervals + one final read
-        # of the window's concatenation.  Entries still in flight at
-        # window end linearize as "not taken" (excluded, and absent
-        # from the read's value) — the partial-history convention.
+        # Committed in-window entries only: pre-window commits have no
+        # recorded ingest; entries in flight at window end linearize as
+        # "not taken" (absent from the final read) — the
+        # partial-history convention.
         commit_final = int(C[-1, g])
-        idxs = [i for i in sorted(entries) if i <= commit_final]
-        t_ins = [entries[i][0] for i in idxs]
-        t_cs = np.searchsorted(C[:, g], np.asarray(idxs), side="left")
+        n_comm = min(commit_final - origin, len(bind_tick) - 1)
+        offs = [o for o in range(1, n_comm + 1) if bind_tick[o] >= 0]
+        idxs = [origin + o for o in offs]
+        # Ambiguous: multi-bound, not ring-arbitrable (compacted away)
+        # — widen the call interval to the EARLIEST binding (a larger
+        # window admits strictly more linearizations: conservative).
+        call_ticks = []
+        for o in offs:
+            idx = origin + o
+            if (
+                multi[o]
+                and not (base < idx <= ring_hi)
+                and chosen_tick[o] == bind_tick[o]
+            ):
+                call_ticks.append(int(first_tick[o]))
+                ambiguous += 1
+            else:
+                call_ticks.append(int(chosen_tick[o]))
+        t_cs = np.searchsorted(C[:, g], np.asarray(idxs, np.int64), "left")
+        calls = np.asarray(call_ticks, np.float64)
+        rets = np.asarray(t_cs, np.float64) + 0.5
+
+        # Multi-client reconstruction: round-robin entries over logical
+        # clients; per-client sequentiality is enforced by flooring each
+        # op's call at its predecessor's return (the floored call is
+        # within the true in-flight window, so admissible
+        # linearizations only shrink — conservative).  The client count
+        # must exceed the largest same-tick commit batch: ops committing
+        # the same tick share a return time, so consecutive SAME-client
+        # ops must land in different batches for the floor to stay
+        # below the op's own return.  Different clients within a batch
+        # still fully overlap — the DFS arbitrates their order.
+        if j < n_multi and len(t_cs):
+            _, batch_sizes = np.unique(t_cs, return_counts=True)
+            k_eff = max(n_clients, int(batch_sizes.max()) + 1)
+            if len(idxs) > k_eff:
+                multi_groups += 1
+                for i in range(k_eff, len(idxs)):
+                    calls[i] = max(calls[i], rets[i - k_eff] + 0.25)
         remaining = max(t_end - _time.monotonic(), 1.0)
-        verdict = _check_group_history(idxs, t_ins, t_cs, g, N, remaining)
+        verdict, conc = _check_group_history(idxs, calls, rets, g, N, remaining)
+        max_concurrency = max(max_concurrency, conc)
         results.append((g, verdict.name))
         if verdict == CheckResult.ILLEGAL:
             return {
@@ -241,21 +428,25 @@ def verify_sampled_groups(
         "sampled_groups": len(sample),
         "groups_ok": ok,
         "groups_unknown": unknown,
-        "groups_churn_skipped": skipped_churn,
+        "groups_churned": churned_groups,
+        "ambiguous_entries": ambiguous,
+        "ring_arbitrated_entries": arbitrated,
         "ring_entries_crosschecked": ring_checked,
+        "multi_client_groups": multi_groups,
+        "multi_client_clients": n_clients,
+        "max_concurrency": max_concurrency,
     }
 
 
-def _check_group_history(idxs, t_ins, t_cs, g, N, timeout_s):
+def _check_group_history(idxs, calls, rets, g, N, timeout_s):
     """Linearizability check of one reconstructed group history.
+    ``calls``/``rets`` are per-op float times (already floored /
+    widened by the caller).  Returns (verdict, max_concurrency).
 
-    Fast path: marshal the arrays STRAIGHT into the native C++ DFS —
-    the events are already sorted (ingest and commit frontiers are
-    both monotone in idx, and call events precede returns via the kind
-    key), so the Operation-object layer and its event sort (which
-    dominated the bench's verification wall-clock ~7:1 over the DFS
-    itself) are skipped.  Falls back to the generic checker when the
-    native library is unavailable."""
+    Fast path: marshal the event order STRAIGHT into the native C++
+    DFS — the Operation-object layer and its event sort dominated the
+    verification wall-clock ~7:1 over the DFS itself.  Falls back to
+    the generic checker when the native library is unavailable."""
     from ..porcupine.checker import check_operations
     from ..porcupine.kv import (
         _NATIVE_STEPS_PER_SEC,
@@ -272,13 +463,14 @@ def _check_group_history(idxs, t_ins, t_cs, g, N, timeout_s):
     n = len(idxs)
     pieces = [f"[{i}]" for i in idxs]
     value = "".join(pieces)
-    # Interleave (time, kind, op) in sorted order by merging the two
-    # already-sorted streams: calls at t_in (kind 0), returns at
-    # t_c + 0.5 (kind 1).  The final get's events land after all.
+    # Sort (time, kind, op) events; kind 0 (call) before kind 1
+    # (return) at equal times.  Calls/rets are each monotone in op
+    # index (commit ticks are monotone; flooring preserves it), so a
+    # two-stream merge beats a full sort.
     events = []
     a = b = 0
     while a < n or b < n:
-        if a < n and (b >= n or t_ins[a] <= t_cs[b] + 0.5):
+        if a < n and (b >= n or calls[a] <= rets[b]):
             events.append((a, False))
             a += 1
         else:
@@ -286,6 +478,10 @@ def _check_group_history(idxs, t_ins, t_cs, g, N, timeout_s):
             b += 1
     events.append((n, False))
     events.append((n, True))
+    open_ops = depth = 0
+    for _, is_ret in events:
+        open_ops += -1 if is_ret else 1
+        depth = max(depth, open_ops)
     kinds = [OP_APPEND] * n + [OP_GET]
     values = pieces + [""]
     outputs = [""] * n + [value]
@@ -295,15 +491,15 @@ def _check_group_history(idxs, t_ins, t_cs, g, N, timeout_s):
         max_wall_s=timeout_s,
     )
     if rc is not None:
-        return _rc_result(rc)
+        return _rc_result(rc), depth
     # No native toolchain: the generic (Operation-object) path.
     ops = [
         Operation(
             client_id=0,
             input=KvInput(op=OP_APPEND, key=f"g{g}", value=pieces[k]),
-            call=float(t_ins[k]),
+            call=float(calls[k]),
             output=KvOutput(),
-            ret=float(t_cs[k]) + 0.5,
+            ret=float(rets[k]),
         )
         for k in range(n)
     ]
@@ -316,7 +512,7 @@ def _check_group_history(idxs, t_ins, t_cs, g, N, timeout_s):
             ret=float(N + 2),
         )
     )
-    return check_operations(kv_model, ops, timeout=timeout_s)
+    return check_operations(kv_model, ops, timeout=timeout_s), depth
 
 
 def _leader_slot(st, g: int) -> int:

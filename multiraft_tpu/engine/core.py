@@ -358,114 +358,123 @@ def tick_impl(
     )
 
     # ---- 1. vote requests (reference: raft/raft_election.go:54-77) ----
-    # Sequential over src so simultaneous candidacies serialize per dst.
-    # PreVote requests (vr_pre lanes) are handled non-bindingly: no
-    # term step-down, no voted_for, no timer reset.
-    for s in range(P):
-        arrived = inbox.vr_active[:, s, :] & state.alive  # [G,P] at dst
-        is_pre = inbox.vr_pre[:, s, :]
-        active = arrived & ~is_pre
-        m_term = inbox.vr_term[:, s, :]
-        # Step down on higher term.
-        higher = active & (m_term > state.term)
-        state = _step_down(cfg, state, higher, m_term)
-        last_idx = _last_index(state)
-        last_term = _term_at(cfg, state, last_idx)
-        up_to_date = (inbox.vr_last_term[:, s, :] > last_term) | (
-            (inbox.vr_last_term[:, s, :] == last_term)
-            & (inbox.vr_last_idx[:, s, :] >= last_idx)
-        )
-        grant = (
-            active
-            & (m_term == state.term)
-            & ((state.voted_for == -1) | (state.voted_for == s))
+    # All candidates arbitrated in ONE pass (fused r04: the per-src
+    # loop emitted P dependent kernel chains; the roofline showed the
+    # tick is launch-bound, not bandwidth-bound).  Semantics: the voter
+    # first adopts the max incoming term (one step-down covers every
+    # request), then grants at most one vote — to ``voted_for`` if
+    # already bound, else to the LOWEST-index eligible candidate, which
+    # is exactly the old loop's order.  Requests below the adopted term
+    # are refused; the old loop could grant them when they arrived
+    # "first", but that is just a different message interleaving, and
+    # Raft is ordering-robust (the mailbox is at-most-once).  PreVote
+    # requests (vr_pre lanes) stay non-binding: no step-down, no
+    # voted_for, no timer reset.
+    # View [G, voter(dst), cand(src)] — matches out.vp's [G,src,dst].
+    vT = lambda x: jnp.swapaxes(x, 1, 2)
+    arrived = vT(inbox.vr_active) & state.alive[:, :, None]
+    is_pre = vT(inbox.vr_pre)
+    active = arrived & ~is_pre
+    m_term = vT(inbox.vr_term)
+    higher_lane = active & (m_term > state.term[..., None])
+    adopt = jnp.max(jnp.where(higher_lane, m_term, -1), axis=2)
+    state = _step_down(cfg, state, jnp.any(higher_lane, axis=2), adopt)
+    last_idx = _last_index(state)
+    last_term = _term_at(cfg, state, last_idx)
+    up_to_date = (vT(inbox.vr_last_term) > last_term[..., None]) | (
+        (vT(inbox.vr_last_term) == last_term[..., None])
+        & (vT(inbox.vr_last_idx) >= last_idx[..., None])
+    )
+    eligible = active & (m_term == state.term[..., None]) & up_to_date
+    cand_ids = jnp.arange(P, dtype=jnp.int32)
+    bound = state.voted_for != -1  # [G,P] at voter
+    cand_ok = eligible & jnp.where(
+        bound[..., None], cand_ids == state.voted_for[..., None], True
+    )
+    winner = jnp.min(jnp.where(cand_ok, cand_ids, P), axis=2)  # [G,P]
+    grant = cand_ok & (cand_ids == winner[..., None])  # ≤1 true per voter
+    grant_any = winner < P
+    state = state._replace(
+        voted_for=jnp.where(grant_any, winner, state.voted_for),
+        elect_dl=jnp.where(grant_any, now + jitter, state.elect_dl),
+        last_heard=jnp.where(grant_any, now, state.last_heard),
+    )
+    if cfg.prevote:
+        pre_act = arrived & is_pre
+        # Grant iff the proposed term would win AND the log is up
+        # to date AND this voter has not heard a live leader within
+        # ELECT_MIN ticks (the disruption guard).  A LEADER never
+        # grants: it is in-lease by definition (its own last_heard
+        # is not refreshed while leading — etcd refuses likewise).
+        lease_expired = (now - state.last_heard) >= cfg.ELECT_MIN
+        grant_pre = (
+            pre_act
+            & (state.role != LEADER)[..., None]
+            & (m_term > state.term[..., None])
+            & lease_expired[..., None]
             & up_to_date
         )
-        state = state._replace(
-            voted_for=jnp.where(grant, s, state.voted_for),
-            elect_dl=jnp.where(grant, now + jitter, state.elect_dl),
-            last_heard=jnp.where(grant, now, state.last_heard),
-        )
-        if cfg.prevote:
-            pre_act = arrived & is_pre
-            # Grant iff the proposed term would win AND the log is up
-            # to date AND this voter has not heard a live leader within
-            # ELECT_MIN ticks (the disruption guard).  A LEADER never
-            # grants: it is in-lease by definition (its own last_heard
-            # is not refreshed while leading — etcd refuses likewise).
-            lease_expired = (now - state.last_heard) >= cfg.ELECT_MIN
-            grant_pre = (
-                pre_act
-                & (state.role != LEADER)
-                & (m_term > state.term)
-                & lease_expired
-                & up_to_date
-            )
-        else:
-            pre_act = jnp.zeros_like(active)
-            grant_pre = pre_act
-        # Reply: out.vp[g, dst(voter)=·, dst_slot=s(candidate)].  A src
-        # sends either a real or a pre request per tick, so the lanes
-        # are disjoint; merge into one write.  A GRANTED pre reply
-        # echoes the proposed term (the tally matches on it); a REFUSED
-        # pre reply carries the voter's actual term, so a candidate
-        # probing a partition-stale term learns the real one and steps
-        # down (sim parity: node.py _on_prevote_reply; etcd does the
-        # same).
-        out = out._replace(
-            vp_active=out.vp_active.at[:, :, s].set(active | pre_act),
-            vp_pre=out.vp_pre.at[:, :, s].set(pre_act),
-            vp_term=out.vp_term.at[:, :, s].set(
-                jnp.where(pre_act & grant_pre, m_term, state.term)
-            ),
-            vp_granted=out.vp_granted.at[:, :, s].set(
-                jnp.where(pre_act, grant_pre, grant)
-            ),
-        )
+    else:
+        pre_act = jnp.zeros_like(active)
+        grant_pre = pre_act
+    # Reply lanes [G, voter, cand] ARE out.vp's [G, src, dst] layout.
+    # A src sends either a real or a pre request per tick, so the lanes
+    # are disjoint; merge into one write.  A GRANTED pre reply echoes
+    # the proposed term (the tally matches on it); a REFUSED pre reply
+    # carries the voter's actual term, so a candidate probing a
+    # partition-stale term learns the real one and steps down (sim
+    # parity: node.py _on_prevote_reply; etcd does the same).
+    out = out._replace(
+        vp_active=active | pre_act,
+        vp_pre=pre_act,
+        vp_term=jnp.where(
+            pre_act & grant_pre,
+            m_term,
+            jnp.broadcast_to(state.term[..., None], (G, P, P)),
+        ),
+        vp_granted=jnp.where(pre_act, grant_pre, grant),
+    )
 
     # ---- 2. vote replies → tally → leadership
     # (reference: raft/raft_election.go:27-49) ----
-    for s in range(P):
-        arrived = inbox.vp_active[:, s, :] & state.alive  # at candidate dst
-        reply_pre = inbox.vp_pre[:, s, :]
-        active = arrived & ~reply_pre
-        m_term = inbox.vp_term[:, s, :]
-        higher = active & (m_term > state.term)
-        if cfg.prevote:
-            # A refused pre reply carries the voter's actual term (see
-            # phase 1): adopt a higher one just like the sim does —
-            # without this, a candidate never learns a voter's real
-            # term from a prevote refusal (liveness lag).
-            higher = higher | (
-                arrived
-                & reply_pre
-                & ~inbox.vp_granted[:, s, :]
-                & (m_term > state.term)
-            )
-        state = _step_down(cfg, state, higher, m_term)
-        good = (
-            active
-            & (state.role == CANDIDATE)
-            & (m_term == state.term)
-            & inbox.vp_granted[:, s, :]
+    # Replies commute: the tally is an OR per voter slot and step-down
+    # adopts the max reply term, so the whole phase is one elementwise
+    # pass over the [G, cand(dst), voter(src)] view (fused r04; the old
+    # per-src loop serialized P dependent chains for an order-invariant
+    # reduction).
+    arrived = vT(inbox.vp_active) & state.alive[:, :, None]
+    reply_pre = vT(inbox.vp_pre)
+    active = arrived & ~reply_pre
+    m_term = vT(inbox.vp_term)
+    granted = vT(inbox.vp_granted)
+    higher_lane = active & (m_term > state.term[..., None])
+    if cfg.prevote:
+        # A refused pre reply carries the voter's actual term (see
+        # phase 1): adopt a higher one just like the sim does —
+        # without this, a candidate never learns a voter's real
+        # term from a prevote refusal (liveness lag).
+        higher_lane = higher_lane | (
+            arrived & reply_pre & ~granted & (m_term > state.term[..., None])
         )
-        state = state._replace(
-            votes=state.votes.at[:, :, s].set(state.votes[:, :, s] | good)
+    adopt = jnp.max(jnp.where(higher_lane, m_term, -1), axis=2)
+    state = _step_down(cfg, state, jnp.any(higher_lane, axis=2), adopt)
+    good = (
+        active
+        & (state.role == CANDIDATE)[..., None]
+        & (m_term == state.term[..., None])
+        & granted
+    )
+    state = state._replace(votes=state.votes | good)
+    if cfg.prevote:
+        # Pre replies echo the proposed term (our term+1); stale
+        # rounds (term moved on) are discarded.
+        good_pre = (
+            arrived
+            & reply_pre
+            & (m_term == state.term[..., None] + 1)
+            & granted
         )
-        if cfg.prevote:
-            # Pre replies echo the proposed term (our term+1); stale
-            # rounds (term moved on) are discarded.
-            good_pre = (
-                arrived
-                & reply_pre
-                & (m_term == state.term + 1)
-                & inbox.vp_granted[:, s, :]
-            )
-            state = state._replace(
-                pre_votes=state.pre_votes.at[:, :, s].set(
-                    state.pre_votes[:, :, s] | good_pre
-                )
-            )
+        state = state._replace(pre_votes=state.pre_votes | good_pre)
 
     if cfg.prevote:
         # Prevote quorum → promote to a REAL candidacy (the only place
@@ -516,154 +525,184 @@ def tick_impl(
     )
 
     # ---- 3. append requests (reference: raft/raft_append_entry.go:108-162) ----
-    for s in range(P):
-        active = inbox.ar_active[:, s, :] & state.alive  # [G,P] at dst
-        m_term = inbox.ar_term[:, s, :]
-        stale = active & (m_term < state.term)
-        ok = active & ~stale
-        # Accept leadership: step down, reset election timer.
-        higher = ok & (m_term > state.term)
+    # One arbitrated pass (fused r04).  Distinct leaders always carry
+    # distinct terms (election safety — a replica's appends all carry
+    # terms at which IT led), so per destination at most one incoming
+    # append is current: pick the max-term message (tie → lowest src,
+    # the old loop's order) as the winner and process exactly it; every
+    # other active message is answered with a failure reply carrying
+    # our post-adoption term, which is what the old loop did for stale
+    # messages and is equivalent to an at-most-once drop for the rare
+    # lower-term-processed-first interleaving.
+    act_in = vT(inbox.ar_active) & state.alive[:, :, None]  # [G,dst,src]
+    m_term_all = vT(inbox.ar_term)
+    term_key = jnp.where(act_in, m_term_all, -1)
+    max_term_in = jnp.max(term_key, axis=2)  # [G,dst]
+    is_max = act_in & (term_key == max_term_in[..., None])
+    src_ids = jnp.arange(P, dtype=jnp.int32)
+    win_src = jnp.min(jnp.where(is_max, src_ids, P), axis=2)  # [G,dst]
+    sel = src_ids == win_src[..., None]  # [G,dst,src] one-hot (or none)
+    pick = lambda x: jnp.sum(jnp.where(sel, vT(x), 0), axis=2)
+    active = win_src < P  # [G,P] a message arrived at dst
+    m_term = pick(inbox.ar_term)
+    stale = active & (m_term < state.term)
+    ok = active & ~stale
+    # Accept leadership: step down, reset election timer.
+    higher = ok & (m_term > state.term)
+    state = state._replace(
+        term=jnp.where(higher, m_term, state.term),
+        voted_for=jnp.where(higher, -1, state.voted_for),
+        role=jnp.where(ok, FOLLOWER, state.role),
+    )
+    state = state._replace(
+        elect_dl=jnp.where(ok, now + jitter, state.elect_dl),
+        last_heard=jnp.where(ok, now, state.last_heard),
+    )
+    if cfg.prevote:
+        # Hearing a live leader ABORTS any in-flight prevote round:
+        # grants collected during the leader's hiccup must not
+        # promote one tick after we acknowledged it (etcd aborts
+        # its campaign on MsgApp/MsgHeartbeat the same way).
         state = state._replace(
-            term=jnp.where(higher, m_term, state.term),
-            voted_for=jnp.where(higher, -1, state.voted_for),
-            role=jnp.where(ok, FOLLOWER, state.role),
-        )
-        state = state._replace(
-            elect_dl=jnp.where(ok, now + jitter, state.elect_dl),
-            last_heard=jnp.where(ok, now, state.last_heard),
-        )
-        if cfg.prevote:
-            # Hearing a live leader ABORTS any in-flight prevote round:
-            # grants collected during the leader's hiccup must not
-            # promote one tick after we acknowledged it (etcd aborts
-            # its campaign on MsgApp/MsgHeartbeat the same way).
-            state = state._replace(
-                pre_votes=jnp.where(ok[..., None], False, state.pre_votes)
-            )
-
-        prev = inbox.ar_prev_idx[:, s, :]
-        prev_t = inbox.ar_prev_term[:, s, :]
-        n_ent = inbox.ar_n[:, s, :]
-        snap = inbox.ar_snap[:, s, :]
-
-        # InstallSnapshot fast-forward (reference: raft/raft_snapshot.go:15-54).
-        do_snap = ok & snap & (prev > state.commit)
-        state = state._replace(
-            base=jnp.where(do_snap, prev, state.base),
-            base_term=jnp.where(do_snap, prev_t, state.base_term),
-            log_len=jnp.where(do_snap, 0, state.log_len),
-            commit=jnp.where(do_snap, prev, state.commit),
-            applied=jnp.where(do_snap, prev, state.applied),
-        )
-        snap_handled = ok & snap
-
-        # last AFTER any snapshot rebase so non-append rows keep a
-        # consistent (base, len) pair.
-        last = _last_index(state)
-        apn = ok & ~snap
-        in_window = (prev >= state.base) & (prev <= last)
-        match = apn & in_window & (_term_at(cfg, state, prev) == prev_t)
-
-        # Write entries prev+1..prev+n, truncating only at a genuine
-        # conflict (reference: raft/raft_append_entry.go:146-155).
-        # Scatter-free ring write (see _ring_write): slots within one
-        # message are distinct mod L (E < L), so the lane mapping is
-        # exact.
-        ei = jnp.arange(E)  # [E]
-        idx = prev[..., None] + 1 + ei  # [G,P,E]
-        in_msg = match[..., None] & (ei < n_ent[..., None])
-        incoming = inbox.ar_terms[:, s, :, :]  # [G,P,E]
-        exists = idx <= last[..., None]
-        overlap = in_msg & exists
-        # Steady-state skip: appends land strictly past ``last`` (no
-        # overlap with existing entries), so the conflict-check ring
-        # read has nothing to compare — elide it under a runtime cond.
-        conflict_any = jax.lax.cond(
-            jnp.any(overlap),
-            lambda _: jnp.any(
-                overlap
-                & (_ring_read(state.log_term, idx, L) != incoming),
-                axis=-1,
-            ),
-            # zeros_like(match), not zeros((G,P)): under shard_map's
-            # rep-tracking both branches must vary over the mesh axis.
-            lambda _: jnp.zeros_like(match),
-            None,
-        )  # [G,P]
-        log = _ring_write(
-            state.log_term, prev + 1, incoming,
-            jnp.where(match, n_ent, 0), L,
-        )
-        state = state._replace(log_term=log)
-        msg_last = prev + n_ent
-        new_last = jnp.where(
-            match,
-            jnp.where(conflict_any, msg_last, jnp.maximum(last, msg_last)),
-            last,
-        )
-        state = state._replace(log_len=new_last - state.base)
-        # Follower commit (reference: raft/raft_append_entry.go:157-160).
-        new_commit = jnp.minimum(inbox.ar_commit[:, s, :], msg_last)
-        state = state._replace(
-            commit=jnp.where(
-                match & (new_commit > state.commit), new_commit, state.commit
-            )
+            pre_votes=jnp.where(ok[..., None], False, state.pre_votes)
         )
 
-        # Conflict backoff: the committed prefix always matches, so
-        # reposition to min(prev, commit+1) in one round (divergence
-        # from the reference's term scan — see module docstring).
-        conflict_idx = jnp.minimum(prev, state.commit + 1)
-        reply_active = active
-        success = match | snap_handled
-        reply_match = jnp.where(snap_handled, prev, msg_last)
-        out = out._replace(
-            ap_active=out.ap_active.at[:, :, s].set(reply_active),
-            ap_term=out.ap_term.at[:, :, s].set(state.term),
-            ap_success=out.ap_success.at[:, :, s].set(success),
-            ap_match=out.ap_match.at[:, :, s].set(reply_match),
-            ap_conflict=out.ap_conflict.at[:, :, s].set(conflict_idx),
+    prev = pick(inbox.ar_prev_idx)
+    prev_t = pick(inbox.ar_prev_term)
+    n_ent = pick(inbox.ar_n)
+    snap = jnp.any(sel & vT(inbox.ar_snap), axis=2)
+
+    # InstallSnapshot fast-forward (reference: raft/raft_snapshot.go:15-54).
+    do_snap = ok & snap & (prev > state.commit)
+    state = state._replace(
+        base=jnp.where(do_snap, prev, state.base),
+        base_term=jnp.where(do_snap, prev_t, state.base_term),
+        log_len=jnp.where(do_snap, 0, state.log_len),
+        commit=jnp.where(do_snap, prev, state.commit),
+        applied=jnp.where(do_snap, prev, state.applied),
+    )
+    snap_handled = ok & snap
+
+    # last AFTER any snapshot rebase so non-append rows keep a
+    # consistent (base, len) pair.
+    last = _last_index(state)
+    apn = ok & ~snap
+    in_window = (prev >= state.base) & (prev <= last)
+    match = apn & in_window & (_term_at(cfg, state, prev) == prev_t)
+
+    # Write entries prev+1..prev+n, truncating only at a genuine
+    # conflict (reference: raft/raft_append_entry.go:146-155).
+    # Scatter-free ring write (see _ring_write): slots within one
+    # message are distinct mod L (E < L), so the lane mapping is
+    # exact.
+    ei = jnp.arange(E)  # [E]
+    idx = prev[..., None] + 1 + ei  # [G,P,E]
+    in_msg = match[..., None] & (ei < n_ent[..., None])
+    # Winner's entry terms: [G,dst,src,E] selected down to [G,dst,E].
+    incoming = jnp.sum(
+        jnp.where(
+            sel[..., None], jnp.swapaxes(inbox.ar_terms, 1, 2), 0
+        ),
+        axis=2,
+    )
+    exists = idx <= last[..., None]
+    overlap = in_msg & exists
+    # Steady-state skip: appends land strictly past ``last`` (no
+    # overlap with existing entries), so the conflict-check ring
+    # read has nothing to compare — elide it under a runtime cond.
+    conflict_any = jax.lax.cond(
+        jnp.any(overlap),
+        lambda _: jnp.any(
+            overlap
+            & (_ring_read(state.log_term, idx, L) != incoming),
+            axis=-1,
+        ),
+        # zeros_like(match), not zeros((G,P)): under shard_map's
+        # rep-tracking both branches must vary over the mesh axis.
+        lambda _: jnp.zeros_like(match),
+        None,
+    )  # [G,P]
+    log = _ring_write(
+        state.log_term, prev + 1, incoming,
+        jnp.where(match, n_ent, 0), L,
+    )
+    state = state._replace(log_term=log)
+    msg_last = prev + n_ent
+    new_last = jnp.where(
+        match,
+        jnp.where(conflict_any, msg_last, jnp.maximum(last, msg_last)),
+        last,
+    )
+    state = state._replace(log_len=new_last - state.base)
+    # Follower commit (reference: raft/raft_append_entry.go:157-160).
+    new_commit = jnp.minimum(pick(inbox.ar_commit), msg_last)
+    state = state._replace(
+        commit=jnp.where(
+            match & (new_commit > state.commit), new_commit, state.commit
         )
+    )
+
+    # Replies go to EVERY active sender ([G,dst,src] is out.ap's
+    # [G,src,dst] layout: the replier is out's src).  Only the winner
+    # can succeed; losers get failure + our current term, and their
+    # per-message msg_last / conflict hints are computed elementwise.
+    prev_all = vT(inbox.ar_prev_idx)
+    msg_last_all = prev_all + vT(inbox.ar_n)
+    # Conflict backoff: the committed prefix always matches, so
+    # reposition to min(prev, commit+1) in one round (divergence
+    # from the reference's term scan — see module docstring).
+    conflict_all = jnp.minimum(prev_all, state.commit[..., None] + 1)
+    success = match | snap_handled  # [G,P] winner outcome
+    reply_match_w = jnp.where(snap_handled, prev, msg_last)
+    out = out._replace(
+        ap_active=act_in,
+        ap_term=jnp.broadcast_to(state.term[..., None], (G, P, P)),
+        ap_success=sel & success[..., None],
+        ap_match=jnp.where(sel, reply_match_w[..., None], msg_last_all),
+        ap_conflict=conflict_all,
+    )
 
     # ---- 4. append replies + quorum commit advance
     # (reference: raft/raft_append_entry.go:66-105 — the north-star) ----
-    for s in range(P):
-        active = inbox.ap_active[:, s, :] & state.alive  # at leader dst
-        m_term = inbox.ap_term[:, s, :]
-        higher = active & (m_term > state.term)
-        state = _step_down(cfg, state, higher, m_term)
-        good = active & (state.role == LEADER) & (m_term == state.term)
-        succ = good & inbox.ap_success[:, s, :]
-        fail = good & ~inbox.ap_success[:, s, :]
-        new_match = jnp.maximum(state.match_idx[:, :, s], inbox.ap_match[:, s, :])
-        state = state._replace(
-            match_idx=state.match_idx.at[:, :, s].set(
-                jnp.where(succ, new_match, state.match_idx[:, :, s])
+    # Replies commute: each src's reply touches only its own
+    # match/next slot and step-down adopts the max reply term, so the
+    # whole phase is one elementwise pass over the
+    # [G, leader(dst), src] view (fused r04).
+    active = vT(inbox.ap_active) & state.alive[:, :, None]
+    m_term = vT(inbox.ap_term)
+    higher_lane = active & (m_term > state.term[..., None])
+    adopt = jnp.max(jnp.where(higher_lane, m_term, -1), axis=2)
+    state = _step_down(cfg, state, jnp.any(higher_lane, axis=2), adopt)
+    good = (
+        active
+        & (state.role == LEADER)[..., None]
+        & (m_term == state.term[..., None])
+    )
+    succ = good & vT(inbox.ap_success)
+    fail = good & ~vT(inbox.ap_success)
+    new_match = jnp.maximum(state.match_idx, vT(inbox.ap_match))
+    state = state._replace(
+        match_idx=jnp.where(succ, new_match, state.match_idx),
+        next_idx=jnp.where(
+            succ,
+            # max(): appends are pipelined (next_idx advances
+            # optimistically at send, phase 5c), so an ack for
+            # batch k must not rewind past batches k+1... already
+            # in flight.
+            jnp.maximum(state.next_idx, new_match + 1),
+            jnp.where(
+                fail,
+                # Floor at match_idx+1: a reordered stale
+                # failure must not rewind below what this
+                # follower has already acked.
+                jnp.maximum(
+                    jnp.clip(vT(inbox.ap_conflict), 1, None),
+                    state.match_idx + 1,
+                ),
+                state.next_idx,
             ),
-        )
-        state = state._replace(
-            next_idx=state.next_idx.at[:, :, s].set(
-                jnp.where(
-                    succ,
-                    # max(): appends are pipelined (next_idx advances
-                    # optimistically at send, phase 5c), so an ack for
-                    # batch k must not rewind past batches k+1... already
-                    # in flight.
-                    jnp.maximum(state.next_idx[:, :, s], new_match + 1),
-                    jnp.where(
-                        fail,
-                        # Floor at match_idx+1: a reordered stale
-                        # failure must not rewind below what this
-                        # follower has already acked.
-                        jnp.maximum(
-                            jnp.clip(inbox.ap_conflict[:, s, :], 1, None),
-                            state.match_idx[:, :, s] + 1,
-                        ),
-                        state.next_idx[:, :, s],
-                    ),
-                )
-            )
-        )
+        ),
+    )
 
     last_idx = _last_index(state)
     is_leader = (state.role == LEADER) & state.alive

@@ -69,6 +69,22 @@ def native_available() -> bool:
 
 def _marshal(events, op_kinds, op_values, op_outputs):
     n = len(op_kinds)
+    # A malformed event order (return before call, duplicate events)
+    # would walk the C++ DFS off its linked list — reject it here with
+    # a Python error instead of a segfault.
+    seen = bytearray(n)  # 0 = unseen, 1 = called, 2 = returned
+    for op, is_ret in events:
+        if not (0 <= op < n):
+            raise ValueError(f"event references op {op} outside [0,{n})")
+        want = 1 if is_ret else 0
+        if seen[op] != want:
+            raise ValueError(
+                f"malformed event order: op {op} "
+                + ("returned before call" if is_ret else "called twice")
+            )
+        seen[op] = want + 1
+    if any(s != 2 for s in seen):
+        raise ValueError("malformed history: op missing call/return")
     ev_op = (ctypes.c_int32 * len(events))(*[e[0] for e in events])
     ev_ret = (ctypes.c_uint8 * len(events))(*[1 if e[1] else 0 for e in events])
     kinds = (ctypes.c_int32 * n)(*op_kinds)
