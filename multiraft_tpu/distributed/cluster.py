@@ -312,6 +312,24 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             data_dir=spec.get("data_dir"),
             snapshot_every_s=spec.get("snapshot_every_s", 30.0),
         )
+    elif kind == "split_shardkv":
+        _pin_platform(spec)
+        from .split_shard_server import serve_split_shardkv
+
+        node = serve_split_shardkv(
+            port=spec["ports"][spec["me"]],
+            me=spec["me"],
+            # JSON stringifies the group keys and listifies slot lists.
+            owners={int(g): list(o) for g, o in spec["owners"].items()},
+            peer_addrs={
+                i: (spec.get("host", "127.0.0.1"), p)
+                for i, p in enumerate(spec["ports"])
+            },
+            G=spec.get("groups", 3),
+            host=spec.get("host", "127.0.0.1"),
+            seed=spec.get("seed", 0),
+            delay_elections=spec.get("delay_elections", 0),
+        )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
     print(f"ready {node.port}", flush=True)
@@ -627,6 +645,93 @@ class BlockingSplitClerk(_BlockingClerkBase):
         self.sched = self.node.sched
         ends = [self.node.client_end(host, p) for p in ports]
         self._clerk = SplitNetClerk(self.sched, ends)
+
+
+class SplitShardProcessCluster:
+    """Several engine processes SHARING the sharded stack's peer slots
+    (engine/split_shard.py + distributed/split_shard_server.py): the
+    config RSM and every replica group survive any minority-owner
+    process death — including mid-migration (the reference shardkv
+    failure model, shardkv/config.go:204-262, at the process level).
+    Non-durable by design: replication across surviving quorums IS the
+    durability; a killed member must stay dead."""
+
+    def __init__(
+        self,
+        owners: Dict[int, Sequence[int]],
+        n_procs: int,
+        groups: int = 3,
+        host: str = "127.0.0.1",
+        seed: int = 0,
+        delay_elections: Optional[Sequence[int]] = None,
+    ) -> None:
+        from . import engine_server  # noqa: F401  (codec registration)
+        from . import split_shard_server  # noqa: F401
+
+        self.host = host
+        self.ports = _reserve_ports(n_procs, host)
+        self.specs = []
+        for i in range(n_procs):
+            self.specs.append({
+                "kind": "split_shardkv",
+                "me": i,
+                "host": host,
+                "ports": self.ports,
+                "owners": {str(g): list(o) for g, o in owners.items()},
+                "groups": groups,
+                "seed": seed + i,
+                "delay_elections": (
+                    int(delay_elections[i]) if delay_elections else 0
+                ),
+                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+            })
+        self._killed: set = set()
+        self.procs: List[Optional[subprocess.Popen]] = [None] * n_procs
+
+    def start_all(self) -> None:
+        assert not self._killed, (
+            "a killed split peer must stay dead (non-durable identity)"
+        )
+        for i, spec in enumerate(self.specs):
+            self.procs[i] = _launch_server(spec, f"splitshard-{i}")
+        for i, p in enumerate(self.procs):
+            _check_ready(p, f"splitshard-{i}", timeout=300.0)
+
+    def kill(self, i: int) -> None:
+        p = self.procs[i]
+        if p is not None and p.poll() is None:
+            p.kill()
+            p.wait()
+        self.procs[i] = None
+        self._killed.add(i)
+
+    def clerk(self) -> "BlockingSplitShardClerk":
+        return BlockingSplitShardClerk(self.ports, host=self.host)
+
+    def shutdown(self) -> None:
+        for i in range(len(self.procs)):
+            self.kill(i)
+
+
+class BlockingSplitShardClerk(_BlockingClerkBase):
+    """Blocking client of a :class:`SplitShardProcessCluster`, with
+    the admin (join/leave/move) and status probes exposed."""
+
+    def __init__(
+        self, ports: Sequence[int], host: str = "127.0.0.1"
+    ) -> None:
+        from .split_shard_server import SplitShardNetClerk
+
+        self.node = RpcNode()
+        self.sched = self.node.sched
+        ends = [self.node.client_end(host, p) for p in ports]
+        self._clerk = SplitShardNetClerk(self.sched, ends)
+
+    def admin(self, kind: str, payload, timeout: float = 60.0) -> None:
+        self._run(self._clerk.admin(kind, payload), timeout)
+
+    def status(self, proc: int, timeout: float = 10.0):
+        return self._run(self._clerk.status(proc), timeout)
 
 
 class EngineFleetCluster:

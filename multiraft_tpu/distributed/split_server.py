@@ -423,8 +423,14 @@ class SplitKVService:
                     yield 0.002
             for i, a in enumerate(args_list):
                 t = tickets.get(i)
-                if i in wrong or t is None:
+                if i in wrong:
+                    # Confirmed: the group's leader lives elsewhere.
                     replies[i] = EngineCmdReply(err=ERR_WRONG_LEADER)
+                elif t is None:
+                    # Never submitted before the deadline (leadership
+                    # flapped locally the whole time) — a timeout, not
+                    # a routing verdict (ADVICE r03).
+                    replies[i] = EngineCmdReply(err=ERR_TIMEOUT)
                 elif t.done and not t.failed:
                     replies[i] = EngineCmdReply(err=OK, value=t.value)
                 else:
@@ -479,7 +485,7 @@ class SplitNetClerk:
         self.ends = list(ends)
         self.client_id = unique_client_id(next(SplitNetClerk._next))
         self.command_id = 0
-        self._leader: Dict[int, int] = {}  # route bucket -> ends index
+        self._leader: Dict[str, int] = {}  # key -> ends index
 
     def _command(self, op: str, key: str, value: str = ""):
         if op != "Get":
@@ -488,9 +494,12 @@ class SplitNetClerk:
             op=op, key=key, value=value,
             client_id=self.client_id, command_id=self.command_id,
         )
-        # Group routing is server-side; the leader cache keys on the
-        # key's route bucket (stable across retries of the same key).
-        gkey = route_group(key, max(len(self.ends), 1))
+        # Group routing is server-side and the clerk does not know the
+        # server's G, so the leader cache keys per-KEY (ADVICE r03: a
+        # bucket over the ends count aliases distinct groups and they
+        # evict each other's entries) — exact, and bounded by the
+        # client's own working set.
+        gkey = key
         i = self._leader.get(gkey, 0)
         while True:
             end = self.ends[i % len(self.ends)]

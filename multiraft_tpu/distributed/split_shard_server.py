@@ -1,0 +1,295 @@
+"""Serving layer for the SHARDED stack over split replica groups
+(engine/split_shard.py) — real sockets, real processes.
+
+Each process runs ``serve_split_shardkv``: one engine hosting the
+config RSM (engine group 0) and every replica group, owning only its
+``owners`` peer slots; per-tick boundary slabs ride ``SplitEngine.slab``
+RPCs between processes (same exchange as split_server.py).  Killing a
+process loses only its owned slots: groups whose survivors hold a
+quorum keep electing, serving, and MIGRATING — the cross-process pull /
+Challenge-1 GC handshake is state-driven (see engine/split_shard.py),
+so whichever process next owns a leader re-derives any step the dead
+one never took.
+
+Client surface: ``SplitShardKV.command`` routes key→shard→gid
+server-side from the latest applied config and answers ErrWrongLeader
+when the owning group's leader lives at a peer process (the clerk
+rotates, reference: shardkv/client.go:68-129); ``admin`` drives
+join/leave/move at whichever process owns the ctrler leader.  A
+cross-process admin retry can duplicate a ctrler op under a different
+per-process session — by construction that re-applies an identical
+membership change, so the extra config is a no-op bump every replica
+steps through (rebalance is deterministic); same-process retries are
+deduped exactly-once.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..engine.core import EngineConfig
+from ..engine.host import EngineDriver
+from ..engine.split import SplitPeering, SplitSpec
+from ..engine.split_shard import SplitShardKV
+from ..engine.shardkv import ERR_WRONG_GROUP, OK
+from ..services.shardkv import SERVING, key2shard
+from ..sim.scheduler import TIMEOUT, Future
+from ..utils.ids import unique_client_id
+from .engine_server import ERR_TIMEOUT, EngineCmdArgs, EngineCmdReply
+from .realtime import RealtimeScheduler
+from .split_server import ERR_WRONG_LEADER
+from .tcp import RpcNode
+
+__all__ = [
+    "SplitShardKVService",
+    "SplitShardNetClerk",
+    "serve_split_shardkv",
+]
+
+
+class SplitShardKVService:
+    """``SplitShardKV.*`` + ``SplitEngine.slab`` on one process."""
+
+    RESUBMIT_S = 0.25
+    DEADLINE_S = 3.0
+    ADMIN_OPS = ("join", "leave", "move")
+
+    def __init__(
+        self,
+        sched: RealtimeScheduler,
+        skv: SplitShardKV,
+        peering: SplitPeering,
+        peer_ends: Dict[int, object],
+        pump_interval: float = 0.002,
+    ) -> None:
+        self.sched = sched
+        self.skv = skv
+        self.peering = peering
+        self.peer_ends = dict(peer_ends)
+        self._interval = pump_interval
+        self._stopped = False
+        sched.call_soon(self._pump_loop)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _pump_loop(self) -> None:
+        if self._stopped:
+            return
+        self.skv.pump(1)
+        for proc, slab in self.peering.extract().items():
+            end = self.peer_ends.get(proc)
+            if end is not None:
+                # Fire-and-forget: a lost slab is a dropped message and
+                # Raft retries; the timeout just reclaims the future.
+                self.sched.with_timeout(
+                    end.call("SplitEngine.slab", slab), 1.0
+                )
+        self.sched.call_after(self._interval, self._pump_loop)
+
+    # -- peer-facing -------------------------------------------------------
+
+    def slab(self, blob: dict):
+        self.peering.inject(blob)
+        return True
+
+    # -- probes (tests/operators) ------------------------------------------
+
+    def status(self, args=None):
+        """(latest config num, shard→gid list, any-slot-migrating,
+        gids whose leader this process owns) — lets a test time a kill
+        to land mid-migration and watch completion from outside."""
+        cfg = self.skv.query_latest()
+        migrating = any(
+            sl.state != SERVING
+            for rep in self.skv.reps.values()
+            for sl in rep.shards.values()
+        )
+        led = [g for g in self.skv.gids
+               if self.skv.local_leader(g) is not None]
+        return (cfg.num, list(cfg.shards), migrating, led)
+
+    # -- client-facing -----------------------------------------------------
+
+    def command(self, args: EngineCmdArgs):
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                cfg = self.skv.query_latest()
+                gid = cfg.shards[key2shard(args.key)]
+                if gid == 0 or gid not in self.skv.reps:
+                    return EngineCmdReply(err=ERR_WRONG_GROUP)
+                t = self.skv.submit_local(
+                    gid, args.op, args.key, args.value,
+                    client_id=args.client_id, command_id=args.command_id,
+                )
+                if t is None:
+                    # The owning group's leader lives at a peer process.
+                    return EngineCmdReply(err=ERR_WRONG_LEADER)
+                sub_deadline = min(
+                    self.sched.now + self.RESUBMIT_S, deadline
+                )
+                while not t.done and self.sched.now < sub_deadline:
+                    yield 0.002
+                if t.done and not t.failed:
+                    if t.err == ERR_WRONG_GROUP:
+                        # Config moved between submit and apply: re-route
+                        # from the (now newer) applied config.
+                        yield 0.002
+                        continue
+                    return EngineCmdReply(err=t.err, value=t.value)
+                # failed (lost slot/leadership) or wedged: resubmit —
+                # same (client_id, command_id), dedup-safe.
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+    def admin(self, args):
+        """args = (kind, payload, command_id); kind ∈ ADMIN_OPS (a
+        network-supplied string must never getattr into arbitrary
+        methods).  ErrWrongLeader when the ctrler leader lives at a
+        peer process — the clerk rotates."""
+        kind, payload = args[0], args[1]
+        cmd = args[2] if len(args) > 2 else None
+        if kind not in self.ADMIN_OPS:
+            return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
+
+        def run():
+            if kind == "join":
+                arg = {int(g): list(s) for g, s in dict(payload).items()}
+            elif kind == "move":
+                arg = (int(payload[0]), int(payload[1]))
+            else:
+                arg = [int(g) for g in payload]
+            t = self.skv.ctrl_local(kind, arg, command_id=cmd)
+            if t is None:
+                return EngineCmdReply(err=ERR_WRONG_LEADER)
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if t.done:
+                    if t.failed:
+                        return EngineCmdReply(err=ERR_TIMEOUT)
+                    return EngineCmdReply(err=OK)
+                yield 0.005
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+
+class SplitShardNetClerk:
+    """Clerk over the split-shard processes: session dedup + rotation
+    on ErrWrongLeader / ErrWrongGroup / timeout (reference clerk loop,
+    shardkv/client.go:68-129 — rotation covers both 'leader elsewhere'
+    and 'shard mid-migration')."""
+
+    _next = itertools.count(1)
+
+    def __init__(self, sched, ends: Sequence) -> None:
+        self.sched = sched
+        self.ends = list(ends)
+        self.client_id = unique_client_id(next(SplitShardNetClerk._next))
+        self.command_id = 0
+        self._admin_cmd = 0
+
+    def _command(self, op: str, key: str, value: str = ""):
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        i = 0
+        while True:
+            end = self.ends[i % len(self.ends)]
+            fut: Future = end.call("SplitShardKV.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if reply is None or reply is TIMEOUT or reply.err not in (
+                OK, "ErrNoKey"
+            ):
+                i += 1  # dropped / wrong leader / mid-migration / timeout
+                yield self.sched.sleep(0.02)
+                continue
+            return reply.value if reply.err == OK else ""
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+    def admin(self, kind: str, payload):
+        """join/leave/move with rotation.  One command id per logical
+        op: same-process retries dedup exactly-once; a cross-process
+        retry can at worst re-apply the identical membership change (a
+        harmless no-op config bump — see module docstring)."""
+        self._admin_cmd += 1
+        args = (kind, payload, self._admin_cmd)
+        i = 0
+        while True:
+            end = self.ends[i % len(self.ends)]
+            fut: Future = end.call("SplitShardKV.admin", args)
+            reply = yield self.sched.with_timeout(fut, 4.0)
+            if reply is None or reply is TIMEOUT or reply.err != OK:
+                i += 1
+                yield self.sched.sleep(0.05)
+                continue
+            return True
+
+    def status(self, proc: int):
+        fut: Future = self.ends[proc].call("SplitShardKV.status", ())
+        reply = yield self.sched.with_timeout(fut, 3.0)
+        return None if reply is TIMEOUT else reply
+
+
+def serve_split_shardkv(
+    port: int,
+    me: int,
+    owners: Dict[int, Sequence[int]],
+    peer_addrs: Dict[int, Tuple[str, int]],
+    G: int = 3,
+    host: str = "127.0.0.1",
+    seed: int = 0,
+    delay_elections: int = 0,
+) -> RpcNode:
+    """Bring up one split-shard process: engine group 0 = config RSM,
+    groups ``1..G-1`` = gids ``1..G-1``, peer slots placed per
+    ``owners`` (every process passes the SAME map).  Non-durable: a
+    killed process must stay dead (fresh state under an old peer
+    identity can double-vote); the surviving quorums carry every acked
+    write — that IS the durability story of this deployment shape."""
+    node = RpcNode(listen=True, host=host, port=port)
+    sched = node.sched
+
+    def build():
+        cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8,
+                           host_paced_compaction=True)
+        driver = EngineDriver(cfg, seed=seed)
+        skv = SplitShardKV(driver)
+        peering = SplitPeering(
+            driver, skv, SplitSpec(me=me, owners={
+                int(g): list(o) for g, o in owners.items()
+            })
+        )
+        if delay_elections:
+            driver.state = driver.state._replace(
+                elect_dl=driver.state.elect_dl + int(delay_elections)
+            )
+        # Warm the tick before the readiness line (first jit compile
+        # would otherwise starve RPC dispatch under the first client).
+        skv.pump(4)
+        ends = {
+            int(p): node.client_end(h, int(pt))
+            for p, (h, pt) in peer_addrs.items()
+            if int(p) != me
+        }
+        return SplitShardKVService(sched, skv, peering, ends)
+
+    svc = sched.run_call(build, timeout=600.0)
+    node.add_service("SplitShardKV", svc)
+    node.add_service("SplitEngine", svc)
+    node.engine_service = svc
+    return node

@@ -240,6 +240,12 @@ class BatchedShardKV(FrontierService):
         self.reps: Dict[int, _Replica] = {g: _Replica(g) for g in self.gids}
         self._route = jnp.zeros((NSHARDS,), jnp.int32)
         self._ctrl_cmd = 0
+        # Ctrler session identity for admin proposals.  Single-instance
+        # deployments use 0; split-group deployments (engine/
+        # split_shard.py) set a per-process id — two processes sharing
+        # client 0 would collide in the ctrler dedup table and silently
+        # swallow each other's joins.
+        self._ctrl_client_id = 0
         self._orchestrate_enabled = True
         # Recovery gate (durable server replay): config advance keeps
         # running, but PULLS and the GC/confirm handshake must not.
@@ -389,7 +395,7 @@ class BatchedShardKV(FrontierService):
             self._ctrl_cmd = max(self._ctrl_cmd, command_id)
         t = ShardTicket(group=0, command_id=command_id)
         self.driver.start(
-            0, _CtrlOp(kind=kind, arg=arg, client_id=0,
+            0, _CtrlOp(kind=kind, arg=arg, client_id=self._ctrl_client_id,
                        command_id=command_id, ticket=t)
         )
         return t

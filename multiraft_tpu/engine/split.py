@@ -77,7 +77,7 @@ from .host import EngineDriver
 from .kv import BatchedKV, KVOp, Ticket
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
 
-__all__ = ["SplitSpec", "SplitPeering", "SplitKV"]
+__all__ = ["SplitSpec", "SplitPeering", "SplitFrontierMixin", "SplitKV"]
 
 _PREFIXES = ("vr_", "vp_", "ar_", "ap_")
 
@@ -149,6 +149,8 @@ class SplitPeering:
         # ring floor passes (entries below base travel as snapshots).
         service.retain_payloads = True
         service.peering = self
+        if hasattr(service, "_attach_peering"):
+            service._attach_peering(self)  # per-process identity setup
         self._gc_countdown = self.GC_EVERY
         # (g, idx) -> {term: payload}.  The DEVICE log is the sole
         # arbiter of which command occupies an index: candidates from
@@ -236,6 +238,15 @@ class SplitPeering:
             return fallback, None
         if len(cands) == 1:
             term, payload = next(iter(cands.items()))
+            # Verify even the sole candidate against the committed
+            # entry's ring term (ADVICE r03): a sender-side eviction
+            # edge could leave only a stale-term candidate, and
+            # applying it silently would diverge replicas — the ring
+            # is the arbiter everywhere else, and the view is already
+            # cached per tick.
+            ct = self.committed_term(g, idx)
+            if ct is not None and ct != term:
+                return fallback, None
             return payload, term
         term = self.committed_term(g, idx)
         if term is not None and term in cands:
@@ -397,7 +408,80 @@ def _to_py(v):
     return a.tolist()
 
 
-class SplitKV(BatchedKV):
+class SplitFrontierMixin:
+    """The split-mode service scaffolding shared by :class:`SplitKV`
+    and :class:`~multiraft_tpu.engine.split_shard.SplitShardKV`: the
+    host-paced compaction clamp and the lost-leadership flush.  The
+    host class must set ``self.peering`` (by :class:`SplitPeering`),
+    ``self._flush_countdown``, and implement ``_ticket_of(payload)``.
+    """
+
+    FLUSH_EVERY = 16
+
+    def _ticket_of(self, payload):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _pre_sweep(self) -> None:
+        """The host half of ``host_paced_compaction``: raise the
+        device's ``applied`` to the PREVIOUS sweep's host frontier
+        (clipped into [base, commit] per replica).  Compaction then
+        never passes an index this sweep is about to apply, so term
+        arbitration (SplitPeering.resolve) can always read the
+        committed entry's term from the ring; the ring still drains at
+        one-pump lag, keeping ingest capacity available."""
+        if self.peering is None:
+            return
+        st = self.driver.state
+        upto = jnp.asarray(
+            np.asarray(self.applied_upto, np.int32)[:, None]
+        )
+        paced = jnp.clip(upto, st.base, st.commit)
+        self.driver.state = st._replace(
+            applied=jnp.maximum(st.applied, paced)
+        )
+
+    def _flush_lost_leadership(self) -> None:
+        """A process that lost leadership holds work no local accept
+        will resolve: unbound backlog commands, and bound-but-
+        uncommitted payloads whose tickets would otherwise wedge.
+        Fail both so clients re-route — the batched analog of kvraft
+        resolving every waiter ErrWrongLeader on a term change
+        (reference: kvraft/server.go:98-128).  Failing is safe even
+        when the entry later commits via the new leader: the client
+        resubmits under the same (client_id, command_id) and dedup
+        absorbs the duplicate."""
+        self._flush_countdown -= 1
+        if self._flush_countdown > 0:
+            return
+        self._flush_countdown = self.FLUSH_EVERY
+        drv = self.driver
+        have_backlog = any(drv.backlog[g] for g in range(drv.cfg.G))
+        have_tickets = any(
+            (t := self._ticket_of(p)) is not None and not t.done
+            for p in drv.payloads.values()
+        )
+        if not have_backlog and not have_tickets:
+            return
+        leaders = drv.leaders_per_group()
+        for g in range(drv.cfg.G):
+            if drv.backlog[g] and leaders[g] == 0:
+                for payload in drv._pending_payloads.pop(g, []):
+                    self._on_evicted(payload)
+                drv.backlog[g] = 0
+        if have_tickets:
+            for (g, _idx), payload in drv.payloads.items():
+                ticket = self._ticket_of(payload)
+                if (
+                    leaders[g] == 0
+                    and ticket is not None and not ticket.done
+                ):
+                    # Fail the ticket but KEEP the payload: if this
+                    # process regains leadership the entry may still
+                    # commit and must apply with its command.
+                    self._on_evicted(payload)
+
+
+class SplitKV(SplitFrontierMixin, BatchedKV):
     """KV state machine for split groups: every hosting process applies
     the same committed log to its own copy (the reference's per-server
     apply loop, kvraft/server.go:98-128, across processes), so client
@@ -425,7 +509,7 @@ class SplitKV(BatchedKV):
         super().__init__(driver, record_groups=record_groups)
         self.retain_payloads = True
         self.peering: Optional[SplitPeering] = None  # set by SplitPeering
-        self._flush_countdown = 16
+        self._flush_countdown = self.FLUSH_EVERY
         # Persistence hooks.  on_applied: (g, idx, term, payload) for
         # every applied entry of a split group (term -1 = fallback
         # apply; the payload itself then carries the op for the WAL) —
@@ -471,6 +555,9 @@ class SplitKV(BatchedKV):
 
     # -- apply: term-arbitrated payload choice ------------------------------
 
+    def _ticket_of(self, payload):
+        return payload[1]
+
     def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
         if self.peering is not None and g in self.peering.spec.owners:
             payload, term = self.peering.resolve_with_term(g, idx, payload)
@@ -481,25 +568,6 @@ class SplitKV(BatchedKV):
                 )
             return
         super()._apply(g, idx, payload, now)
-
-    def _pre_sweep(self) -> None:
-        """The host half of ``host_paced_compaction``: raise the
-        device's ``applied`` to the PREVIOUS sweep's host frontier
-        (clipped into [base, commit] per replica).  Compaction then
-        never passes an index this sweep is about to apply, so term
-        arbitration (SplitPeering.resolve) can always read the
-        committed entry's term from the ring; the ring still drains at
-        one-pump lag, keeping ingest capacity available."""
-        if self.peering is None:
-            return
-        st = self.driver.state
-        upto = jnp.asarray(
-            np.asarray(self.applied_upto, np.int32)[:, None]
-        )
-        paced = jnp.clip(upto, st.base, st.commit)
-        self.driver.state = st._replace(
-            applied=jnp.maximum(st.applied, paced)
-        )
 
     # -- leadership-gated submission --------------------------------------
 
@@ -519,41 +587,4 @@ class SplitKV(BatchedKV):
     # -- pump hooks --------------------------------------------------------
 
     def _post_pump(self) -> None:
-        # A process that lost leadership holds work no local accept
-        # will resolve: unbound backlog commands, and bound-but-
-        # uncommitted payloads whose tickets would otherwise wedge.
-        # Fail both so clients re-route — the batched analog of kvraft
-        # resolving every waiter ErrWrongLeader on a term change
-        # (reference: kvraft/server.go:98-128).  Failing is safe even
-        # when the entry later commits via the new leader: the client
-        # resubmits under the same (client_id, command_id) and dedup
-        # absorbs the duplicate.
-        self._flush_countdown -= 1
-        if self._flush_countdown > 0:
-            return
-        self._flush_countdown = 16
-        drv = self.driver
-        have_backlog = any(drv.backlog[g] for g in range(drv.cfg.G))
-        have_tickets = any(
-            p[1] is not None and not p[1].done
-            for p in drv.payloads.values()
-        )
-        if not have_backlog and not have_tickets:
-            return
-        leaders = drv.leaders_per_group()
-        for g in range(drv.cfg.G):
-            if drv.backlog[g] and leaders[g] == 0:
-                for payload in drv._pending_payloads.pop(g, []):
-                    self._on_evicted(payload)
-                drv.backlog[g] = 0
-        if have_tickets:
-            for (g, _idx), payload in drv.payloads.items():
-                ticket = payload[1]
-                if (
-                    leaders[g] == 0
-                    and ticket is not None and not ticket.done
-                ):
-                    # Fail the ticket but KEEP the payload: if this
-                    # process regains leadership the entry may still
-                    # commit and must apply with its command.
-                    self._on_evicted(payload)
+        self._flush_lost_leadership()

@@ -1,0 +1,87 @@
+"""Sharded split groups over REAL sockets and OS processes
+(distributed/split_shard_server.py): kill -9 a minority-owner process
+UNDER client load DURING a config change — unaffected shards keep
+serving, the cross-process migration (pull + Challenge-1 GC handshake)
+completes on the survivor, and every acknowledged write is intact from
+replication alone (no WAL replay; the killed member stays dead).
+
+Reference analog: shardkv old-owner shutdown mid-migration
+(shardkv/test_test.go:97-216) with per-server failure domains
+(shardkv/config.go:204-262) — here the 'server' is an engine process
+owning one peer slot of every group.
+"""
+
+import time
+
+from multiraft_tpu.distributed.cluster import SplitShardProcessCluster
+from multiraft_tpu.services.shardkv import key2shard
+
+# Engine groups: 0 = config RSM, 1..2 = gids.  Process 0 owns ONE slot
+# of every group (minority everywhere); process 1 owns the other two.
+G = 3
+OWNERS = {g: [0, 1, 1] for g in range(G)}
+
+
+def test_split_shard_kill9_minority_owner_mid_migration(tmp_path):
+    cluster = SplitShardProcessCluster(
+        OWNERS, n_procs=2, groups=G,
+        # Park the first leaders on process 0 — the kill then takes
+        # every group's leader AND a peer slot at once.
+        delay_elections=[0, 400],
+    )
+    clerk = None
+    try:
+        cluster.start_all()
+        clerk = cluster.clerk()
+        clerk.admin("join", {1: ["p1"]})
+        keys = [chr(ord("a") + i) + "key" for i in range(10)]
+        acked = {}
+        for k in keys:
+            clerk.append(k, f"[a-{k}]")
+            acked[k] = f"[a-{k}]"
+
+        # Kick off the migration and catch it observably mid-flight.
+        clerk.admin("join", {2: ["p2"]})
+        deadline = time.monotonic() + 60.0
+        migrating = False
+        while time.monotonic() < deadline:
+            st = clerk.status(0) or clerk.status(1)
+            if st and st[2]:
+                migrating = True
+                break
+            time.sleep(0.02)
+        assert migrating, "migration never became observable"
+
+        # KILL -9 the minority owner (holds every group's leader).
+        cluster.kill(0)
+
+        # Client load continues through the failover: acked writes
+        # stay visible; new writes land.
+        for k in keys[:3]:
+            clerk.append(k, "[during]")
+            acked[k] += "[during]"
+
+        # The migration completes on the survivor alone.
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = clerk.status(1)
+            if st and st[0] >= 2 and not st[2]:
+                break
+            time.sleep(0.05)
+        st = clerk.status(1)
+        assert st and st[0] >= 2 and not st[2], (
+            f"migration did not complete after the kill: {st}"
+        )
+
+        # Every acked write intact — including on migrated shards.
+        for k in keys:
+            assert clerk.get(k) == acked[k], f"lost acked write on {k}"
+        # And the new owners serve fresh writes on migrated shards.
+        shards = st[1]
+        moved = next(k for k in keys if shards[key2shard(k)] == 2)
+        clerk.append(moved, "[post]")
+        assert clerk.get(moved) == acked[moved] + "[post]"
+    finally:
+        if clerk is not None:
+            clerk.close()
+        cluster.shutdown()
