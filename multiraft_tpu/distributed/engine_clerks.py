@@ -1,0 +1,275 @@
+"""Clerk-side clients of the engine serving stack (split out of
+engine_server.py round 4): the single-server retry clerk, the
+pipelined multi-op frame clerk, and the fleet clerks that route
+key→shard→gid→process from the replicated config (reference loops:
+kvraft/client.go:47-71, shardkv/client.go:68-129).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..sim.scheduler import TIMEOUT, Future
+from ..utils.ids import unique_client_id
+from .engine_wire import OK, EngineCmdArgs
+
+__all__ = [
+    "EngineClerk",
+    "PipelinedClerk",
+    "EngineShardNetClerk",
+    "EngineFleetClerk",
+    "PipelinedFleetClerk",
+]
+
+
+class EngineClerk:
+    """Generator-coroutine client of an engine KV/shard server —
+    retry-until-answer with session dedup, mirroring the reference
+    clerk loop (kvraft/client.go:47-71) against the single front door."""
+
+    # Clerks are created from concurrent threads (one per blocking
+    # client); the counter allocation must be atomic or two clerks
+    # share a client_id and dedup silently drops one's writes.
+    _next = itertools.count(1)
+
+    def __init__(self, sched, end, service: str = "EngineKV") -> None:
+        self.sched = sched
+        self.end = end
+        self.service = service
+        self.client_id = unique_client_id(next(EngineClerk._next))
+        self.command_id = 0
+
+    def _command(self, op: str, key: str, value: str = ""):
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        while True:
+            fut: Future = self.end.call(f"{self.service}.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if (
+                reply is None
+                or reply is TIMEOUT
+                or reply.err != OK
+            ):
+                continue  # lost/timed out/old leader: retry (dedup-safe)
+            return reply.value
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+
+class PipelinedClerk(EngineClerk):
+    """Clerk that ships a whole batch of ops as ONE ``batch`` frame —
+    the reference clerk's serial loop (kvraft/client.go:47-71) widened
+    for the engine's coalescing front door: the server applies the
+    frame in one pump, so per-op RPC overhead amortizes ~frame-fold.
+    Whole-frame retry is dedup-safe (same client/command ids)."""
+
+    # Mirror of EngineKVService.MAX_BATCH: oversized op lists split
+    # into compliant frames client-side (the server's rejection is
+    # permanent, so retrying an oversized frame would spin forever).
+    MAX_FRAME = 1024
+
+    def run_batch(self, ops):
+        """ops = [(op, key, value), ...] → list of values (Gets) in
+        order.  Generator (spawn on the scheduler)."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_frame(ops[s:s + self.MAX_FRAME])
+            out.extend(part)
+        return out
+
+    def _one_frame(self, ops):
+        frame = []
+        for op, key, value in ops:
+            if op != "Get":
+                self.command_id += 1
+            frame.append(
+                EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=self.client_id,
+                    command_id=self.command_id,
+                )
+            )
+        while True:
+            fut: Future = self.end.call(f"{self.service}.batch", frame)
+            reply = yield self.sched.with_timeout(fut, 10.0)
+            if reply is not None and reply is not TIMEOUT and any(
+                r.err.startswith("ErrBatchTooLarge") for r in reply
+            ):
+                # Permanent: the server's cap shrank below ours.
+                raise ValueError(reply[0].err)
+            if (
+                reply is None
+                or reply is TIMEOUT
+                or any(r.err != OK for r in reply)
+            ):
+                continue  # lost/partial frame: retry whole (dedup-safe)
+            return [r.value for r in reply]
+
+
+class EngineShardNetClerk(EngineClerk):
+    def __init__(self, sched, end) -> None:
+        super().__init__(sched, end, service="EngineShardKV")
+
+
+class EngineFleetClerk:
+    """Clerk for a fleet of engine shard servers: route key→shard→gid→
+    process from the replicated config, re-query and re-route on
+    ErrWrongGroup — the reference clerk loop (shardkv/client.go:68-129)
+    where each "group" is a chip-owning process."""
+
+    def __init__(self, sched, ends_by_gid: dict) -> None:
+        self.sched = sched
+        self.ends = dict(ends_by_gid)  # gid -> TcpClientEnd
+        self._all = list(dict.fromkeys(self.ends.values()))
+        self.client_id = unique_client_id(next(EngineClerk._next))
+        self.command_id = 0
+        self._cfg = None  # cached (num, shards, groups)
+
+    def _refresh_config(self):
+        while True:
+            for end in self._all:
+                fut = end.call("EngineShardKV.config", ())
+                reply = yield self.sched.with_timeout(fut, 2.0)
+                if reply is not None and reply is not TIMEOUT:
+                    self._cfg = reply
+                    return reply
+            yield self.sched.sleep(0.05)
+
+    def _command(self, op: str, key: str, value: str = ""):
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        if op != "Get":
+            self.command_id += 1
+        args = EngineCmdArgs(
+            op=op, key=key, value=value,
+            client_id=self.client_id, command_id=self.command_id,
+        )
+        while True:
+            cfg = self._cfg
+            if cfg is None:
+                cfg = yield from self._refresh_config()
+            gid = cfg[1][key2shard(key)]
+            end = self.ends.get(gid)
+            if end is None:  # unassigned shard / unknown gid: re-query
+                yield self.sched.sleep(0.05)
+                self._cfg = None
+                continue
+            fut = end.call("EngineShardKV.command", args)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if reply is None or reply is TIMEOUT:
+                self._cfg = None
+                continue  # dropped / wedged: re-route and retry
+            if reply.err == OK:
+                return reply.value
+            if reply.err == ERR_WRONG_GROUP:
+                self._cfg = None  # stale routing: re-query the config
+            yield self.sched.sleep(0.02)
+
+    def get(self, key: str):
+        return self._command("Get", key)
+
+    def put(self, key: str, value: str):
+        return self._command("Put", key, value)
+
+    def append(self, key: str, value: str):
+        return self._command("Append", key, value)
+
+
+class PipelinedFleetClerk(EngineFleetClerk):
+    """Multi-op frames over a sharded fleet: each round partitions the
+    remaining ops by owning process (key→shard→gid→end from the
+    replicated config) and ships one ``batch`` frame per process; ops
+    answered ErrWrongGroup (shard mid-migration / stale routing)
+    re-frame to the new owner next round.  Order safety: a frame's
+    chains fully resolve server-side before it answers, so re-framed
+    retries can never interleave with in-flight ops."""
+
+    # Ops per sequential WINDOW.  An oversized batch must NOT split
+    # into concurrently-in-flight frames: a (client, shard) chain
+    # spanning two live frames breaks the serial-chain discipline the
+    # server's dedup safety rests on (op N+1 applying while op N is
+    # unresolved lets N's retry dedup-swallow into a false OK).  Each
+    # window fully resolves before the next ships.
+    MAX_FRAME = 1024
+
+    def run_batch(self, ops):
+        """ops = [(op, key, value), ...] → list of values in order."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_window(ops[s:s + self.MAX_FRAME])
+            out.extend(part)
+        return out
+
+    def _one_window(self, ops):
+        from ..services.shardkv import key2shard
+
+        frame_args = []
+        for op, key, value in ops:
+            if op != "Get":
+                self.command_id += 1
+            frame_args.append(
+                EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=self.client_id,
+                    command_id=self.command_id,
+                )
+            )
+        results = [None] * len(ops)
+        todo = list(range(len(ops)))
+        while todo:
+            cfg = self._cfg
+            if cfg is None:
+                cfg = yield from self._refresh_config()
+            by_end: dict = {}
+            unrouted = []
+            for i in todo:
+                gid = cfg[1][key2shard(frame_args[i].key)]
+                end = self.ends.get(gid)
+                if end is None:
+                    unrouted.append(i)
+                else:
+                    by_end.setdefault(end, []).append(i)
+            retry = list(unrouted)
+            # Dispatch every process's frame FIRST, then collect:
+            # wall-clock is the slowest frame, not the sum.  (Frames
+            # are per-process partitions of one ≤MAX_FRAME window, so
+            # none can exceed the server's cap.)
+            flights = [
+                (idxs, end.call(
+                    "EngineShardKV.batch",
+                    [frame_args[i] for i in idxs],
+                ))
+                for end, idxs in by_end.items()
+            ]
+            for part, fut in flights:
+                reply = yield self.sched.with_timeout(fut, 10.0)
+                if reply is None or reply is TIMEOUT:
+                    retry.extend(part)
+                    continue
+                if any(
+                    r.err.startswith("ErrBatchTooLarge") for r in reply
+                ):
+                    # Permanent: the server's cap shrank below ours.
+                    raise ValueError(reply[0].err)
+                for i, r in zip(part, reply):
+                    if r.err == OK:
+                        results[i] = r.value
+                    else:
+                        retry.append(i)
+            todo = sorted(retry)
+            if todo:
+                self._cfg = None  # routing moved: re-query
+                yield self.sched.sleep(0.02)
+        return results

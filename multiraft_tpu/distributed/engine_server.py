@@ -27,20 +27,28 @@ Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
 
 from __future__ import annotations
 
-import dataclasses
-import itertools
 import os
-import time
-import zlib
-from typing import Any, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
 from ..engine.host import EngineDriver
 from ..engine.kv import BatchedKV, KVOp
-from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
-from ..sim.scheduler import TIMEOUT, Future
-from ..transport import codec
-from ..utils.ids import unique_client_id
+from ..porcupine.kv import OP_GET
+from .engine_durability import (
+    EngineDurability,
+    await_frame_synced,
+    replay_kv_wal,
+)
+from .engine_wire import (
+    _OPCODE,
+    _OPNAME,
+    ERR_TIMEOUT,
+    OK,
+    EngineCmdArgs,
+    EngineCmdReply,
+    make_mesh,
+    route_group,
+)
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 
@@ -57,135 +65,6 @@ __all__ = [
     "serve_engine_kv",
     "serve_engine_shardkv",
 ]
-
-OK = "OK"
-ERR_TIMEOUT = "ErrTimeout"
-
-_OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
-_OPNAME = {v: k for k, v in _OPCODE.items()}
-
-
-class EngineDurability:
-    """Checkpoint + WAL lifecycle for one engine server process.
-
-    The engine's durability contract (see distributed/wal.py): periodic
-    atomic whole-engine checkpoints + a WAL of ops since the last one;
-    write acks gate on the WAL record being fsynced (group commit at
-    pump cadence, so the fsync amortizes over every op in the ~2 ms
-    window).  Recovery restores the checkpoint and re-submits WAL
-    records through consensus — session dedup makes it exactly-once."""
-
-    def __init__(
-        self,
-        data_dir: str,
-        driver: EngineDriver,
-        state_owner,  # has state_dict() (BatchedKV / BatchedShardKV)
-        checkpoint_every_s: float = 30.0,
-        fsync: bool = True,
-    ) -> None:
-        from .wal import WriteAheadLog
-
-        os.makedirs(data_dir, exist_ok=True)
-        self.ckpt_path = os.path.join(data_dir, "engine.ckpt")
-        self.wal = WriteAheadLog(os.path.join(data_dir, "ops.wal"),
-                                 fsync=fsync)
-        self.driver = driver
-        self.state_owner = state_owner
-        self.every = checkpoint_every_s
-        self._last_ckpt = time.monotonic()
-
-    def log(self, record) -> int:
-        """Append one op record; returns its ack-gate seq."""
-        return self.wal.append(codec.encode(record))
-
-    def synced(self, seq: int) -> bool:
-        return self.wal.synced >= seq
-
-    def replay_records(self):
-        for body in self.wal.replay():
-            yield codec.decode(body)
-
-    def after_pump(self) -> None:
-        """Group fsync + periodic checkpoint, called once per pump."""
-        self.wal.sync()
-        if self.every > 0 and (
-            time.monotonic() - self._last_ckpt >= self.every
-        ):
-            self.checkpoint()
-
-    def checkpoint(self) -> None:
-        """Atomic engine+service snapshot, then WAL rotation.  A crash
-        between the two merely makes the next replay redundant."""
-        self.driver.save(
-            self.ckpt_path,
-            extra={"service": self.state_owner.state_dict()},
-        )
-        self.wal.rotate()
-        self._last_ckpt = time.monotonic()
-
-
-@codec.registered
-@dataclasses.dataclass
-class EngineCmdArgs:
-    op: str = "Get"
-    key: str = ""
-    value: str = ""
-    client_id: int = 0
-    command_id: int = 0
-
-
-@codec.registered
-@dataclasses.dataclass
-class EngineCmdReply:
-    err: str = OK
-    value: str = ""
-
-
-def route_group(key: str, G: int) -> int:
-    """Deterministic key→group routing shared by every process (a
-    stable hash — Python's builtin is salted per process)."""
-    return zlib.crc32(key.encode()) % G
-
-
-def _await_frame_synced(sched, dur, write_seqs, ok, args_list, deadline):
-    """Durable frame-ack gate shared by both services' ``batch``
-    handlers (yield-from inside the handler generator): every write in
-    ``ok`` must have its apply-time WAL record fsynced before it may
-    ack OK; at the deadline, unsynced writes are DROPPED from ``ok``
-    (they answer ErrTimeout — never a false durable ack)."""
-    while dur is not None:
-        pend = [
-            i for i in ok
-            if (s := write_seqs.get(
-                (args_list[i].client_id, args_list[i].command_id)
-            )) is not None and not dur.synced(s)
-        ]
-        if not pend:
-            break
-        if sched.now >= deadline:
-            ok -= set(pend)
-            break
-        yield 0.002
-
-
-def _make_mesh(n_devices: int):
-    """A 1-D ``groups`` mesh over the first ``n_devices`` local devices
-    — the production entry to the shard_map tick (engine/mesh.py): the
-    server's state lives sharded across its chips, consensus stays
-    zero-collective, and the same driver/pump/checkpoint path serves
-    single- and multi-chip alike."""
-    import jax
-    import numpy as np
-    from jax.sharding import Mesh
-
-    if n_devices <= 0:
-        raise ValueError(f"mesh_devices must be positive, got {n_devices}")
-    devs = jax.devices()
-    if n_devices > len(devs):
-        raise ValueError(
-            f"mesh_devices={n_devices} > {len(devs)} visible devices"
-        )
-    return Mesh(np.array(devs[:n_devices]), ("groups",))
 
 
 class EngineKVService:
@@ -258,70 +137,11 @@ class EngineKVService:
         self.sched.call_after(self._interval, self._pump_loop)
 
     def replay_wal(self) -> int:
-        """Re-submit every WAL record through consensus (recovery path;
-        runs to completion before the server starts answering).  Dedup
-        tables make records already in the checkpoint no-ops.
-
-        STRICTLY one record at a time PER GROUP: the WAL is
-        commit-ordered, and both order guarantees that replay must
-        reproduce are group-local — a client's cmd N vs N+1 (an
-        eviction committing N+1 first would dedup-swallow the
-        resubmitted N) and cross-client order on a shared key (an
-        acked A-then-B pair replayed B-then-A would recover the wrong
-        value).  A key routes to exactly one group, so serial-per-group
-        preserves both while groups pipeline through each pump wave:
-        recovery wall-clock scales with the deepest single-group
-        backlog, not the WAL length.  With the default 30 s checkpoint
-        interval the WAL bounds to ~30 s of acked writes, so expected
-        RTO ≈ that backlog's longest per-group chain at one commit per
-        ~2 pump rounds."""
-        if self._dur is None:
-            return 0
-        recs = [rec for rec in self._dur.replay_records() if rec[0] == "kv"]
-        queues: dict = {}
-        for rec in recs:
-            queues.setdefault(route_group(rec[2], self.G), []).append(rec)
-
-        def submit(rec):
-            _, op, key, value, cid, cmd = rec
-            return self.kv.submit(
-                route_group(key, self.G),
-                KVOp(op=_OPCODE[op], key=key, value=value,
-                     client_id=cid, command_id=cmd),
-            )
-
-        depth = max((len(q) for q in queues.values()), default=0)
-        max_rounds = 4000 + 200 * depth
-        pending: dict = {}  # group -> [ticket, attempts_left, submit_round]
-        rounds = 0
-        while queues:
-            for g in queues:
-                if g not in pending:
-                    pending[g] = [submit(queues[g][0]), 50, rounds]
-            self.kv.pump(2)
-            rounds += 1
-            for g, (t, left, since) in list(pending.items()):
-                resubmit = False
-                if t.done and not t.failed:
-                    queues[g].pop(0)
-                    del pending[g]
-                    if not queues[g]:
-                        del queues[g]
-                elif t.done and t.failed:
-                    resubmit = True  # evicted: same ids, dedup-safe
-                elif rounds - since >= 600:
-                    resubmit = True  # wedged ticket (binding lost)
-                if resubmit:
-                    if left <= 1:
-                        rec = queues[g][0]
-                        raise RuntimeError(
-                            f"WAL replay of {rec[1]}({rec[2]!r}) did not "
-                            "converge"
-                        )
-                    pending[g] = [submit(queues[g][0]), left - 1, rounds]
-            if rounds > max_rounds:
-                raise RuntimeError("WAL replay did not converge")
-        return len(recs)
+        """Recovery replay — delegated to
+        :func:`~.engine_durability.replay_kv_wal` (strictly one record
+        in flight per group; see its docstring for the full
+        contract)."""
+        return replay_kv_wal(self.kv, self._dur, self.G)
 
     # Largest multi-op frame one RPC may carry (bounds the per-pump
     # submit burst a single frame can impose).
@@ -404,7 +224,7 @@ class EngineKVService:
             # Durable mode: one group fsync covers the whole frame
             # (shared gate — see _await_frame_synced).
             synced_ok = set(tickets)
-            yield from _await_frame_synced(
+            yield from await_frame_synced(
                 self.sched, self._dur, self._write_seqs, synced_ok,
                 args_list, deadline,
             )
@@ -472,900 +292,6 @@ class EngineKVService:
         return run()
 
 
-class EngineShardKVService:
-    """``EngineShardKV.command``: the sharded engine service behind the
-    same TCP front door.  Key→shard routing happens server-side against
-    the replicated config; WRONG_GROUP during migration re-routes like
-    the reference clerk (shardkv/client.go:68-129).
-
-    **Fleet mode** (``peers`` given): this process hosts a subset of
-    the global gid space and its ``BatchedShardKV`` migrates shards
-    to/from peer processes over the network — ``remote_fetch`` becomes
-    a ``pull_shard`` RPC to the owning peer, ``remote_delete`` a
-    ``delete_shard`` RPC riding the peer's log (Challenge 1 across
-    processes).  Ops for a gid hosted elsewhere answer ErrWrongGroup so
-    the fleet clerk re-routes, exactly like a reference group answering
-    for a shard it no longer owns."""
-
-    RESUBMIT_S = 0.25
-    DEADLINE_S = 5.0
-    # Per-RPC bound on one migration fetch/delete attempt; the
-    # orchestration sweep re-issues after a timeout.
-    MIGRATE_RPC_S = 2.0
-
-    def __init__(
-        self,
-        sched: RealtimeScheduler,
-        skv,  # BatchedShardKV
-        pump_interval: float = 0.002,
-        ticks_per_pump: int = 2,
-        peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
-        durability: Optional[EngineDurability] = None,
-    ) -> None:
-        self.sched = sched
-        self.skv = skv
-        self._interval = pump_interval
-        self._ticks = ticks_per_pump
-        self._stopped = False
-        self.peers = dict(peers or {})
-        self._fleet = bool(self.peers)
-        self._dur = durability
-        # seq of the WAL record covering each applied insert — the GC
-        # gate below refuses to ask the old owner to delete until the
-        # inserted blob (possibly the last copy) is fsynced here.
-        self._insert_seqs: dict = {}
-        # (client_id, command_id) -> WAL seq, apply-time (commit order)
-        # — see EngineKVService; pruned once synced.
-        self._write_seqs: dict = {}
-        self._admin_seqs: dict = {}  # command_id -> WAL seq
-        # seq of the WAL record covering each applied delete — the
-        # delete_shard RPC reply gates on it being fsynced: the puller
-        # confirms (and never re-asks) the moment we answer OK, so an
-        # OK that could be lost to a crash would leave a BEPULLING slot
-        # here that nothing ever clears, wedging config advance.
-        self._delete_seqs: dict = {}
-        if self._dur is not None:
-            skv.on_insert = self._on_insert_applied
-            skv.on_delete = self._on_delete_applied
-            skv.on_confirm = self._on_confirm_applied
-            # The committing gid travels in the record: recovery REDOES
-            # the write into that gid's slot directly (see
-            # _redo_client_op) — re-routing by the latest config would
-            # drop a write acked at an old owner just before a config
-            # change, and a peer that never pulled pre-crash would then
-            # pull an empty slot.
-            skv.on_write = lambda gid, op: self._write_seqs.__setitem__(
-                (op.client_id, op.command_id),
-                durability.log(("skv", gid, op.op, op.key, op.value,
-                                op.client_id, op.command_id)),
-            )
-            skv.on_ctrl = lambda op: self._admin_seqs.__setitem__(
-                op.command_id,
-                durability.log(("admin", op.kind, op.arg, op.command_id)),
-            )
-        if self._fleet:
-            self._fetches: dict = {}  # (gid, shard, num) -> Future
-            self._deletes: dict = {}
-            skv.remote_fetch = self._remote_fetch
-            skv.remote_delete = self._remote_delete
-        sched.call_soon(self._pump_loop)
-
-    # -- durability hooks (apply-time, loop thread) -----------------------
-
-    def _on_insert_applied(self, gid, shard, num, data, latest):
-        self._insert_seqs[(gid, shard, num)] = self._dur.log(
-            ("insert", gid, shard, num, dict(data), dict(latest))
-        )
-
-    def _on_delete_applied(self, gid, shard, num):
-        # Replayed on restore so a stale BEPULLING slot can't survive an
-        # older checkpoint and wedge config advance.
-        self._delete_seqs[(gid, shard, num)] = self._dur.log(
-            ("delete", gid, shard, num)
-        )
-
-    def _on_confirm_applied(self, gid, shard, num):
-        # Replayed on restore so recovery re-applies GCING→SERVING
-        # locally instead of re-running the GC handshake — during
-        # replay the loop thread is busy replaying, so an RPC to a
-        # remote old owner could never resolve and recovery would
-        # wedge (the confirm only ever committed because the delete
-        # leg already succeeded pre-crash).
-        self._dur.log(("confirm", gid, shard, num))
-
-    # -- fleet migration hooks (run on the loop thread, inside pump) ------
-
-    def _remote_fetch(self, src_gid: int, shard: int, num: int):
-        from ..engine.shardkv import OK as SK_OK
-
-        key = (src_gid, shard, num)
-        fut = self._fetches.get(key)
-        if fut is None:
-            end = self.peers.get(src_gid)
-            if end is None:
-                return None  # unroutable: keep retrying (config may fix)
-            self._fetches[key] = self.sched.with_timeout(
-                end.call("EngineShardKV.pull_shard", (src_gid, shard, num)),
-                self.MIGRATE_RPC_S,
-            )
-            return None
-        if not fut.done:
-            return None
-        del self._fetches[key]  # resolved: consume or retry next sweep
-        reply = fut.value
-        if (
-            reply is None or reply is TIMEOUT
-            or not isinstance(reply, tuple) or reply[0] != SK_OK
-        ):
-            return None  # dropped / not ready: the sweep re-issues
-        return reply[1], reply[2]
-
-    def _remote_delete(self, src_gid: int, shard: int, num: int):
-        from ..engine.shardkv import OK as SK_OK
-
-        # Durability gate: never tell the old owner to delete a shard
-        # whose inserted copy isn't fsynced locally yet — between its
-        # delete and our next checkpoint/WAL-sync, a crash would lose
-        # the only copy.  One pump's group fsync clears this.
-        if self._dur is not None:
-            for (g, s, n), seq in self._insert_seqs.items():
-                if s == shard and n == num and not self._dur.synced(seq):
-                    return None
-        key = (src_gid, shard, num)
-        fut = self._deletes.get(key)
-        if fut is None:
-            end = self.peers.get(src_gid)
-            if end is None:
-                return True  # owner unknown everywhere: nothing to delete
-            self._deletes[key] = self.sched.with_timeout(
-                end.call("EngineShardKV.delete_shard", (src_gid, shard, num)),
-                self.MIGRATE_RPC_S,
-            )
-            return None
-        if not fut.done:
-            return None
-        del self._deletes[key]
-        reply = fut.value
-        if reply is None or reply is TIMEOUT or not isinstance(reply, tuple):
-            return None  # dropped: re-issue next sweep
-        return reply[0] == SK_OK  # False = ErrNotReady, re-asked later
-
-    # -- fleet migration RPC handlers (the serving side of the hooks) -----
-
-    def pull_shard(self, args):
-        """Return ``(OK, data, latest)`` for a shard this process's old
-        owner holds, once it has applied the puller's config number —
-        the cross-process form of the in-process applied-state read
-        (engine/shardkv.py _orchestrate step (b))."""
-        from ..engine.shardkv import ERR_NOT_READY, ERR_WRONG_GROUP
-        from ..engine.shardkv import OK as SK_OK
-
-        src_gid, shard, num = args
-        if src_gid not in self.skv.reps:
-            return (ERR_WRONG_GROUP,)
-
-        def run():
-            deadline = self.sched.now + self.DEADLINE_S
-            while self.sched.now < deadline:
-                rep = self.skv.reps[src_gid]
-                if rep.cur.num >= num:
-                    sh = rep.shards[shard]
-                    return (SK_OK, dict(sh.data), dict(sh.latest))
-                yield 0.01  # config catching up (the ErrNotReady gate)
-            return (ERR_NOT_READY,)
-
-        return run()
-
-    def delete_shard(self, args):
-        """Challenge-1 deletion on behalf of a remote puller: ride the
-        local old owner's log (BatchedShardKV.delete_shard) and report
-        the outcome."""
-        from ..engine.shardkv import ERR_WRONG_GROUP
-        from ..engine.shardkv import OK as SK_OK
-
-        src_gid, shard, num = args
-        if src_gid not in self.skv.reps:
-            return (ERR_WRONG_GROUP,)
-
-        def run():
-            t = self.skv.delete_shard(src_gid, shard, num)
-            deadline = self.sched.now + self.DEADLINE_S
-            while self.sched.now < deadline:
-                if t.done:
-                    if t.failed:
-                        return (ERR_TIMEOUT,)
-                    if t.err != SK_OK:
-                        return (t.err,)
-                    # Gate the OK on the delete's WAL record being
-                    # fsynced: the puller confirms on our OK and never
-                    # re-asks, so losing the record to a crash would
-                    # strand a BEPULLING slot here forever.  (Absent =
-                    # pruned = already durable, or the slot was already
-                    # clear and no record was written — also durable.)
-                    # Deadline-bounded: a stalled fsync must surface as
-                    # a timeout the puller retries, not a pinned
-                    # generator.
-                    while self._dur is not None:
-                        seq = self._delete_seqs.get((src_gid, shard, num))
-                        if seq is None or self._dur.synced(seq):
-                            break
-                        if self.sched.now >= deadline:
-                            return (ERR_TIMEOUT,)
-                        yield 0.002
-                    return (SK_OK,)
-                yield 0.005
-            return (ERR_TIMEOUT,)
-
-        return run()
-
-    def config(self, args):
-        """Latest committed config as ``(num, shards, groups)`` — the
-        fleet clerk's routing source (shardctrler Query analog)."""
-        cfg = self.skv.query_latest()
-        return (
-            cfg.num,
-            list(cfg.shards),
-            {g: list(v) for g, v in cfg.groups.items()},
-        )
-
-    def stop(self) -> None:
-        self._stopped = True
-
-    def final_checkpoint(self) -> bool:
-        """Graceful-shutdown hook — see EngineKVService."""
-        if self._dur is None:
-            return False
-        self._dur.checkpoint()
-        return True
-
-    def _pump_loop(self) -> None:
-        if self._stopped:
-            return
-        self.skv.pump(self._ticks)
-        if self._dur is not None:
-            self._dur.after_pump()  # group fsync + periodic checkpoint
-            for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs",
-                         "_delete_seqs"):
-                seqs = getattr(self, attr)
-                if seqs:
-                    setattr(self, attr, {
-                        k: v for k, v in seqs.items()
-                        if not self._dur.synced(v)
-                    })
-        self.sched.call_after(self._interval, self._pump_loop)
-
-    def replay_wal(self) -> int:
-        """Recovery replay in two passes over the (commit-ordered) WAL:
-
-        1. admin records rebuild the config history, in order, each
-           retried until it actually commits (an eviction during
-           recovery must not silently skip a config — the fleet's
-           histories would diverge);
-        2. insert/delete/confirm/client records re-ride the local logs
-           in WAL order, with their apply-time gates making anything
-           already in the checkpoint a no-op.
-
-        PULLS and the live GC/confirm handshake are paused for the
-        duration via ``skv.migration_paused`` — a pull completing
-        mid-replay would copy a slot before its redo records landed,
-        and a GC handshake whose old owner is a REMOTE peer can never
-        resolve here (this method runs synchronously on the scheduler
-        loop, so peer RPC replies are not serviced until it returns).
-        Committed GCING→SERVING transitions are instead re-applied from
-        the WAL's "confirm" records — the pre-crash handshake already
-        ran its delete leg, so replaying the confirm alone is sound —
-        which keeps config advance (needs all-SERVING) purely local.
-        A slot whose confirm had not committed pre-crash stays GCING
-        through replay; the post-replay pump loop re-runs its handshake
-        live (idempotent at the peer)."""
-        if self._dur is None:
-            return 0
-        recs = list(self._dur.replay_records())
-        self.skv.migration_paused = True
-        try:
-            for rec in recs:
-                if rec[0] == "admin":
-                    self._replay_admin(rec[1], rec[2], rec[3])
-            for rec in recs:
-                kind = rec[0]
-                if kind == "insert":
-                    self._replay_insert(*rec[1:])
-                elif kind == "delete":
-                    _, gid, shard, num = rec
-                    if gid in self.skv.reps:
-                        # The apply gate answers ErrNotReady while the
-                        # source rep is behind `num` — wait like the
-                        # insert replay does, or the record would
-                        # "succeed" as a no-op and the stale BEPULLING
-                        # slot would wedge config advance forever.
-                        self._await_config(gid, num, "a delete record")
-                        self._retry_until_ok(
-                            lambda: self.skv.delete_shard(gid, shard, num)
-                        )
-                elif kind == "confirm":
-                    _, gid, shard, num = rec
-                    if gid in self.skv.reps:
-                        # Re-apply the committed GCING→SERVING flip
-                        # locally (never the cross-process handshake —
-                        # see the docstring).  Gated on the rep having
-                        # reached config `num` like insert/delete.
-                        self._await_config(gid, num, "a confirm record")
-                        self._retry_until_ok(
-                            lambda: self.skv.confirm_shard(gid, shard, num)
-                        )
-                elif kind == "skv":
-                    if len(rec) != 7:
-                        # Records from the pre-gid WAL format cannot be
-                        # routed safely — refuse loudly rather than
-                        # misparse (shifted fields) or silently drop.
-                        raise RuntimeError(
-                            "WAL 'skv' record has legacy format "
-                            f"({len(rec)} fields); cannot replay"
-                        )
-                    _, gid, op, key, value, cid, cmd = rec
-                    self._redo_client_op(gid, op, key, value, cid, cmd)
-            # Drain: let every replayed proposal commit before serving.
-            self._pump_until(lambda: False, max_rounds=50)
-        finally:
-            self.skv.migration_paused = False
-        return len(recs)
-
-    def _pump_until(self, cond, max_rounds: int = 4000) -> bool:
-        for _ in range(max_rounds):
-            if cond():
-                return True
-            self.skv.pump(2)
-        return cond()
-
-    def _await_config(self, gid: int, num: int, what: str) -> None:
-        """Pump until rep ``gid`` has applied config ``num`` (replay
-        gate shared by insert and delete records); a timeout is a real
-        recovery failure, raised loudly."""
-        rep = self.skv.reps[gid]
-        if not self._pump_until(lambda: rep.cur.num >= num):
-            raise RuntimeError(
-                f"replay: rep {gid} never reached config {num} for "
-                f"{what} (stuck at {rep.cur.num})"
-            )
-
-    def _retry_until_ok(self, propose, attempts: int = 50):
-        """Propose-and-wait with eviction retry (leader churn during
-        recovery must not drop a record).  A resolved-but-not-OK ticket
-        (e.g. ErrNotReady) retries too — callers gate config catch-up
-        beforehand, so non-OK can only be transient."""
-        from ..engine.shardkv import OK as SK_OK
-
-        for _ in range(attempts):
-            t = propose()
-            self._pump_until(lambda: t.done)
-            if t.done and not t.failed and t.err == SK_OK:
-                return t
-        raise RuntimeError("WAL replay proposal did not commit")
-
-    def _replay_admin(self, kind, payload, cmd) -> None:
-        def propose():
-            if kind == "move":
-                return self.skv.move(*payload, command_id=cmd)
-            return getattr(self.skv, kind)(payload, command_id=cmd)
-
-        self._retry_until_ok(propose)
-
-    def _replay_insert(self, gid, shard, num, data, latest) -> None:
-        if gid not in self.skv.reps:
-            return
-        from ..engine.shardkv import ShardTicket, _InsertOp
-        from ..services.shardkv import PULLING
-
-        rep = self.skv.reps[gid]
-        # The apply gate needs the rep AT config `num` and PULLING —
-        # wait for orchestration to advance it there (earlier inserts/
-        # configs already replayed), else the insert would silently
-        # no-op and a later remote re-fetch could find the peer's copy
-        # already GC'd.
-        self._await_config(gid, num, "an insert record")
-        if rep.cur.num != num or rep.shards[shard].state != PULLING:
-            return  # checkpoint already contains this insert's effects
-
-        def propose():
-            t = ShardTicket(group=gid)
-            self.skv.driver.start(
-                self.skv._g2l[gid],
-                _InsertOp(config_num=num, shard=shard, data=dict(data),
-                          latest=dict(latest), ticket=t),
-            )
-            return t
-
-        self._retry_until_ok(propose)
-
-    def _redo_client_op(self, gid, op, key, value, cid, cmd) -> None:
-        """REDO one acknowledged write into the slot of the gid that
-        committed it, directly on the host state — the standard
-        redo-log discipline.  Routing/ownership gates don't apply to
-        redo: the op already linearized pre-crash; in particular a
-        write acked just before its shard went BEPULLING must land in
-        that (now non-serving) slot so a peer's later pull sees it, and
-        a subsequent WAL delete record clears it in order."""
-        from ..services.shardkv import key2shard
-
-        rep = self.skv.reps.get(gid)
-        if rep is None:
-            return  # record from a gid this process no longer hosts
-        sh = rep.shards[key2shard(key)]
-        if sh.latest.get(cid, -1) >= cmd:
-            return  # already in the checkpoint / an earlier redo
-        if op == "Put":
-            sh.data[key] = value
-        elif op == "Append":
-            sh.data[key] = sh.data.get(key, "") + value
-        sh.latest[cid] = cmd
-
-    # Largest multi-op frame one RPC may carry (see EngineKVService).
-    MAX_BATCH = 1024
-
-    def batch(self, args_list):
-        """Multi-op frame for the SHARDED service.  Chains key on
-        (client, shard) — a shard's dedup table travels with it and
-        same-key ops share a shard — and run STRICTLY one op in flight
-        each, the reference clerk's serial discipline
-        (shardkv/client.go:68-129): pipelining within a chain is
-        unsafe here because an away-and-back shard migration can let a
-        later op apply while an earlier one bounced ErrWrongGroup, and
-        the earlier op's retry then dedup-swallows into a false OK.
-        The frame's parallelism comes from chains to DIFFERENT shards
-        pipelining freely.  In fleet mode, ops whose shard a peer
-        process owns answer ErrWrongGroup per-op so the fleet clerk
-        re-frames them to the owner."""
-        from ..engine.shardkv import ERR_WRONG_GROUP
-        from ..services.shardkv import key2shard
-
-        if len(args_list) > self.MAX_BATCH:
-            return [
-                EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
-            ] * len(args_list)
-
-        def run():
-            deadline = self.sched.now + self.DEADLINE_S
-            replies = [None] * len(args_list)
-            chains: dict = {}
-            for i, a in enumerate(args_list):
-                if a.op == "Get":
-                    continue
-                chains.setdefault(
-                    (a.client_id, key2shard(a.key)), []
-                ).append(i)
-
-            def submit(a):
-                cfg = self.skv.query_latest()
-                gid = cfg.shards[key2shard(a.key)]
-                if gid not in self.skv.reps:
-                    return None  # peer-owned (or unassigned) shard
-                return self.skv.submit(
-                    gid, a.op, a.key, a.value,
-                    client_id=a.client_id, command_id=a.command_id,
-                )
-
-            tickets: dict = {}   # frame idx -> resolved-OK ticket
-            wrong: set = set()   # frame idx -> answer ErrWrongGroup
-            heads: dict = {}     # chain -> (frame idx, live ticket)
-            cursor = {qk: 0 for qk in chains}
-            pending = set(chains)
-            while pending and self.sched.now < deadline:
-                progressed = False
-                for qk in list(pending):
-                    members = chains[qk]
-                    if qk not in heads:
-                        i = members[cursor[qk]]
-                        t = submit(args_list[i])
-                        if t is None:
-                            if self._fleet:
-                                # Peer-owned: the whole remaining chain
-                                # belongs to that peer — punt it.
-                                for j in members[cursor[qk]:]:
-                                    wrong.add(j)
-                                pending.discard(qk)
-                                progressed = True
-                            continue  # non-fleet: config moving; wait
-                        heads[qk] = (i, t)
-                        continue
-                    i, t = heads[qk]
-                    if not t.done:
-                        continue
-                    del heads[qk]
-                    if t.failed or t.err == ERR_WRONG_GROUP:
-                        continue  # resubmit next round (dedup-safe)
-                    tickets[i] = t
-                    cursor[qk] += 1
-                    progressed = True
-                    if cursor[qk] >= len(members):
-                        pending.discard(qk)
-                if pending and not progressed:
-                    yield 0.002
-            # Durable frame ack (shared gate — see _await_frame_synced).
-            ok = {
-                i for i, t in tickets.items()
-                if t.done and not t.failed and t.err == OK
-            }
-            yield from _await_frame_synced(
-                self.sched, self._dur, self._write_seqs, ok,
-                args_list, deadline,
-            )
-            for i, a in enumerate(args_list):
-                if a.op == "Get":
-                    t = self.skv.get_fast(a.key)
-                    if t.err == ERR_WRONG_GROUP:
-                        replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
-                    else:
-                        replies[i] = EngineCmdReply(
-                            err=OK, value=t.value if t.err == OK else ""
-                        )
-                elif i in wrong:
-                    replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
-                elif i in ok:
-                    replies[i] = EngineCmdReply(
-                        err=OK, value=tickets[i].value
-                    )
-                else:
-                    replies[i] = EngineCmdReply(err=ERR_TIMEOUT)
-            return replies
-
-        return run()
-
-    def command(self, args: EngineCmdArgs):
-        from ..engine.shardkv import ERR_WRONG_GROUP
-        from ..services.shardkv import key2shard
-
-        if args.op == "Get":
-            # ReadIndex fast read (BatchedShardKV.get_fast): no log
-            # entry, gated on serving-shard ownership exactly like the
-            # logged path; ErrWrongGroup during migration pumps and
-            # retries like any clerk op.
-            def run_get():
-                deadline = self.sched.now + self.DEADLINE_S
-                while self.sched.now < deadline:
-                    t = self.skv.get_fast(args.key)
-                    if t.err == ERR_WRONG_GROUP:
-                        # Fleet: the owner is (probably) another
-                        # process — answer so the clerk re-routes.
-                        if self._fleet:
-                            return EngineCmdReply(err=ERR_WRONG_GROUP)
-                        yield 0.01  # config moving; shard not serving here
-                        continue
-                    value = t.value if t.err == OK else ""
-                    return EngineCmdReply(err=OK, value=value)
-                return EngineCmdReply(err=ERR_TIMEOUT)
-
-            return run_get()
-
-        def run():
-            deadline = self.sched.now + self.DEADLINE_S
-            while self.sched.now < deadline:
-                cfg = self.skv.query_latest()
-                gid = cfg.shards[key2shard(args.key)]
-                if gid not in self.skv.reps:
-                    if self._fleet:
-                        # Hosted by a peer process: tell the clerk.
-                        return EngineCmdReply(err=ERR_WRONG_GROUP)
-                    yield 0.01  # shard unassigned; config still moving
-                    continue
-                t = self.skv.submit(
-                    gid, args.op, args.key, args.value,
-                    client_id=args.client_id, command_id=args.command_id,
-                )
-                sub_deadline = min(
-                    self.sched.now + self.RESUBMIT_S, deadline
-                )
-                while not t.done and self.sched.now < sub_deadline:
-                    yield 0.002
-                if not t.done or t.failed or t.err == ERR_WRONG_GROUP:
-                    continue  # resubmit / re-route; dedup-safe
-                # Ack gates on the apply-time WAL record being fsynced
-                # (absent = pruned/duplicate = already durable).
-                while self._dur is not None:
-                    seq = self._write_seqs.get(
-                        (args.client_id, args.command_id)
-                    )
-                    if seq is None or self._dur.synced(seq):
-                        break
-                    yield 0.002
-                return EngineCmdReply(err=OK, value=t.value)
-            return EngineCmdReply(err=ERR_TIMEOUT)
-
-        return run()
-
-    ADMIN_OPS = ("join", "leave", "move")
-
-    def admin(self, args):
-        """Config administration: args = (kind, payload[, command_id])
-        with kind in ADMIN_OPS — a network-supplied string must never
-        getattr into arbitrary methods.  The optional command_id makes
-        retries exactly-once through the ctrler dedup table; a FLEET
-        admin MUST pass one (a duplicate apply would fork the config
-        histories' numbering across processes and wedge migration)."""
-        kind, payload = args[0], args[1]
-        cmd = args[2] if len(args) > 2 else None
-        if kind not in self.ADMIN_OPS:
-            return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
-
-        def run():
-            # join/leave take their payload whole (a gid list / mapping);
-            # move takes (shard, gid) as two positionals.
-            if kind == "move":
-                t = self.skv.move(*payload, command_id=cmd)
-            else:
-                t = getattr(self.skv, kind)(payload, command_id=cmd)
-            deadline = self.sched.now + self.DEADLINE_S
-            while self.sched.now < deadline:
-                if t.done:
-                    if t.failed:
-                        return EngineCmdReply(err=ERR_TIMEOUT)
-                    # Ack gates on the apply-time ("admin", ...) WAL
-                    # record (logged by the on_ctrl hook in commit
-                    # order) being fsynced.
-                    while self._dur is not None:
-                        seq = self._admin_seqs.get(t.command_id)
-                        if seq is None or self._dur.synced(seq):
-                            break
-                        yield 0.002
-                    return EngineCmdReply(err=OK)
-                yield 0.005
-            return EngineCmdReply(err=ERR_TIMEOUT)
-
-        return run()
-
-
-class EngineClerk:
-    """Generator-coroutine client of an engine KV/shard server —
-    retry-until-answer with session dedup, mirroring the reference
-    clerk loop (kvraft/client.go:47-71) against the single front door."""
-
-    # Clerks are created from concurrent threads (one per blocking
-    # client); the counter allocation must be atomic or two clerks
-    # share a client_id and dedup silently drops one's writes.
-    _next = itertools.count(1)
-
-    def __init__(self, sched, end, service: str = "EngineKV") -> None:
-        self.sched = sched
-        self.end = end
-        self.service = service
-        self.client_id = unique_client_id(next(EngineClerk._next))
-        self.command_id = 0
-
-    def _command(self, op: str, key: str, value: str = ""):
-        if op != "Get":
-            self.command_id += 1
-        args = EngineCmdArgs(
-            op=op, key=key, value=value,
-            client_id=self.client_id, command_id=self.command_id,
-        )
-        while True:
-            fut: Future = self.end.call(f"{self.service}.command", args)
-            reply = yield self.sched.with_timeout(fut, 3.5)
-            if (
-                reply is None
-                or reply is TIMEOUT
-                or reply.err != OK
-            ):
-                continue  # lost/timed out/old leader: retry (dedup-safe)
-            return reply.value
-
-    def get(self, key: str):
-        return self._command("Get", key)
-
-    def put(self, key: str, value: str):
-        return self._command("Put", key, value)
-
-    def append(self, key: str, value: str):
-        return self._command("Append", key, value)
-
-
-class PipelinedClerk(EngineClerk):
-    """Clerk that ships a whole batch of ops as ONE ``batch`` frame —
-    the reference clerk's serial loop (kvraft/client.go:47-71) widened
-    for the engine's coalescing front door: the server applies the
-    frame in one pump, so per-op RPC overhead amortizes ~frame-fold.
-    Whole-frame retry is dedup-safe (same client/command ids)."""
-
-    # Mirror of EngineKVService.MAX_BATCH: oversized op lists split
-    # into compliant frames client-side (the server's rejection is
-    # permanent, so retrying an oversized frame would spin forever).
-    MAX_FRAME = 1024
-
-    def run_batch(self, ops):
-        """ops = [(op, key, value), ...] → list of values (Gets) in
-        order.  Generator (spawn on the scheduler)."""
-        out = []
-        for s in range(0, len(ops), self.MAX_FRAME):
-            part = yield from self._one_frame(ops[s:s + self.MAX_FRAME])
-            out.extend(part)
-        return out
-
-    def _one_frame(self, ops):
-        frame = []
-        for op, key, value in ops:
-            if op != "Get":
-                self.command_id += 1
-            frame.append(
-                EngineCmdArgs(
-                    op=op, key=key, value=value,
-                    client_id=self.client_id,
-                    command_id=self.command_id,
-                )
-            )
-        while True:
-            fut: Future = self.end.call(f"{self.service}.batch", frame)
-            reply = yield self.sched.with_timeout(fut, 10.0)
-            if reply is not None and reply is not TIMEOUT and any(
-                r.err.startswith("ErrBatchTooLarge") for r in reply
-            ):
-                # Permanent: the server's cap shrank below ours.
-                raise ValueError(reply[0].err)
-            if (
-                reply is None
-                or reply is TIMEOUT
-                or any(r.err != OK for r in reply)
-            ):
-                continue  # lost/partial frame: retry whole (dedup-safe)
-            return [r.value for r in reply]
-
-
-class EngineShardNetClerk(EngineClerk):
-    def __init__(self, sched, end) -> None:
-        super().__init__(sched, end, service="EngineShardKV")
-
-
-class EngineFleetClerk:
-    """Clerk for a fleet of engine shard servers: route key→shard→gid→
-    process from the replicated config, re-query and re-route on
-    ErrWrongGroup — the reference clerk loop (shardkv/client.go:68-129)
-    where each "group" is a chip-owning process."""
-
-    def __init__(self, sched, ends_by_gid: dict) -> None:
-        self.sched = sched
-        self.ends = dict(ends_by_gid)  # gid -> TcpClientEnd
-        self._all = list(dict.fromkeys(self.ends.values()))
-        self.client_id = unique_client_id(next(EngineClerk._next))
-        self.command_id = 0
-        self._cfg = None  # cached (num, shards, groups)
-
-    def _refresh_config(self):
-        while True:
-            for end in self._all:
-                fut = end.call("EngineShardKV.config", ())
-                reply = yield self.sched.with_timeout(fut, 2.0)
-                if reply is not None and reply is not TIMEOUT:
-                    self._cfg = reply
-                    return reply
-            yield self.sched.sleep(0.05)
-
-    def _command(self, op: str, key: str, value: str = ""):
-        from ..engine.shardkv import ERR_WRONG_GROUP
-        from ..services.shardkv import key2shard
-
-        if op != "Get":
-            self.command_id += 1
-        args = EngineCmdArgs(
-            op=op, key=key, value=value,
-            client_id=self.client_id, command_id=self.command_id,
-        )
-        while True:
-            cfg = self._cfg
-            if cfg is None:
-                cfg = yield from self._refresh_config()
-            gid = cfg[1][key2shard(key)]
-            end = self.ends.get(gid)
-            if end is None:  # unassigned shard / unknown gid: re-query
-                yield self.sched.sleep(0.05)
-                self._cfg = None
-                continue
-            fut = end.call("EngineShardKV.command", args)
-            reply = yield self.sched.with_timeout(fut, 3.5)
-            if reply is None or reply is TIMEOUT:
-                self._cfg = None
-                continue  # dropped / wedged: re-route and retry
-            if reply.err == OK:
-                return reply.value
-            if reply.err == ERR_WRONG_GROUP:
-                self._cfg = None  # stale routing: re-query the config
-            yield self.sched.sleep(0.02)
-
-    def get(self, key: str):
-        return self._command("Get", key)
-
-    def put(self, key: str, value: str):
-        return self._command("Put", key, value)
-
-    def append(self, key: str, value: str):
-        return self._command("Append", key, value)
-
-
-class PipelinedFleetClerk(EngineFleetClerk):
-    """Multi-op frames over a sharded fleet: each round partitions the
-    remaining ops by owning process (key→shard→gid→end from the
-    replicated config) and ships one ``batch`` frame per process; ops
-    answered ErrWrongGroup (shard mid-migration / stale routing)
-    re-frame to the new owner next round.  Order safety: a frame's
-    chains fully resolve server-side before it answers, so re-framed
-    retries can never interleave with in-flight ops."""
-
-    # Ops per sequential WINDOW.  An oversized batch must NOT split
-    # into concurrently-in-flight frames: a (client, shard) chain
-    # spanning two live frames breaks the serial-chain discipline the
-    # server's dedup safety rests on (op N+1 applying while op N is
-    # unresolved lets N's retry dedup-swallow into a false OK).  Each
-    # window fully resolves before the next ships.
-    MAX_FRAME = 1024
-
-    def run_batch(self, ops):
-        """ops = [(op, key, value), ...] → list of values in order."""
-        out = []
-        for s in range(0, len(ops), self.MAX_FRAME):
-            part = yield from self._one_window(ops[s:s + self.MAX_FRAME])
-            out.extend(part)
-        return out
-
-    def _one_window(self, ops):
-        from ..services.shardkv import key2shard
-
-        frame_args = []
-        for op, key, value in ops:
-            if op != "Get":
-                self.command_id += 1
-            frame_args.append(
-                EngineCmdArgs(
-                    op=op, key=key, value=value,
-                    client_id=self.client_id,
-                    command_id=self.command_id,
-                )
-            )
-        results = [None] * len(ops)
-        todo = list(range(len(ops)))
-        while todo:
-            cfg = self._cfg
-            if cfg is None:
-                cfg = yield from self._refresh_config()
-            by_end: dict = {}
-            unrouted = []
-            for i in todo:
-                gid = cfg[1][key2shard(frame_args[i].key)]
-                end = self.ends.get(gid)
-                if end is None:
-                    unrouted.append(i)
-                else:
-                    by_end.setdefault(end, []).append(i)
-            retry = list(unrouted)
-            # Dispatch every process's frame FIRST, then collect:
-            # wall-clock is the slowest frame, not the sum.  (Frames
-            # are per-process partitions of one ≤MAX_FRAME window, so
-            # none can exceed the server's cap.)
-            flights = [
-                (idxs, end.call(
-                    "EngineShardKV.batch",
-                    [frame_args[i] for i in idxs],
-                ))
-                for end, idxs in by_end.items()
-            ]
-            for part, fut in flights:
-                reply = yield self.sched.with_timeout(fut, 10.0)
-                if reply is None or reply is TIMEOUT:
-                    retry.extend(part)
-                    continue
-                if any(
-                    r.err.startswith("ErrBatchTooLarge") for r in reply
-                ):
-                    # Permanent: the server's cap shrank below ours.
-                    raise ValueError(reply[0].err)
-                for i, r in zip(part, reply):
-                    if r.err == OK:
-                        results[i] = r.value
-                    else:
-                        retry.append(i)
-            todo = sorted(retry)
-            if todo:
-                self._cfg = None  # routing moved: re-query
-                yield self.sched.sleep(0.02)
-        return results
-
-
 def serve_engine_kv(
     port: int,
     G: int = 64,
@@ -1394,7 +320,7 @@ def serve_engine_kv(
     sched = node.sched
 
     def build():
-        mesh = _make_mesh(mesh_devices) if mesh_devices else None
+        mesh = make_mesh(mesh_devices) if mesh_devices else None
         driver = None
         if data_dir:
             ckpt = os.path.join(data_dir, "engine.ckpt")
@@ -1440,94 +366,17 @@ def serve_engine_kv(
     node.engine_service = svc  # keep reachable for introspection
     return node
 
-
-def serve_engine_shardkv(
-    port: int,
-    G: int = 4,
-    host: str = "127.0.0.1",
-    seed: int = 0,
-    join_gids: Optional[Sequence[int]] = None,
-    gids: Optional[Sequence[int]] = None,
-    peer_addrs: Optional[dict] = None,  # gid -> (host, port) of the owner
-    data_dir: Optional[str] = None,
-    checkpoint_every_s: float = 30.0,
-    mesh_devices: int = 0,
-) -> RpcNode:
-    """The sharded engine behind TCP: BatchedShardKV (replicated config
-    + per-shard migration pipeline) on one chip-owning process.
-
-    Fleet mode: pass ``gids`` (the global gids THIS process hosts; the
-    local engine is sized ``len(gids)+1``) and ``peer_addrs`` (owner
-    address for every remotely hosted gid) — shard migration then rides
-    ``pull_shard``/``delete_shard`` RPCs between processes.
-
-    With ``data_dir`` the process is DURABLE (checkpoint + WAL of
-    client writes, admin ops, and migration inserts/deletes); a
-    restarted process recovers every acknowledged op, and in a fleet
-    the GC handshake is gated so a migrated-in blob is never the only
-    un-fsynced copy."""
-    from ..engine.shardkv import BatchedShardKV
-
-    node = RpcNode(listen=True, host=host, port=port)
-    sched = node.sched
-    local_gids = list(gids) if gids is not None else None
-    G_local = (len(local_gids) + 1) if local_gids is not None else G
-    peers = {
-        g: node.client_end(h, p)
-        for g, (h, p) in (peer_addrs or {}).items()
-        if local_gids is None or g not in local_gids
-    }
-
-    def build():
-        mesh = _make_mesh(mesh_devices) if mesh_devices else None
-        driver = None
-        if data_dir:
-            ckpt = os.path.join(data_dir, "engine.ckpt")
-            if os.path.exists(ckpt):
-                driver = EngineDriver.restore(ckpt, mesh=mesh)
-        restored = driver is not None
-        if not restored:
-            cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
-            driver = EngineDriver(cfg, seed=seed, mesh=mesh)
-            # Warm-up before readiness (see serve_engine_kv):
-            # elections + both tick compiles happen here, not under
-            # client traffic.
-            ok = driver.run_until_quiet_leaders(2000)
-            assert ok, "engine groups failed to elect"
-        skv = BatchedShardKV(driver, gids=local_gids)
-        if restored:
-            blob = driver.restored_extra.get("service")
-            if blob:
-                skv.load_state_dict(blob)
-        # Warm the LOADED tick variant before the readiness line (the
-        # jit compile takes tens of seconds on CPU and would otherwise
-        # land under the first admin/client RPC and time it out).  A
-        # None payload is the "binding lost" no-op: it exercises the
-        # ingest path without touching config history — essential in
-        # fleet mode, where every process's history must stay aligned.
-        skv.driver.start(0, None)
-        skv.pump(8)
-        if not restored:
-            # A restored process's config history lives in its
-            # checkpoint + WAL — re-running the bootstrap joins would
-            # allocate fresh ctrler ids the dedup table can't absorb
-            # and append a spurious config per restart.
-            for gid in join_gids or []:
-                skv.admin_sync("join", [gid])
-        dur = (
-            EngineDurability(data_dir, driver, skv,
-                             checkpoint_every_s=checkpoint_every_s)
-            if data_dir else None
-        )
-        if node.tracer is not None:
-            driver.tracer = node.tracer  # ticks + RPCs on one timeline
-        svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
-        if dur is not None:
-            svc.replay_wal()  # recovery completes before readiness
-            dur.checkpoint()  # fold replay into a fresh checkpoint
-        return svc
-
-    svc = sched.run_call(build, timeout=600.0)
-    node.add_service("EngineShardKV", svc)
-    node.engine_service = svc
-    return node
+# Backwards-compatible re-exports: engine_server was the single module
+# for the whole serving stack before the round-4 decomposition, and
+# in-repo callers/tests import these names from here.
+from .engine_clerks import (  # noqa: E402,F401
+    EngineClerk,
+    EngineFleetClerk,
+    EngineShardNetClerk,
+    PipelinedClerk,
+    PipelinedFleetClerk,
+)
+from .engine_shard_server import (  # noqa: E402,F401
+    EngineShardKVService,
+    serve_engine_shardkv,
+)

@@ -1,0 +1,612 @@
+"""The SHARDED engine service behind the TCP front door — the
+sharded half of the former engine_server.py (split round 4; the wire
+layer lives in engine_wire.py, durability/replay in
+engine_durability.py, clerks in engine_clerks.py).
+
+``EngineShardKVService`` wraps a :class:`~multiraft_tpu.engine.shardkv.
+BatchedShardKV`: server-side key→shard routing against the replicated
+config, the reference clerk retry semantics (ErrWrongGroup →
+re-route, shardkv/client.go:68-129), multi-op frames, fleet-mode
+migration RPCs (pull_shard/delete_shard — Challenge 1 across
+processes), and durable serving (checkpoint + WAL + recovery via
+:class:`~.engine_durability.ShardWalReplay`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from ..engine.core import EngineConfig
+from ..engine.host import EngineDriver
+from ..sim.scheduler import TIMEOUT
+from .engine_durability import (
+    EngineDurability,
+    ShardWalReplay,
+    await_frame_synced,
+)
+from .engine_wire import (
+    ERR_TIMEOUT,
+    OK,
+    EngineCmdArgs,
+    EngineCmdReply,
+    make_mesh,
+)
+from .realtime import RealtimeScheduler
+from .tcp import RpcNode
+
+__all__ = ["EngineShardKVService", "serve_engine_shardkv"]
+
+
+class EngineShardKVService:
+    """``EngineShardKV.command``: the sharded engine service behind the
+    same TCP front door.  Key→shard routing happens server-side against
+    the replicated config; WRONG_GROUP during migration re-routes like
+    the reference clerk (shardkv/client.go:68-129).
+
+    **Fleet mode** (``peers`` given): this process hosts a subset of
+    the global gid space and its ``BatchedShardKV`` migrates shards
+    to/from peer processes over the network — ``remote_fetch`` becomes
+    a ``pull_shard`` RPC to the owning peer, ``remote_delete`` a
+    ``delete_shard`` RPC riding the peer's log (Challenge 1 across
+    processes).  Ops for a gid hosted elsewhere answer ErrWrongGroup so
+    the fleet clerk re-routes, exactly like a reference group answering
+    for a shard it no longer owns."""
+
+    RESUBMIT_S = 0.25
+    DEADLINE_S = 5.0
+    # Per-RPC bound on one migration fetch/delete attempt; the
+    # orchestration sweep re-issues after a timeout.
+    MIGRATE_RPC_S = 2.0
+
+    def __init__(
+        self,
+        sched: RealtimeScheduler,
+        skv,  # BatchedShardKV
+        pump_interval: float = 0.002,
+        ticks_per_pump: int = 2,
+        peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
+        durability: Optional[EngineDurability] = None,
+    ) -> None:
+        self.sched = sched
+        self.skv = skv
+        self._interval = pump_interval
+        self._ticks = ticks_per_pump
+        self._stopped = False
+        self.peers = dict(peers or {})
+        self._fleet = bool(self.peers)
+        self._dur = durability
+        # seq of the WAL record covering each applied insert — the GC
+        # gate below refuses to ask the old owner to delete until the
+        # inserted blob (possibly the last copy) is fsynced here.
+        self._insert_seqs: dict = {}
+        # (client_id, command_id) -> WAL seq, apply-time (commit order)
+        # — see EngineKVService; pruned once synced.
+        self._write_seqs: dict = {}
+        self._admin_seqs: dict = {}  # command_id -> WAL seq
+        # seq of the WAL record covering each applied delete — the
+        # delete_shard RPC reply gates on it being fsynced: the puller
+        # confirms (and never re-asks) the moment we answer OK, so an
+        # OK that could be lost to a crash would leave a BEPULLING slot
+        # here that nothing ever clears, wedging config advance.
+        self._delete_seqs: dict = {}
+        if self._dur is not None:
+            skv.on_insert = self._on_insert_applied
+            skv.on_delete = self._on_delete_applied
+            skv.on_confirm = self._on_confirm_applied
+            # The committing gid travels in the record: recovery REDOES
+            # the write into that gid's slot directly (see
+            # _redo_client_op) — re-routing by the latest config would
+            # drop a write acked at an old owner just before a config
+            # change, and a peer that never pulled pre-crash would then
+            # pull an empty slot.
+            skv.on_write = lambda gid, op: self._write_seqs.__setitem__(
+                (op.client_id, op.command_id),
+                durability.log(("skv", gid, op.op, op.key, op.value,
+                                op.client_id, op.command_id)),
+            )
+            skv.on_ctrl = lambda op: self._admin_seqs.__setitem__(
+                op.command_id,
+                durability.log(("admin", op.kind, op.arg, op.command_id)),
+            )
+        if self._fleet:
+            self._fetches: dict = {}  # (gid, shard, num) -> Future
+            self._deletes: dict = {}
+            skv.remote_fetch = self._remote_fetch
+            skv.remote_delete = self._remote_delete
+        sched.call_soon(self._pump_loop)
+
+    # -- durability hooks (apply-time, loop thread) -----------------------
+
+    def _on_insert_applied(self, gid, shard, num, data, latest):
+        self._insert_seqs[(gid, shard, num)] = self._dur.log(
+            ("insert", gid, shard, num, dict(data), dict(latest))
+        )
+
+    def _on_delete_applied(self, gid, shard, num):
+        # Replayed on restore so a stale BEPULLING slot can't survive an
+        # older checkpoint and wedge config advance.
+        self._delete_seqs[(gid, shard, num)] = self._dur.log(
+            ("delete", gid, shard, num)
+        )
+
+    def _on_confirm_applied(self, gid, shard, num):
+        # Replayed on restore so recovery re-applies GCING→SERVING
+        # locally instead of re-running the GC handshake — during
+        # replay the loop thread is busy replaying, so an RPC to a
+        # remote old owner could never resolve and recovery would
+        # wedge (the confirm only ever committed because the delete
+        # leg already succeeded pre-crash).
+        self._dur.log(("confirm", gid, shard, num))
+
+    # -- fleet migration hooks (run on the loop thread, inside pump) ------
+
+    def _remote_fetch(self, src_gid: int, shard: int, num: int):
+        from ..engine.shardkv import OK as SK_OK
+
+        key = (src_gid, shard, num)
+        fut = self._fetches.get(key)
+        if fut is None:
+            end = self.peers.get(src_gid)
+            if end is None:
+                return None  # unroutable: keep retrying (config may fix)
+            self._fetches[key] = self.sched.with_timeout(
+                end.call("EngineShardKV.pull_shard", (src_gid, shard, num)),
+                self.MIGRATE_RPC_S,
+            )
+            return None
+        if not fut.done:
+            return None
+        del self._fetches[key]  # resolved: consume or retry next sweep
+        reply = fut.value
+        if (
+            reply is None or reply is TIMEOUT
+            or not isinstance(reply, tuple) or reply[0] != SK_OK
+        ):
+            return None  # dropped / not ready: the sweep re-issues
+        return reply[1], reply[2]
+
+    def _remote_delete(self, src_gid: int, shard: int, num: int):
+        from ..engine.shardkv import OK as SK_OK
+
+        # Durability gate: never tell the old owner to delete a shard
+        # whose inserted copy isn't fsynced locally yet — between its
+        # delete and our next checkpoint/WAL-sync, a crash would lose
+        # the only copy.  One pump's group fsync clears this.
+        if self._dur is not None:
+            for (g, s, n), seq in self._insert_seqs.items():
+                if s == shard and n == num and not self._dur.synced(seq):
+                    return None
+        key = (src_gid, shard, num)
+        fut = self._deletes.get(key)
+        if fut is None:
+            end = self.peers.get(src_gid)
+            if end is None:
+                return True  # owner unknown everywhere: nothing to delete
+            self._deletes[key] = self.sched.with_timeout(
+                end.call("EngineShardKV.delete_shard", (src_gid, shard, num)),
+                self.MIGRATE_RPC_S,
+            )
+            return None
+        if not fut.done:
+            return None
+        del self._deletes[key]
+        reply = fut.value
+        if reply is None or reply is TIMEOUT or not isinstance(reply, tuple):
+            return None  # dropped: re-issue next sweep
+        return reply[0] == SK_OK  # False = ErrNotReady, re-asked later
+
+    # -- fleet migration RPC handlers (the serving side of the hooks) -----
+
+    def pull_shard(self, args):
+        """Return ``(OK, data, latest)`` for a shard this process's old
+        owner holds, once it has applied the puller's config number —
+        the cross-process form of the in-process applied-state read
+        (engine/shardkv.py _orchestrate step (b))."""
+        from ..engine.shardkv import ERR_NOT_READY, ERR_WRONG_GROUP
+        from ..engine.shardkv import OK as SK_OK
+
+        src_gid, shard, num = args
+        if src_gid not in self.skv.reps:
+            return (ERR_WRONG_GROUP,)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                rep = self.skv.reps[src_gid]
+                if rep.cur.num >= num:
+                    sh = rep.shards[shard]
+                    return (SK_OK, dict(sh.data), dict(sh.latest))
+                yield 0.01  # config catching up (the ErrNotReady gate)
+            return (ERR_NOT_READY,)
+
+        return run()
+
+    def delete_shard(self, args):
+        """Challenge-1 deletion on behalf of a remote puller: ride the
+        local old owner's log (BatchedShardKV.delete_shard) and report
+        the outcome."""
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..engine.shardkv import OK as SK_OK
+
+        src_gid, shard, num = args
+        if src_gid not in self.skv.reps:
+            return (ERR_WRONG_GROUP,)
+
+        def run():
+            t = self.skv.delete_shard(src_gid, shard, num)
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if t.done:
+                    if t.failed:
+                        return (ERR_TIMEOUT,)
+                    if t.err != SK_OK:
+                        return (t.err,)
+                    # Gate the OK on the delete's WAL record being
+                    # fsynced: the puller confirms on our OK and never
+                    # re-asks, so losing the record to a crash would
+                    # strand a BEPULLING slot here forever.  (Absent =
+                    # pruned = already durable, or the slot was already
+                    # clear and no record was written — also durable.)
+                    # Deadline-bounded: a stalled fsync must surface as
+                    # a timeout the puller retries, not a pinned
+                    # generator.
+                    while self._dur is not None:
+                        seq = self._delete_seqs.get((src_gid, shard, num))
+                        if seq is None or self._dur.synced(seq):
+                            break
+                        if self.sched.now >= deadline:
+                            return (ERR_TIMEOUT,)
+                        yield 0.002
+                    return (SK_OK,)
+                yield 0.005
+            return (ERR_TIMEOUT,)
+
+        return run()
+
+    def config(self, args):
+        """Latest committed config as ``(num, shards, groups)`` — the
+        fleet clerk's routing source (shardctrler Query analog)."""
+        cfg = self.skv.query_latest()
+        return (
+            cfg.num,
+            list(cfg.shards),
+            {g: list(v) for g, v in cfg.groups.items()},
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def final_checkpoint(self) -> bool:
+        """Graceful-shutdown hook — see EngineKVService."""
+        if self._dur is None:
+            return False
+        self._dur.checkpoint()
+        return True
+
+    def _pump_loop(self) -> None:
+        if self._stopped:
+            return
+        self.skv.pump(self._ticks)
+        if self._dur is not None:
+            self._dur.after_pump()  # group fsync + periodic checkpoint
+            for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs",
+                         "_delete_seqs"):
+                seqs = getattr(self, attr)
+                if seqs:
+                    setattr(self, attr, {
+                        k: v for k, v in seqs.items()
+                        if not self._dur.synced(v)
+                    })
+        self.sched.call_after(self._interval, self._pump_loop)
+
+    def replay_wal(self) -> int:
+        """Recovery replay — delegated to
+        :class:`~.engine_durability.ShardWalReplay` (two-pass redo with
+        migration paused; see its docstring for the full contract)."""
+        return ShardWalReplay(self.skv, self._dur).run()
+
+    # Largest multi-op frame one RPC may carry (see EngineKVService).
+    MAX_BATCH = 1024
+
+    def batch(self, args_list):
+        """Multi-op frame for the SHARDED service.  Chains key on
+        (client, shard) — a shard's dedup table travels with it and
+        same-key ops share a shard — and run STRICTLY one op in flight
+        each, the reference clerk's serial discipline
+        (shardkv/client.go:68-129): pipelining within a chain is
+        unsafe here because an away-and-back shard migration can let a
+        later op apply while an earlier one bounced ErrWrongGroup, and
+        the earlier op's retry then dedup-swallows into a false OK.
+        The frame's parallelism comes from chains to DIFFERENT shards
+        pipelining freely.  In fleet mode, ops whose shard a peer
+        process owns answer ErrWrongGroup per-op so the fleet clerk
+        re-frames them to the owner."""
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        if len(args_list) > self.MAX_BATCH:
+            return [
+                EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
+            ] * len(args_list)
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            replies = [None] * len(args_list)
+            chains: dict = {}
+            for i, a in enumerate(args_list):
+                if a.op == "Get":
+                    continue
+                chains.setdefault(
+                    (a.client_id, key2shard(a.key)), []
+                ).append(i)
+
+            def submit(a):
+                cfg = self.skv.query_latest()
+                gid = cfg.shards[key2shard(a.key)]
+                if gid not in self.skv.reps:
+                    return None  # peer-owned (or unassigned) shard
+                return self.skv.submit(
+                    gid, a.op, a.key, a.value,
+                    client_id=a.client_id, command_id=a.command_id,
+                )
+
+            tickets: dict = {}   # frame idx -> resolved-OK ticket
+            wrong: set = set()   # frame idx -> answer ErrWrongGroup
+            heads: dict = {}     # chain -> (frame idx, live ticket)
+            cursor = {qk: 0 for qk in chains}
+            pending = set(chains)
+            while pending and self.sched.now < deadline:
+                progressed = False
+                for qk in list(pending):
+                    members = chains[qk]
+                    if qk not in heads:
+                        i = members[cursor[qk]]
+                        t = submit(args_list[i])
+                        if t is None:
+                            if self._fleet:
+                                # Peer-owned: the whole remaining chain
+                                # belongs to that peer — punt it.
+                                for j in members[cursor[qk]:]:
+                                    wrong.add(j)
+                                pending.discard(qk)
+                                progressed = True
+                            continue  # non-fleet: config moving; wait
+                        heads[qk] = (i, t)
+                        continue
+                    i, t = heads[qk]
+                    if not t.done:
+                        continue
+                    del heads[qk]
+                    if t.failed or t.err == ERR_WRONG_GROUP:
+                        continue  # resubmit next round (dedup-safe)
+                    tickets[i] = t
+                    cursor[qk] += 1
+                    progressed = True
+                    if cursor[qk] >= len(members):
+                        pending.discard(qk)
+                if pending and not progressed:
+                    yield 0.002
+            # Durable frame ack (shared gate — see _await_frame_synced).
+            ok = {
+                i for i, t in tickets.items()
+                if t.done and not t.failed and t.err == OK
+            }
+            yield from await_frame_synced(
+                self.sched, self._dur, self._write_seqs, ok,
+                args_list, deadline,
+            )
+            for i, a in enumerate(args_list):
+                if a.op == "Get":
+                    t = self.skv.get_fast(a.key)
+                    if t.err == ERR_WRONG_GROUP:
+                        replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
+                    else:
+                        replies[i] = EngineCmdReply(
+                            err=OK, value=t.value if t.err == OK else ""
+                        )
+                elif i in wrong:
+                    replies[i] = EngineCmdReply(err=ERR_WRONG_GROUP)
+                elif i in ok:
+                    replies[i] = EngineCmdReply(
+                        err=OK, value=tickets[i].value
+                    )
+                else:
+                    replies[i] = EngineCmdReply(err=ERR_TIMEOUT)
+            return replies
+
+        return run()
+
+    def command(self, args: EngineCmdArgs):
+        from ..engine.shardkv import ERR_WRONG_GROUP
+        from ..services.shardkv import key2shard
+
+        if args.op == "Get":
+            # ReadIndex fast read (BatchedShardKV.get_fast): no log
+            # entry, gated on serving-shard ownership exactly like the
+            # logged path; ErrWrongGroup during migration pumps and
+            # retries like any clerk op.
+            def run_get():
+                deadline = self.sched.now + self.DEADLINE_S
+                while self.sched.now < deadline:
+                    t = self.skv.get_fast(args.key)
+                    if t.err == ERR_WRONG_GROUP:
+                        # Fleet: the owner is (probably) another
+                        # process — answer so the clerk re-routes.
+                        if self._fleet:
+                            return EngineCmdReply(err=ERR_WRONG_GROUP)
+                        yield 0.01  # config moving; shard not serving here
+                        continue
+                    value = t.value if t.err == OK else ""
+                    return EngineCmdReply(err=OK, value=value)
+                return EngineCmdReply(err=ERR_TIMEOUT)
+
+            return run_get()
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                cfg = self.skv.query_latest()
+                gid = cfg.shards[key2shard(args.key)]
+                if gid not in self.skv.reps:
+                    if self._fleet:
+                        # Hosted by a peer process: tell the clerk.
+                        return EngineCmdReply(err=ERR_WRONG_GROUP)
+                    yield 0.01  # shard unassigned; config still moving
+                    continue
+                t = self.skv.submit(
+                    gid, args.op, args.key, args.value,
+                    client_id=args.client_id, command_id=args.command_id,
+                )
+                sub_deadline = min(
+                    self.sched.now + self.RESUBMIT_S, deadline
+                )
+                while not t.done and self.sched.now < sub_deadline:
+                    yield 0.002
+                if not t.done or t.failed or t.err == ERR_WRONG_GROUP:
+                    continue  # resubmit / re-route; dedup-safe
+                # Ack gates on the apply-time WAL record being fsynced
+                # (absent = pruned/duplicate = already durable).
+                while self._dur is not None:
+                    seq = self._write_seqs.get(
+                        (args.client_id, args.command_id)
+                    )
+                    if seq is None or self._dur.synced(seq):
+                        break
+                    yield 0.002
+                return EngineCmdReply(err=OK, value=t.value)
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+    ADMIN_OPS = ("join", "leave", "move")
+
+    def admin(self, args):
+        """Config administration: args = (kind, payload[, command_id])
+        with kind in ADMIN_OPS — a network-supplied string must never
+        getattr into arbitrary methods.  The optional command_id makes
+        retries exactly-once through the ctrler dedup table; a FLEET
+        admin MUST pass one (a duplicate apply would fork the config
+        histories' numbering across processes and wedge migration)."""
+        kind, payload = args[0], args[1]
+        cmd = args[2] if len(args) > 2 else None
+        if kind not in self.ADMIN_OPS:
+            return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
+
+        def run():
+            # join/leave take their payload whole (a gid list / mapping);
+            # move takes (shard, gid) as two positionals.
+            if kind == "move":
+                t = self.skv.move(*payload, command_id=cmd)
+            else:
+                t = getattr(self.skv, kind)(payload, command_id=cmd)
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if t.done:
+                    if t.failed:
+                        return EngineCmdReply(err=ERR_TIMEOUT)
+                    # Ack gates on the apply-time ("admin", ...) WAL
+                    # record (logged by the on_ctrl hook in commit
+                    # order) being fsynced.
+                    while self._dur is not None:
+                        seq = self._admin_seqs.get(t.command_id)
+                        if seq is None or self._dur.synced(seq):
+                            break
+                        yield 0.002
+                    return EngineCmdReply(err=OK)
+                yield 0.005
+            return EngineCmdReply(err=ERR_TIMEOUT)
+
+        return run()
+
+
+def serve_engine_shardkv(
+    port: int,
+    G: int = 4,
+    host: str = "127.0.0.1",
+    seed: int = 0,
+    join_gids: Optional[Sequence[int]] = None,
+    gids: Optional[Sequence[int]] = None,
+    peer_addrs: Optional[dict] = None,  # gid -> (host, port) of the owner
+    data_dir: Optional[str] = None,
+    checkpoint_every_s: float = 30.0,
+    mesh_devices: int = 0,
+) -> RpcNode:
+    """The sharded engine behind TCP: BatchedShardKV (replicated config
+    + per-shard migration pipeline) on one chip-owning process.
+
+    Fleet mode: pass ``gids`` (the global gids THIS process hosts; the
+    local engine is sized ``len(gids)+1``) and ``peer_addrs`` (owner
+    address for every remotely hosted gid) — shard migration then rides
+    ``pull_shard``/``delete_shard`` RPCs between processes.
+
+    With ``data_dir`` the process is DURABLE (checkpoint + WAL of
+    client writes, admin ops, and migration inserts/deletes); a
+    restarted process recovers every acknowledged op, and in a fleet
+    the GC handshake is gated so a migrated-in blob is never the only
+    un-fsynced copy."""
+    from ..engine.shardkv import BatchedShardKV
+
+    node = RpcNode(listen=True, host=host, port=port)
+    sched = node.sched
+    local_gids = list(gids) if gids is not None else None
+    G_local = (len(local_gids) + 1) if local_gids is not None else G
+    peers = {
+        g: node.client_end(h, p)
+        for g, (h, p) in (peer_addrs or {}).items()
+        if local_gids is None or g not in local_gids
+    }
+
+    def build():
+        mesh = make_mesh(mesh_devices) if mesh_devices else None
+        driver = None
+        if data_dir:
+            ckpt = os.path.join(data_dir, "engine.ckpt")
+            if os.path.exists(ckpt):
+                driver = EngineDriver.restore(ckpt, mesh=mesh)
+        restored = driver is not None
+        if not restored:
+            cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
+            driver = EngineDriver(cfg, seed=seed, mesh=mesh)
+            # Warm-up before readiness (see serve_engine_kv):
+            # elections + both tick compiles happen here, not under
+            # client traffic.
+            ok = driver.run_until_quiet_leaders(2000)
+            assert ok, "engine groups failed to elect"
+        skv = BatchedShardKV(driver, gids=local_gids)
+        if restored:
+            blob = driver.restored_extra.get("service")
+            if blob:
+                skv.load_state_dict(blob)
+        # Warm the LOADED tick variant before the readiness line (the
+        # jit compile takes tens of seconds on CPU and would otherwise
+        # land under the first admin/client RPC and time it out).  A
+        # None payload is the "binding lost" no-op: it exercises the
+        # ingest path without touching config history — essential in
+        # fleet mode, where every process's history must stay aligned.
+        skv.driver.start(0, None)
+        skv.pump(8)
+        if not restored:
+            # A restored process's config history lives in its
+            # checkpoint + WAL — re-running the bootstrap joins would
+            # allocate fresh ctrler ids the dedup table can't absorb
+            # and append a spurious config per restart.
+            for gid in join_gids or []:
+                skv.admin_sync("join", [gid])
+        dur = (
+            EngineDurability(data_dir, driver, skv,
+                             checkpoint_every_s=checkpoint_every_s)
+            if data_dir else None
+        )
+        if node.tracer is not None:
+            driver.tracer = node.tracer  # ticks + RPCs on one timeline
+        svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
+        if dur is not None:
+            svc.replay_wal()  # recovery completes before readiness
+            dur.checkpoint()  # fold replay into a fresh checkpoint
+        return svc
+
+    svc = sched.run_call(build, timeout=600.0)
+    node.add_service("EngineShardKV", svc)
+    node.engine_service = svc
+    return node

@@ -1,0 +1,71 @@
+"""Shared wire layer for the engine serving stack: command
+dataclasses, deterministic key→group routing, opcode maps, and the
+local-mesh helper.  Split out of engine_server.py (round 4) so the KV
+service, the sharded service, the clerks, and the durability machinery
+depend on one small module instead of each other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+
+from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+from ..transport import codec
+
+__all__ = [
+    "OK",
+    "ERR_TIMEOUT",
+    "EngineCmdArgs",
+    "EngineCmdReply",
+    "route_group",
+]
+
+OK = "OK"
+ERR_TIMEOUT = "ErrTimeout"
+
+_OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
+_OPNAME = {v: k for k, v in _OPCODE.items()}
+
+
+@codec.registered
+@dataclasses.dataclass
+class EngineCmdArgs:
+    op: str = "Get"
+    key: str = ""
+    value: str = ""
+    client_id: int = 0
+    command_id: int = 0
+
+
+@codec.registered
+@dataclasses.dataclass
+class EngineCmdReply:
+    err: str = OK
+    value: str = ""
+
+
+def route_group(key: str, G: int) -> int:
+    """Deterministic key→group routing shared by every process (a
+    stable hash — Python's builtin is salted per process)."""
+    return zlib.crc32(key.encode()) % G
+
+
+def make_mesh(n_devices: int):
+    """A 1-D ``groups`` mesh over the first ``n_devices`` local devices
+    — the production entry to the shard_map tick (engine/mesh.py): the
+    server's state lives sharded across its chips, consensus stays
+    zero-collective, and the same driver/pump/checkpoint path serves
+    single- and multi-chip alike."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if n_devices <= 0:
+        raise ValueError(f"mesh_devices must be positive, got {n_devices}")
+    devs = jax.devices()
+    if n_devices > len(devs):
+        raise ValueError(
+            f"mesh_devices={n_devices} > {len(devs)} visible devices"
+        )
+    return Mesh(np.array(devs[:n_devices]), ("groups",))

@@ -15,12 +15,12 @@ Client surface: ``SplitShardKV.command`` routes key→shard→gid
 server-side from the latest applied config and answers ErrWrongLeader
 when the owning group's leader lives at a peer process (the clerk
 rotates, reference: shardkv/client.go:68-129); ``admin`` drives
-join/leave/move at whichever process owns the ctrler leader.  A
-cross-process admin retry can duplicate a ctrler op under a different
-per-process session — by construction that re-applies an identical
-membership change, so the extra config is a no-op bump every replica
-steps through (rebalance is deterministic); same-process retries are
-deduped exactly-once.
+join/leave/move at whichever process owns the ctrler leader, keyed on
+the CLERK's unique client_id — the ctrler log is replicated to every
+process, so a retry landing at a different leader owner dedups
+exactly-once against the committed op, and two clerks' independent
+command numbering can never collide (a per-process session would
+silently swallow one clerk's op as another's duplicate).
 """
 
 from __future__ import annotations
@@ -146,12 +146,17 @@ class SplitShardKVService:
         return run()
 
     def admin(self, args):
-        """args = (kind, payload, command_id); kind ∈ ADMIN_OPS (a
-        network-supplied string must never getattr into arbitrary
-        methods).  ErrWrongLeader when the ctrler leader lives at a
-        peer process — the clerk rotates."""
+        """args = (kind, payload, command_id, client_id); kind ∈
+        ADMIN_OPS (a network-supplied string must never getattr into
+        arbitrary methods).  ErrWrongLeader when the ctrler leader
+        lives at a peer process — the clerk rotates.  The clerk's OWN
+        client_id keys the dedup: admin ops land at whichever process
+        owns the ctrler leader, so keying on a per-process session
+        would let two clerks' independent command numbering collide
+        and silently swallow an op as a duplicate."""
         kind, payload = args[0], args[1]
         cmd = args[2] if len(args) > 2 else None
+        cid = args[3] if len(args) > 3 else None
         if kind not in self.ADMIN_OPS:
             return EngineCmdReply(err=f"ErrBadAdminOp:{kind}")
 
@@ -162,7 +167,8 @@ class SplitShardKVService:
                 arg = (int(payload[0]), int(payload[1]))
             else:
                 arg = [int(g) for g in payload]
-            t = self.skv.ctrl_local(kind, arg, command_id=cmd)
+            t = self.skv.ctrl_local(kind, arg, command_id=cmd,
+                                    client_id=cid)
             if t is None:
                 return EngineCmdReply(err=ERR_WRONG_LEADER)
             deadline = self.sched.now + self.DEADLINE_S
@@ -222,12 +228,13 @@ class SplitShardNetClerk:
         return self._command("Append", key, value)
 
     def admin(self, kind: str, payload):
-        """join/leave/move with rotation.  One command id per logical
-        op: same-process retries dedup exactly-once; a cross-process
-        retry can at worst re-apply the identical membership change (a
-        harmless no-op config bump — see module docstring)."""
+        """join/leave/move with rotation.  The clerk's unique client_id
+        + one command id per logical op make retries exactly-once
+        through the ctrler dedup table — across processes too (every
+        process applies the same replicated ctrler log, so a retry at
+        a different leader owner dedups against the committed op)."""
         self._admin_cmd += 1
-        args = (kind, payload, self._admin_cmd)
+        args = (kind, payload, self._admin_cmd, self.client_id)
         i = 0
         while True:
             end = self.ends[i % len(self.ends)]

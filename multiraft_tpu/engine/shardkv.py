@@ -381,10 +381,15 @@ class BatchedShardKV(FrontierService):
         return t
 
     def _ctrl(self, kind: str, arg: Any,
-              command_id: Optional[int] = None) -> ShardTicket:
+              command_id: Optional[int] = None,
+              client_id: Optional[int] = None) -> ShardTicket:
         """Propose a ctrler op.  Pass the ``command_id`` of a failed
         ticket to retry it — the ctrler dedup table then guarantees
-        exactly-once application even if the original did commit."""
+        exactly-once application even if the original did commit.
+        ``client_id`` overrides the session the dedup keys on: a
+        network admin clerk passes ITS unique id so its (id, cmd)
+        pairs can never collide with another clerk's (or another
+        process's) numbering — see split_shard_server.admin."""
         if command_id is None:
             self._ctrl_cmd += 1
             command_id = self._ctrl_cmd
@@ -393,9 +398,11 @@ class BatchedShardKV(FrontierService):
             # (fleet admin) — otherwise a later auto-allocated id lands
             # below _ctrl_latest and is silently dedup-dropped as OK.
             self._ctrl_cmd = max(self._ctrl_cmd, command_id)
+        if client_id is None:
+            client_id = self._ctrl_client_id
         t = ShardTicket(group=0, command_id=command_id)
         self.driver.start(
-            0, _CtrlOp(kind=kind, arg=arg, client_id=self._ctrl_client_id,
+            0, _CtrlOp(kind=kind, arg=arg, client_id=client_id,
                        command_id=command_id, ticket=t)
         )
         return t

@@ -305,13 +305,18 @@ class SplitShardKV(SplitFrontierMixin, BatchedShardKV):
         return self.submit(gid, op, key, value, client_id, command_id)
 
     def ctrl_local(self, kind: str, arg: Any,
-                   command_id: Optional[int] = None
+                   command_id: Optional[int] = None,
+                   client_id: Optional[int] = None
                    ) -> Optional[ShardTicket]:
         """Admin op iff an owned slot leads the config RSM (engine
-        group 0); None = wrong process."""
+        group 0); None = wrong process.  Callers that may retry the
+        same op AT ANOTHER PROCESS must pass their own ``client_id``
+        (+ a stable ``command_id``): the per-process default session
+        would let two issuers' independent command numbering collide in
+        the dedup table and silently swallow an op as a "duplicate"."""
         if self.driver.leader_of(0) is None:
             return None
-        return self._ctrl(kind, arg, command_id)
+        return self._ctrl(kind, arg, command_id, client_id=client_id)
 
     def get_fast(self, key: str) -> ShardTicket:
         raise NotImplementedError(

@@ -255,8 +255,8 @@ def test_mesh_size_mismatch_rejected(tmp_path):
 
 
 def test_make_mesh_rejects_nonpositive():
-    from multiraft_tpu.distributed.engine_server import _make_mesh
+    from multiraft_tpu.distributed.engine_wire import make_mesh
 
     for bad in (0, -1, -4):
         with pytest.raises(ValueError, match="positive"):
-            _make_mesh(bad)
+            make_mesh(bad)

@@ -3,146 +3,39 @@ the config RSM and every replica group have their P peer slots spread
 over SEVERAL engine processes, so a process death loses single peers,
 not whole groups — while shard migration keeps running.
 
-Two drivers in-process with the deterministic manual slab shuttle
-(same machinery as tests/test_engine_split.py; sockets are covered by
-tests/test_split_server.py).  Reference targets: per-server crash
-within replica groups while migration continues
+Two drivers in-process driven by the shared slab-shuttle harness
+(multiraft_tpu/harness/split_harness.py — the same machinery the
+socket servers run, minus the sockets; those are covered by
+tests/test_split_shard_server.py).  Reference targets: per-server
+crash within replica groups while migration continues
 (shardkv/config.go:204-262, shardkv/test_test.go:97-216), Challenge-1
 deletion and Challenge-2 availability across the process boundary.
 """
-
-import numpy as np
 
 from multiraft_tpu.engine.core import EngineConfig
 from multiraft_tpu.engine.host import EngineDriver
 from multiraft_tpu.engine.split import SplitPeering, SplitSpec
 from multiraft_tpu.engine.split_shard import SplitShardKV
+from multiraft_tpu.harness.split_harness import SplitShardRig
 from multiraft_tpu.services.shardctrler import NSHARDS
 from multiraft_tpu.services.shardkv import BEPULLING, GCING, SERVING, key2shard
 
 
-class Side:
-    """One 'process': driver + sharded service + peering."""
-
-    def __init__(self, me, owners, G, seed, delay_elections=0):
+def make_rig(owners, G, delay_on=None, delay=300):
+    sides = []
+    for me, seed in ((0, 11), (1, 22)):
         cfg = EngineConfig(G=G, P=3, L=48, E=8, INGEST=8,
                            host_paced_compaction=True)
-        self.driver = EngineDriver(cfg, seed=seed)
-        self.skv = SplitShardKV(self.driver)
-        self.peering = SplitPeering(
-            self.driver, self.skv, SplitSpec(me=me, owners=owners)
-        )
-        self.me = me
-        self.alive = True
-        if delay_elections:
-            self.driver.state = self.driver.state._replace(
-                elect_dl=self.driver.state.elect_dl + delay_elections
+        driver = EngineDriver(cfg, seed=seed)
+        skv = SplitShardKV(driver)
+        peering = SplitPeering(driver, skv,
+                               SplitSpec(me=me, owners=owners))
+        if delay_on == me:
+            driver.state = driver.state._replace(
+                elect_dl=driver.state.elect_dl + delay
             )
-
-
-def make_pair(owners, G, delay_on=None, delay=300):
-    return [
-        Side(0, owners, G, seed=11,
-             delay_elections=delay if delay_on == 0 else 0),
-        Side(1, owners, G, seed=22,
-             delay_elections=delay if delay_on == 1 else 0),
-    ]
-
-
-def pump(sides, rounds=1):
-    for _ in range(rounds):
-        for side in sides:
-            if not side.alive:
-                continue
-            side.skv.pump(1)
-            for proc, slab in side.peering.extract().items():
-                dst = sides[proc]
-                if dst.alive:
-                    dst.peering.inject(slab)
-
-
-def admin(sides, kind, arg, max_rounds=2000):
-    """Drive a ctrler op at whichever live side owns the ctrler leader,
-    retrying under the same dedup id across failovers."""
-    t = None
-    cid = None
-    for _ in range(max_rounds):
-        if t is not None and t.done and not t.failed:
-            return
-        if t is None or t.done:
-            for side in sides:
-                if side.alive:
-                    nt = side.skv.ctrl_local(kind, arg, command_id=cid)
-                    if nt is not None:
-                        t, cid = nt, nt.command_id
-                        break
-        pump(sides, 1)
-    raise TimeoutError(f"ctrler {kind} never committed")
-
-
-_cmd = [0]
-
-
-def client_op(sides, op, key, value="", max_rounds=2000):
-    """The reference clerk loop across sides: find the gid owner's
-    leader side, submit, retry on wrong-group/lost-leader under one
-    (client_id, command_id) so resubmits stay exactly-once."""
-    _cmd[0] += 1
-    cid = _cmd[0]
-    t = None
-    for _ in range(max_rounds):
-        if t is not None and t.done and not t.failed and t.err == "OK":
-            return t.value
-        if t is None or t.done:
-            t = None
-            live = [s for s in sides if s.alive]
-            if live:
-                cfg = live[0].skv.query_latest()
-                gid = cfg.shards[key2shard(key)]
-                for side in live:
-                    if gid in side.skv.reps:
-                        nt = side.skv.submit_local(
-                            gid, op, key, value,
-                            client_id=777, command_id=cid,
-                        )
-                        if nt is not None:
-                            t = nt
-                            break
-        pump(sides, 1)
-    raise TimeoutError(f"{op}({key!r}) never committed")
-
-
-def settle(sides, G, max_rounds=600):
-    def leaders(g):
-        return sum(
-            int(s.driver.leaders_per_group()[g]) for s in sides if s.alive
-        )
-
-    for _ in range(max_rounds):
-        pump(sides, 1)
-        if all(leaders(g) == 1 for g in range(G)):
-            return
-    raise TimeoutError("split shard groups did not elect leaders")
-
-
-def wait_migrated(sides, gids, max_rounds=3000):
-    """Pump until every live side's replicas are SERVING-stable at the
-    latest config (migration + Challenge-1 GC complete)."""
-    for _ in range(max_rounds):
-        pump(sides, 1)
-        live = [s for s in sides if s.alive]
-        latest = max(s.skv.configs[-1].num for s in live)
-        done = True
-        for s in live:
-            for gid in gids:
-                rep = s.skv.reps[gid]
-                if rep.cur.num != latest or any(
-                    sl.state != SERVING for sl in rep.shards.values()
-                ):
-                    done = False
-        if done:
-            return
-    raise TimeoutError("migration never completed")
+        sides.append((skv, peering))
+    return SplitShardRig(sides)
 
 
 # G = 3 engine groups: 0 = config RSM, 1..2 = gids 1..2.
@@ -154,25 +47,25 @@ def test_split_shard_basic_migration_across_processes():
     """Join gid 1, write; join gid 2 — shards migrate between replica
     groups whose peers span two processes; Challenge-1 deletes the old
     copies; both processes converge on the same applied state."""
-    sides = make_pair(OWNERS_MINORITY_0, G, delay_on=1)
-    settle(sides, G)
-    admin(sides, "join", {1: ["p1"]})
+    rig = make_rig(OWNERS_MINORITY_0, G, delay_on=1)
+    rig.settle(G)
+    rig.admin("join", {1: ["p1"]})
     keys = [chr(ord("a") + i) + "key" for i in range(8)]
     for k in keys:
-        client_op(sides, "Put", k, f"v-{k}")
-    admin(sides, "join", {2: ["p2"]})
-    wait_migrated(sides, [1, 2])
+        rig.client_op("Put", k, f"v-{k}")
+    rig.admin("join", {2: ["p2"]})
+    rig.wait_migrated([1, 2])
     # Every key readable post-migration (served by the new owners).
     for k in keys:
-        assert client_op(sides, "Get", k) == f"v-{k}"
+        assert rig.client_op("Get", k) == f"v-{k}"
     # Challenge 1: migrated shards are DELETED at the old owner on
     # every process.
-    latest = sides[0].skv.configs[-1]
+    latest = rig.sides[0][0].configs[-1]
     for s in range(NSHARDS):
         if latest.shards[s] == 2:  # migrated to gid 2
-            for side in sides:
-                assert side.skv.reps[1].shards[s].data == {}, (
-                    f"old owner kept shard {s} data on side {side.me}"
+            for i, (skv, _) in enumerate(rig.sides):
+                assert skv.reps[1].shards[s].data == {}, (
+                    f"old owner kept shard {s} data on side {i}"
                 )
 
 
@@ -184,67 +77,53 @@ def test_split_shard_kill_minority_owner_mid_migration():
     Challenge-1 GC handshake) completes cross-process, unaffected
     shards keep serving throughout, and every acknowledged write is
     intact from replication alone — no WAL, no disk."""
-    sides = make_pair(OWNERS_MINORITY_0, G, delay_on=1)  # leaders → side 0
-    settle(sides, G)
+    rig = make_rig(OWNERS_MINORITY_0, G, delay_on=1)  # leaders → side 0
+    rig.settle(G)
     assert all(
-        sides[0].skv.driver.leader_of(g) is not None for g in range(G)
+        rig.sides[0][0].driver.leader_of(g) is not None for g in range(G)
     ), "leader bias failed"
-    admin(sides, "join", {1: ["p1"]})
+    rig.admin("join", {1: ["p1"]})
     acked = {}
     keys = [chr(ord("a") + i) + "key" for i in range(10)]
     for k in keys:
-        client_op(sides, "Append", k, f"[a-{k}]")
+        rig.client_op("Append", k, f"[a-{k}]")
         acked[k] = f"[a-{k}]"
 
     # Start the migration: join gid 2 — shards begin moving 1 → 2.
-    admin(sides, "join", {2: ["p2"]})
-    # Pump JUST until the migration is observably mid-flight (some
-    # slot PULLING/GCING/BEPULLING somewhere), then kill.
-    def mid_flight():
-        for s in sides:
-            if not s.alive:
-                continue
-            for rep in s.skv.reps.values():
-                if any(sl.state != SERVING for sl in rep.shards.values()):
-                    return True
-        return False
-
-    for _ in range(1500):
-        pump(sides, 1)
-        if mid_flight():
-            break
-    assert mid_flight(), "migration never became observable"
+    rig.admin("join", {2: ["p2"]})
+    assert rig.wait_migrating(), "migration never became observable"
 
     # KILL -9 the minority owner (which held every leader).
-    sides[0].alive = False
+    rig.kill(0)
 
     # Unaffected shards keep serving: a key still owned by gid 1 in
     # the latest config answers while the migration completes.
+    survivor = rig.sides[1][0]
     stay = next(
         k for k in keys
-        if sides[1].skv.configs[-1].shards[key2shard(k)] == 1
+        if survivor.configs[-1].shards[key2shard(k)] == 1
     )
-    client_op(sides, "Append", stay, "[during]")
+    rig.client_op("Append", stay, "[during]")
     acked[stay] += "[during]"
 
     # The migration completes cross-process on the survivor alone.
-    wait_migrated(sides, [1, 2])
+    rig.wait_migrated([1, 2])
 
     # Every acked write intact — including writes to migrated shards —
     # and new writes land at the new owners.
     for k in keys:
-        assert client_op(sides, "Get", k) == acked[k], f"lost {k}"
+        assert rig.client_op("Get", k) == acked[k], f"lost {k}"
     moved = next(
         k for k in keys
-        if sides[1].skv.configs[-1].shards[key2shard(k)] == 2
+        if survivor.configs[-1].shards[key2shard(k)] == 2
     )
-    client_op(sides, "Append", moved, "[post]")
-    assert client_op(sides, "Get", moved) == acked[moved] + "[post]"
+    rig.client_op("Append", moved, "[post]")
+    assert rig.client_op("Get", moved) == acked[moved] + "[post]"
     # Challenge 1 held across the kill: old copies deleted.
-    latest = sides[1].skv.configs[-1]
+    latest = survivor.configs[-1]
     for s in range(NSHARDS):
         if latest.shards[s] == 2:
-            assert sides[1].skv.reps[1].shards[s].data == {}
+            assert survivor.reps[1].shards[s].data == {}
 
 
 def test_split_shard_delete_waits_for_cross_process_insert():
@@ -252,35 +131,35 @@ def test_split_shard_delete_waits_for_cross_process_insert():
     leader-owner must not propose the delete before it OBSERVES the
     puller's committed insert (GCING) in its applied copy — at no
     point may the only copy of a shard be the one being deleted."""
-    sides = make_pair(OWNERS_MINORITY_0, G, delay_on=1)
-    settle(sides, G)
-    admin(sides, "join", {1: ["p1"]})
-    client_op(sides, "Put", "watched", "payload")
+    rig = make_rig(OWNERS_MINORITY_0, G, delay_on=1)
+    rig.settle(G)
+    rig.admin("join", {1: ["p1"]})
+    rig.client_op("Put", "watched", "payload")
     shard = key2shard("watched")
-    admin(sides, "move", (shard, 2))
+    rig.admin("move", (shard, 2))
     saw_states = set()
     for _ in range(3000):
-        pump(sides, 1)
-        for side in sides:
-            st1 = side.skv.reps[1].shards[shard].state
-            st2 = side.skv.reps[2].shards[shard].state
-            saw_states.add((side.me, st1, st2))
+        rig.shuttle()
+        for i, (skv, _) in enumerate(rig.sides):
+            st1 = skv.reps[1].shards[shard].state
+            st2 = skv.reps[2].shards[shard].state
+            saw_states.add((i, st1, st2))
             # The invariant: source slot empty (deleted) implies the
             # new owner holds the data on every process that observed
             # the deletion.
-            if st1 == SERVING and side.skv.reps[1].cur.num >= 2:
-                if not side.skv.reps[1].shards[shard].data:
-                    assert side.skv.reps[2].shards[shard].data or st2 in (
+            if st1 == SERVING and skv.reps[1].cur.num >= 2:
+                if not skv.reps[1].shards[shard].data:
+                    assert skv.reps[2].shards[shard].data or st2 in (
                         GCING, SERVING
                     ), "source deleted before insert observed"
-        live_done = all(
-            side.skv.reps[2].shards[shard].state == SERVING
-            and side.skv.reps[2].cur.num == sides[0].skv.reps[2].cur.num
-            for side in sides
+        done = all(
+            skv.reps[2].shards[shard].state == SERVING
+            and skv.reps[2].cur.num == rig.sides[0][0].reps[2].cur.num
+            for skv, _ in rig.sides
         )
-        if live_done and sides[0].skv.reps[2].shards[shard].data:
+        if done and rig.sides[0][0].reps[2].shards[shard].data:
             break
-    assert client_op(sides, "Get", "watched") == "payload"
+    assert rig.client_op("Get", "watched") == "payload"
     # The handshake actually crossed states (BEPULLING/GCING observed).
     assert any(st[1] == BEPULLING for st in saw_states)
     assert any(st[2] == GCING for st in saw_states)
