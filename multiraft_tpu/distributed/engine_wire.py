@@ -19,6 +19,7 @@ __all__ = [
     "EngineCmdArgs",
     "EngineCmdReply",
     "route_group",
+    "make_mesh",
 ]
 
 OK = "OK"

@@ -9,7 +9,7 @@ retry/dedup discipline lives here exactly once).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 __all__ = ["SplitShardRig"]
 
@@ -31,6 +31,7 @@ class SplitShardRig:
         self.sides = list(sides)
         self.alive = [True] * len(self.sides)
         self._cmd = 0
+        self._admin_cmd = 0
 
     # -- the shuttle -------------------------------------------------------
 
@@ -74,8 +75,15 @@ class SplitShardRig:
         """Drive a ctrler op at whichever live side owns the ctrler
         leader, retrying under ONE (client, command) identity across
         failovers — so a retry that lands at a different side dedups
-        against a commit the caller never saw acked."""
-        t, cid = None, None
+        against a commit the caller never saw acked.  The command id
+        comes from the RIG's counter and is always passed explicitly:
+        letting the accepting side auto-allocate would collide two
+        successive admin ops accepted by different sides (each side's
+        local counter starts at 0) and the second would be silently
+        dedup-swallowed as a duplicate."""
+        self._admin_cmd += 1
+        cid = self._admin_cmd
+        t = None
         for _ in range(max_rounds):
             if t is not None and t.done and not t.failed:
                 return
@@ -87,7 +95,7 @@ class SplitShardRig:
                             client_id=self.ADMIN_CLIENT,
                         )
                         if nt is not None:
-                            t, cid = nt, nt.command_id
+                            t = nt
                             break
             self.shuttle()
         raise TimeoutError(f"ctrler {kind} never committed")
