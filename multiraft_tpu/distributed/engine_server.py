@@ -46,8 +46,10 @@ from .engine_wire import (
     OK,
     EngineCmdArgs,
     EngineCmdReply,
+    PumpCadence,
     make_mesh,
     route_group,
+    service_busy,
 )
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
@@ -92,7 +94,7 @@ class EngineKVService:
         self.sched = sched
         self.kv = kv
         self.G = kv.driver.cfg.G
-        self._interval = pump_interval
+        self._cadence = PumpCadence(pump_interval)
         self._ticks = ticks_per_pump
         self._stopped = False
         self._dur = durability
@@ -134,7 +136,10 @@ class EngineKVService:
                     k: v for k, v in self._write_seqs.items()
                     if not self._dur.synced(v)
                 }
-        self.sched.call_after(self._interval, self._pump_loop)
+        self.sched.call_after(
+            self._cadence.next_delay(service_busy(self.kv)),
+            self._pump_loop,
+        )
 
     def replay_wal(self) -> int:
         """Recovery replay — delegated to

@@ -30,7 +30,9 @@ from .engine_wire import (
     OK,
     EngineCmdArgs,
     EngineCmdReply,
+    PumpCadence,
     make_mesh,
+    service_busy,
 )
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
@@ -70,7 +72,7 @@ class EngineShardKVService:
     ) -> None:
         self.sched = sched
         self.skv = skv
-        self._interval = pump_interval
+        self._cadence = PumpCadence(pump_interval)
         self._ticks = ticks_per_pump
         self._stopped = False
         self.peers = dict(peers or {})
@@ -298,7 +300,10 @@ class EngineShardKVService:
                         k: v for k, v in seqs.items()
                         if not self._dur.synced(v)
                     })
-        self.sched.call_after(self._interval, self._pump_loop)
+        self.sched.call_after(
+            self._cadence.next_delay(service_busy(self.skv)),
+            self._pump_loop,
+        )
 
     def replay_wal(self) -> int:
         """Recovery replay — delegated to

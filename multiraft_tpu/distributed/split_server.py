@@ -39,6 +39,7 @@ from .engine_server import (
     EngineCmdReply,
     route_group,
 )
+from .engine_wire import PumpCadence, service_busy
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 
@@ -316,7 +317,7 @@ class SplitKVService:
         self.peering = peering
         self.peer_ends = dict(peer_ends)
         self.G = kv.driver.cfg.G
-        self._interval = pump_interval
+        self._cadence = PumpCadence(pump_interval)
         self._stopped = False
         self._persist = persistence
         sched.call_soon(self._pump_loop)
@@ -340,7 +341,10 @@ class SplitKVService:
                 self.sched.with_timeout(
                     end.call("SplitEngine.slab", slab), 1.0
                 )
-        self.sched.call_after(self._interval, self._pump_loop)
+        self.sched.call_after(
+            self._cadence.next_delay(service_busy(self.kv)),
+            self._pump_loop,
+        )
 
     # -- peer-facing -------------------------------------------------------
 

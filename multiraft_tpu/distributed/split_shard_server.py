@@ -37,6 +37,7 @@ from ..services.shardkv import SERVING, key2shard
 from ..sim.scheduler import TIMEOUT, Future
 from ..utils.ids import unique_client_id
 from .engine_server import ERR_TIMEOUT, EngineCmdArgs, EngineCmdReply
+from .engine_wire import PumpCadence, service_busy
 from .realtime import RealtimeScheduler
 from .split_server import ERR_WRONG_LEADER
 from .tcp import RpcNode
@@ -67,7 +68,7 @@ class SplitShardKVService:
         self.skv = skv
         self.peering = peering
         self.peer_ends = dict(peer_ends)
-        self._interval = pump_interval
+        self._cadence = PumpCadence(pump_interval)
         self._stopped = False
         sched.call_soon(self._pump_loop)
 
@@ -86,7 +87,10 @@ class SplitShardKVService:
                 self.sched.with_timeout(
                     end.call("SplitEngine.slab", slab), 1.0
                 )
-        self.sched.call_after(self._interval, self._pump_loop)
+        self.sched.call_after(
+            self._cadence.next_delay(service_busy(self.skv)),
+            self._pump_loop,
+        )
 
     # -- peer-facing -------------------------------------------------------
 

@@ -101,8 +101,12 @@ class RpcNode:
         # µs out, so spinning that long before blocking removes the
         # futex wake from the round trip.  Pointless (and harmful —
         # the spinner starves the peer) on a single-CPU box, so the
-        # default is gated on core count.  MRT_SPIN_US overrides.
-        default_spin = "40" if (os.cpu_count() or 1) > 1 else "0"
+        # default is gated on the AFFINITY-aware cpu count (a process
+        # pinned to one core of a big host is a single-CPU box for
+        # this purpose).  MRT_SPIN_US overrides.
+        from ..utils.cpus import usable_cpus
+
+        default_spin = "40" if usable_cpus() > 1 else "0"
         self._tr.set_spin(int(os.environ.get("MRT_SPIN_US", default_spin)))
         # The loop thread doubles as the transport's read reactor; it
         # owns all handler execution and future resolution.

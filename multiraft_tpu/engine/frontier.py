@@ -35,6 +35,10 @@ class FrontierService:
         self.applied_upto = [0] * driver.cfg.G
         driver.on_payload_evicted = self._on_evicted
         self._sweep_countdown = self.ORPHAN_SWEEP_TICKS
+        # Entries applied by the LAST pump's sweep — the serving pump
+        # loops read it as their work-pending signal (adaptive pump
+        # cadence: hot while traffic flows, idle interval otherwise).
+        self.last_applied = 0
         # Split-group mode (engine/split.py): applied payloads are KEPT
         # so a lagging remote peer's resend can still ship them; the
         # peering GCs below the ring floor instead.  Default False: the
@@ -77,6 +81,7 @@ class FrontierService:
         self._pre_sweep()
         commit = np.asarray(self.driver.last_metrics["commit_index"])
         now = self.driver.tick
+        applied = 0
         for g in range(self.driver.cfg.G):
             upto = int(commit[g])
             while self.applied_upto[g] < upto:
@@ -91,6 +96,8 @@ class FrontierService:
                     payload = self.driver.payloads.pop((g, idx), None)
                 self._apply(g, idx, payload, now)
                 self.applied_upto[g] = idx
+                applied += 1
+        self.last_applied = applied
         self._post_pump()
         # Periodically fail bindings orphaned by log truncation (a
         # leader change can strand tail bindings that no future accept
