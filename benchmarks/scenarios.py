@@ -32,9 +32,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _cfg(G=None, P=None, L=112, E=28, ingest=28):
-    """Defaults match bench.py's measured sweet spot (E=INGEST=28,
-    L=112, re-tuned round 2 — see the operating-point note there).  P
+def _cfg(G=None, P=None, L=192, E=48, ingest=48):
+    """Defaults match bench.py's measured sweet spot (E=INGEST=48,
+    L=192, re-tuned round 4 after the phase fusion — see the
+    operating-point note there; E multiples of 32 collapse).  P
     comes from MULTIRAFT_BENCH_P so every scenario is
     peer-count-generic."""
     from multiraft_tpu.engine.core import EngineConfig
@@ -288,11 +289,11 @@ def bench_sweep() -> Dict:
         for G in [g for g in (1000, 10000, 100000) if g <= gmax]:
             # Per-scale operating point (measured, not modeled — the
             # round-3 roofline showed the tick is NOT bandwidth-bound):
-            # at 100k groups the leaner 16/64 ring wins; at <=10k the
-            # round-2 retune (28/112, _cfg's default) wins ~35% over
-            # the old 20/80 — see BENCHMARKS.md "Roofline".
+            # at 100k groups a leaner ring wins; at <=10k the round-4
+            # retune (48/192, _cfg's default) follows the fused tick's
+            # envelope — see BENCHMARKS.md "Roofline".
             cfg = (
-                _cfg(G=G, P=P, L=64, E=16, ingest=16)
+                _cfg(G=G, P=P, L=112, E=28, ingest=28)
                 if G >= 100000
                 else _cfg(G=G, P=P)
             )

@@ -92,6 +92,15 @@ def bench_service(frame: int = 64, n_frames: int = 40,
     sched.run_call(build, timeout=600.0)
     svc = done["svc"]
 
+    if frame > svc.MAX_BATCH:
+        raise ValueError(
+            f"frame={frame} exceeds the service cap {svc.MAX_BATCH} — "
+            "oversized frames answer ErrBatchTooLarge instantly and "
+            "would inflate the measurement"
+        )
+    if n_frames < clerks:
+        raise ValueError(f"n_frames={n_frames} must be >= clerks={clerks}")
+
     results = []
 
     def one_clerk(ci):
@@ -114,6 +123,12 @@ def bench_service(frame: int = 64, n_frames: int = 40,
         sched.wait(f, 600.0)
     elapsed = time.perf_counter() - t0
     sched.stop()
+    # A timed-out or error reply counted as a completed op would
+    # silently inflate the ceiling — demand a fully-OK run.
+    bad = sum(
+        1 for reply in results for r in reply if r.err != "OK"
+    )
+    assert bad == 0, f"{bad} ops did not complete OK — rerun on a quieter box"
     total_ops = (n_frames // clerks) * clerks * frame
     return {
         "service_frames": (n_frames // clerks) * clerks,

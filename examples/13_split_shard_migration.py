@@ -1,0 +1,100 @@
+"""The SHARDED stack over split replica groups: kill -9 the process
+holding every leader MID-migration, and the migration still completes.
+
+examples/12 split a plain-KV group's peers across processes; this is
+the full sharded deployment (engine/split_shard.py) in the same shape:
+the config RSM and every replica group have their 3 peer slots split
+1/2 over two OS processes, slab exchange carrying consensus between
+them.  The migration machinery — config advance, shard pulls, the
+Challenge-1 delete/confirm handshake — is STATE-driven: every process
+applies every group's log, so whichever process owns a leader after a
+failover re-derives exactly the step a dead process never took.
+
+The demo:
+
+1. Two processes come up; gid 1 joins; keys are written.
+2. gid 2 joins — shards start migrating 1 → 2.
+3. The instant the migration is observably mid-flight, process 0
+   (owning ONE slot of every group — and every leader) is SIGKILLed.
+4. Process 1's quorums elect, finish the pull + GC handshake alone,
+   and every acknowledged write is served back intact — no WAL, no
+   disk: replication across the surviving quorum IS the durability.
+
+Reference failure model: shardkv old-owner shutdown mid-migration
+(shardkv/test_test.go:97-216) with per-server failure domains
+(shardkv/config.go:204-262).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multiraft_tpu.distributed.cluster import SplitShardProcessCluster
+from multiraft_tpu.services.shardkv import key2shard
+
+
+def main() -> None:
+    G = 3  # engine group 0 = config RSM; groups 1..2 = gids 1..2
+    owners = {g: [0, 1, 1] for g in range(G)}
+    cluster = SplitShardProcessCluster(
+        owners, n_procs=2, groups=G, delay_elections=[0, 400],
+    )
+    print("starting 2 engine processes sharing the sharded stack's "
+          "peer slots 1/2...")
+    cluster.start_all()
+    clerk = None
+    try:
+        clerk = cluster.clerk()
+        print("join gid 1; writing 8 keys through the clerk")
+        clerk.admin("join", {1: ["proc-demo"]})
+        acked = {}
+        keys = [chr(ord("a") + i) + "-key" for i in range(8)]
+        for k in keys:
+            clerk.append(k, f"[{k[0]}]")
+            acked[k] = f"[{k[0]}]"
+        print("  8 appends acknowledged at gid 1")
+
+        print("join gid 2 — shards begin migrating 1 → 2...")
+        clerk.admin("join", {2: ["proc-demo-2"]})
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            st = clerk.status(0) or clerk.status(1)
+            if st and st[2]:
+                break
+            time.sleep(0.02)
+        print("  migration observably mid-flight")
+
+        print("kill -9 process 0 (owns ONE slot of every group — and "
+              "every leader)")
+        cluster.kill(0)
+
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            st = clerk.status(1)
+            if st and st[0] >= 2 and not st[2]:
+                break
+            time.sleep(0.05)
+        st = clerk.status(1)
+        assert st and st[0] >= 2 and not st[2], st
+        print(f"  survivor finished the migration alone: config {st[0]}, "
+              f"shards → {st[1]}")
+
+        for k in keys:
+            got = clerk.get(k)
+            assert got == acked[k], (k, got)
+        moved = next(k for k in keys if st[1][key2shard(k)] == 2)
+        clerk.append(moved, "[post]")
+        assert clerk.get(moved) == acked[moved] + "[post]"
+        print("every acknowledged write intact; migrated shards serve "
+              "fresh writes at the new owner — no WAL replay, "
+              "replication was the durability")
+    finally:
+        if clerk is not None:
+            clerk.close()
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

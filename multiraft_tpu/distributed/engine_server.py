@@ -46,12 +46,14 @@ from .engine_wire import (
     OK,
     EngineCmdArgs,
     EngineCmdReply,
-    PumpCadence,
     make_mesh,
     route_group,
+)
+from .realtime import (
+    PumpCadence,
+    RealtimeScheduler,
     service_busy,
 )
-from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 
 __all__ = [

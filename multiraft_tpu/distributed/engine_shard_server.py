@@ -30,11 +30,13 @@ from .engine_wire import (
     OK,
     EngineCmdArgs,
     EngineCmdReply,
-    PumpCadence,
     make_mesh,
+)
+from .realtime import (
+    PumpCadence,
+    RealtimeScheduler,
     service_busy,
 )
-from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 
 __all__ = ["EngineShardKVService", "serve_engine_shardkv"]

@@ -12,7 +12,6 @@ import zlib
 
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
 from ..transport import codec
-from ..utils.cpus import usable_cpus
 
 __all__ = [
     "OK",
@@ -51,52 +50,6 @@ def route_group(key: str, G: int) -> int:
     """Deterministic key→group routing shared by every process (a
     stable hash — Python's builtin is salted per process)."""
     return zlib.crc32(key.encode()) % G
-
-
-class PumpCadence:
-    """Adaptive pump scheduling shared by the serving loops: pump HOT
-    (a fraction of the idle interval) while client work is in flight,
-    idle cadence otherwise.  The fixed-interval loop leaves the pump
-    ~half idle under load (measured: the in-process framed ceiling
-    rises 28k → 45k ops/s at a fixed hot cadence); the idle interval
-    still bounds the steady-state CPU burn, and the hot interval keeps
-    a real idle window each cycle so the socket reactor (the
-    scheduler's idle wait) continues to run.
-
-    GATED ON CORE COUNT, like the transport's adaptive busy-poll
-    (tcp.py MRT_SPIN_US): on a single-CPU box the hot pump steals the
-    co-located clients' cycles and the end-to-end number DROPS
-    (measured −38% on the 1-core test VM), so single-core hosts keep
-    the fixed cadence.  ``MRT_PUMP_HOT=1/0`` overrides."""
-
-    HOT_DIV = 5     # hot interval = interval / HOT_DIV
-    HOT_PUMPS = 3   # stay hot this many pumps past the last work
-
-    def __init__(self, interval: float) -> None:
-        import os
-
-        self.interval = interval
-        self.hot_interval = interval / self.HOT_DIV
-        default = "1" if usable_cpus() > 1 else "0"
-        self.enabled = os.environ.get("MRT_PUMP_HOT", default) == "1"
-        self._hot = 0
-
-    def next_delay(self, busy: bool) -> float:
-        """``busy`` = the service observed in-flight work this pump
-        (entries applied, or commands waiting in the backlog)."""
-        if not self.enabled:
-            return self.interval
-        if busy:
-            self._hot = self.HOT_PUMPS
-        elif self._hot:
-            self._hot -= 1
-        return self.hot_interval if self._hot else self.interval
-
-
-def service_busy(svc) -> bool:
-    """The serving loops' shared work-pending signal: the last sweep
-    applied entries, or submitted commands await ingestion."""
-    return bool(svc.last_applied) or bool(svc.driver.backlog.any())
 
 
 def make_mesh(n_devices: int):

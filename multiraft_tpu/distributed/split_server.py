@@ -39,7 +39,7 @@ from .engine_server import (
     EngineCmdReply,
     route_group,
 )
-from .engine_wire import PumpCadence, service_busy
+from .realtime import PumpCadence, service_busy
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 

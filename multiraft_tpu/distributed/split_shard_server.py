@@ -37,7 +37,7 @@ from ..services.shardkv import SERVING, key2shard
 from ..sim.scheduler import TIMEOUT, Future
 from ..utils.ids import unique_client_id
 from .engine_server import ERR_TIMEOUT, EngineCmdArgs, EngineCmdReply
-from .engine_wire import PumpCadence, service_busy
+from .realtime import PumpCadence, service_busy
 from .realtime import RealtimeScheduler
 from .split_server import ERR_WRONG_LEADER
 from .tcp import RpcNode
