@@ -78,6 +78,17 @@ def bench_service(frame: int = 64, n_frames: int = 40,
     from multiraft_tpu.engine.host import EngineDriver
     from multiraft_tpu.engine.kv import BatchedKV
 
+    # Validate BEFORE the expensive engine build (both checks depend
+    # only on the args and a class constant).
+    if frame > EngineKVService.MAX_BATCH:
+        raise ValueError(
+            f"frame={frame} exceeds the service cap "
+            f"{EngineKVService.MAX_BATCH} — oversized frames answer "
+            "ErrBatchTooLarge instantly and would inflate the measurement"
+        )
+    if n_frames < clerks:
+        raise ValueError(f"n_frames={n_frames} must be >= clerks={clerks}")
+
     sched = RealtimeScheduler()
     done = {"svc": None}
 
@@ -91,15 +102,6 @@ def bench_service(frame: int = 64, n_frames: int = 40,
 
     sched.run_call(build, timeout=600.0)
     svc = done["svc"]
-
-    if frame > svc.MAX_BATCH:
-        raise ValueError(
-            f"frame={frame} exceeds the service cap {svc.MAX_BATCH} — "
-            "oversized frames answer ErrBatchTooLarge instantly and "
-            "would inflate the measurement"
-        )
-    if n_frames < clerks:
-        raise ValueError(f"n_frames={n_frames} must be >= clerks={clerks}")
 
     results = []
 

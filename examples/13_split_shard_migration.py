@@ -59,11 +59,17 @@ def main() -> None:
         print("join gid 2 — shards begin migrating 1 → 2...")
         clerk.admin("join", {2: ["proc-demo-2"]})
         deadline = time.monotonic() + 60.0
+        mid_flight = False
         while time.monotonic() < deadline:
             st = clerk.status(0) or clerk.status(1)
             if st and st[2]:
+                mid_flight = True
                 break
             time.sleep(0.02)
+        assert mid_flight, (
+            "migration never became observable — the kill below would "
+            "not demonstrate mid-migration recovery"
+        )
         print("  migration observably mid-flight")
 
         print("kill -9 process 0 (owns ONE slot of every group — and "

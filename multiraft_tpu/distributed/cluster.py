@@ -265,6 +265,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             host=spec.get("host", "127.0.0.1"),
             seed=spec.get("seed", 0),
             delay_elections=spec.get("delay_elections", 0),
+            data_dir=spec.get("data_dir"),
+            snapshot_every_s=spec.get("snapshot_every_s", 30.0),
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")

@@ -239,8 +239,11 @@ class SplitShardProcessCluster:
     config RSM and every replica group survive any minority-owner
     process death — including mid-migration (the reference shardkv
     failure model, shardkv/config.go:204-262, at the process level).
-    Non-durable by design: replication across surviving quorums IS the
-    durability; a killed member must stay dead."""
+    Without ``data_dir``, replication across surviving quorums IS the
+    durability and a killed member must stay dead; with it, each
+    process is durable under its peer identity (SplitPersistence via
+    the shared service-adapter trio) and ``kill(i)`` + ``start(i)``
+    REJOINS from the persisted term/vote/log + service redo log."""
 
     def __init__(
         self,
@@ -250,6 +253,8 @@ class SplitShardProcessCluster:
         host: str = "127.0.0.1",
         seed: int = 0,
         delay_elections: Optional[Sequence[int]] = None,
+        data_dir: Optional[str] = None,
+        snapshot_every_s: float = 30.0,
     ) -> None:
         from . import engine_server  # noqa: F401  (codec registration)
         from . import split_shard_server  # noqa: F401
@@ -258,7 +263,7 @@ class SplitShardProcessCluster:
         self.ports = _reserve_ports(n_procs, host)
         self.specs = []
         for i in range(n_procs):
-            self.specs.append({
+            spec = {
                 "kind": "split_shardkv",
                 "me": i,
                 "host": host,
@@ -270,12 +275,29 @@ class SplitShardProcessCluster:
                     int(delay_elections[i]) if delay_elections else 0
                 ),
                 "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
-            })
+            }
+            if data_dir is not None:
+                # Durable peer identity (SplitPersistence): kill(i) +
+                # start(i) REJOINS from the persisted term/vote/log +
+                # service redo log.
+                spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
+                spec["snapshot_every_s"] = snapshot_every_s
+            self.specs.append(spec)
+        self.durable = data_dir is not None
         self._killed: set = set()
         self.procs: List[Optional[subprocess.Popen]] = [None] * n_procs
 
+    def start(self, i: int) -> None:
+        assert self.procs[i] is None or self.procs[i].poll() is not None
+        assert self.durable or i not in self._killed, (
+            f"process {i} was killed; a non-durable split peer must "
+            "stay dead (pass data_dir= for safe rejoin)"
+        )
+        self.procs[i] = _launch_server(self.specs[i], f"splitshard-{i}")
+        _check_ready(self.procs[i], f"splitshard-{i}", timeout=300.0)
+
     def start_all(self) -> None:
-        assert not self._killed, (
+        assert self.durable or not self._killed, (
             "a killed split peer must stay dead (non-durable identity)"
         )
         for i, spec in enumerate(self.specs):

@@ -181,11 +181,9 @@ class SplitPersistence:
         gs = self.peering.split_gs
         blob = {
             "svc": {
-                g: (
-                    self.kv.applied_upto[g],
-                    dict(self.kv.data[g]),
-                    dict(self.kv.sessions[g]),
-                )
+                # (applied_upto, service blob) via the service adapter
+                # (SplitKV / SplitShardKV persist_group).
+                g: self.kv.persist_group(g)
                 for g in gs
             },
             "cands": [
@@ -257,12 +255,10 @@ class SplitPersistence:
         drv.state = drv.state._replace(
             **{f: jnp.asarray(v) for f, v in host.items()}
         )
-        # 2. Service state from the snapshot.
+        # 2. Service state from the snapshot (service adapter).
         if blob:
-            for g, (upto, data, sessions) in blob["svc"].items():
-                kv.data[g] = dict(data)
-                kv.sessions[g] = dict(sessions)
-                kv.applied_upto[g] = upto
+            for g, (upto, sblob) in blob["svc"].items():
+                kv.restore_group(g, upto, sblob)
         # 3. Payload candidates (snapshot + WAL increments).
         for g, idx, term, wire in pays:
             payload = kv.import_payload(wire)
@@ -271,9 +267,10 @@ class SplitPersistence:
                 drv.payloads[(g, idx)] = payload
         # 4. Service-state redo: applied entries since the snapshot,
         #    in commit order, exact by (idx, term) — fallback applies
-        #    (term -1) carry their op in the record itself.
-        from ..engine.kv import apply_kv_op
-
+        #    (term -1) carry their op in the record itself.  The
+        #    service adapter's replay_apply routes through the same
+        #    apply path as live serving, so recovery can never drift
+        #    from serving semantics.
         for g, idx, term, wire in apps:
             if idx <= kv.applied_upto[g]:
                 continue  # already inside the snapshot
@@ -283,9 +280,7 @@ class SplitPersistence:
             elif wire is not None:
                 payload = kv.import_payload(wire)
             if payload is not None:
-                # Same apply function as the live path (engine/kv.py)
-                # — recovery can never drift from serving semantics.
-                apply_kv_op(kv.data[g], kv.sessions[g], payload[0])
+                kv.replay_apply(g, idx, payload)
             kv.applied_upto[g] = idx
         for g in peering.split_gs:
             peering.gc_floor[g] = kv.applied_upto[g]

@@ -63,6 +63,7 @@ class SplitShardKVService:
         peering: SplitPeering,
         peer_ends: Dict[int, object],
         pump_interval: float = 0.002,
+        persistence=None,  # SplitPersistence (durable peer identity)
     ) -> None:
         self.sched = sched
         self.skv = skv
@@ -70,6 +71,7 @@ class SplitShardKVService:
         self.peer_ends = dict(peer_ends)
         self._cadence = PumpCadence(pump_interval)
         self._stopped = False
+        self._persist = persistence
         sched.call_soon(self._pump_loop)
 
     def stop(self) -> None:
@@ -79,6 +81,11 @@ class SplitShardKVService:
         if self._stopped:
             return
         self.skv.pump(1)
+        if self._persist is not None:
+            # THE persistence invariant: the pump's raft slice is
+            # fsynced before any of its slabs leave the process
+            # (split_server.SplitPersistence).
+            self._persist.after_pump()
         for proc, slab in self.peering.extract().items():
             end = self.peer_ends.get(proc)
             if end is not None:
@@ -265,17 +272,27 @@ def serve_split_shardkv(
     host: str = "127.0.0.1",
     seed: int = 0,
     delay_elections: int = 0,
+    data_dir: Optional[str] = None,
+    snapshot_every_s: float = 30.0,
 ) -> RpcNode:
     """Bring up one split-shard process: engine group 0 = config RSM,
     groups ``1..G-1`` = gids ``1..G-1``, peer slots placed per
-    ``owners`` (every process passes the SAME map).  Non-durable: a
-    killed process must stay dead (fresh state under an old peer
-    identity can double-vote); the surviving quorums carry every acked
-    write — that IS the durability story of this deployment shape."""
+    ``owners`` (every process passes the SAME map).
+
+    With ``data_dir`` the process is DURABLE under its peer identity
+    (split_server.SplitPersistence, via the shared service-adapter
+    trio): a kill -9'd process may be restarted on the same dir and
+    REJOINS safely — persisted term/vote/log make double-votes and
+    acked-entry loss impossible, and the service redo log re-applies
+    shard/config state through the live apply gates.  Without it, a
+    killed process must stay dead; the surviving quorums carry every
+    acked write — replication is the durability."""
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
 
     def build():
+        from .split_server import SplitPersistence
+
         cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8,
                            host_paced_compaction=True)
         driver = EngineDriver(cfg, seed=seed)
@@ -285,6 +302,13 @@ def serve_split_shardkv(
                 int(g): list(o) for g, o in owners.items()
             })
         )
+        persist = None
+        if data_dir is not None:
+            persist = SplitPersistence(
+                data_dir, skv, peering, snapshot_every_s=snapshot_every_s
+            )
+            # BEFORE any tick: pre-restore state must never act.
+            persist.load_and_install()
         if delay_elections:
             driver.state = driver.state._replace(
                 elect_dl=driver.state.elect_dl + int(delay_elections)
@@ -297,7 +321,8 @@ def serve_split_shardkv(
             for p, (h, pt) in peer_addrs.items()
             if int(p) != me
         }
-        return SplitShardKVService(sched, skv, peering, ends)
+        return SplitShardKVService(sched, skv, peering, ends,
+                                   persistence=persist)
 
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("SplitShardKV", svc)

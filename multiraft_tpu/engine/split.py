@@ -74,7 +74,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .host import EngineDriver
-from .kv import BatchedKV, KVOp, Ticket
+from .kv import BatchedKV, KVOp, Ticket, apply_kv_op
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
 
 __all__ = ["SplitSpec", "SplitPeering", "SplitFrontierMixin", "SplitKV"]
@@ -602,12 +602,25 @@ class SplitKV(SplitFrontierMixin, BatchedKV):
             "sessions": dict(self.sessions[g]),
         }
 
-    def install_group_snapshot(self, g: int, upto: int, blob: dict) -> None:
-        if upto <= self.applied_upto[g]:
-            return  # stale slab: we are already past it
+    # persist_group/restore_group/replay_apply: the service adapter
+    # trio SplitPersistence drives (shared contract with SplitShardKV).
+    persist_group = snapshot_group
+
+    def restore_group(self, g: int, upto: int, blob: dict) -> None:
         self.data[g] = dict(blob["data"])
         self.sessions[g] = dict(blob["sessions"])
         self.applied_upto[g] = upto
+
+    def replay_apply(self, g: int, idx: int, payload) -> None:
+        """Redo one recovered applied entry onto host state — the same
+        apply function as the live path (engine/kv.py), so recovery
+        can never drift from serving semantics."""
+        apply_kv_op(self.data[g], self.sessions[g], payload[0])
+
+    def install_group_snapshot(self, g: int, upto: int, blob: dict) -> None:
+        if upto <= self.applied_upto[g]:
+            return  # stale slab: we are already past it
+        self.restore_group(g, upto, blob)
         if self.on_snapshot_installed is not None:
             # Persistence must capture this state before the next
             # pump's raft slice (whose base jumped with it) is fsynced

@@ -234,9 +234,39 @@ class SplitShardKV(SplitFrontierMixin, BatchedShardKV):
             },
         }
 
+    # persist_group/restore_group/replay_apply: the service adapter
+    # trio SplitPersistence drives (shared contract with SplitKV) —
+    # the durable sharded split reuses the same snapshot + redo-log
+    # machinery the plain-KV split peers have.
+    def persist_group(self, g: int) -> Tuple[int, dict]:
+        return self.snapshot_group(g)
+
+    def replay_apply(self, g: int, idx: int, payload) -> None:
+        """Redo one recovered applied entry through the SAME dispatch
+        the live path uses (dedup tables and config/state gates make
+        anything already inside the snapshot a no-op), with the
+        durability hooks suppressed so replay does not re-log its own
+        records."""
+        if isinstance(payload, _NoOp):
+            return
+        hooks = (self.on_applied, self.on_insert, self.on_delete,
+                 self.on_confirm, self.on_write, self.on_ctrl)
+        (self.on_applied, self.on_insert, self.on_delete,
+         self.on_confirm, self.on_write, self.on_ctrl) = (None,) * 6
+        try:
+            BatchedShardKV._apply(self, g, idx, payload, 0)
+        finally:
+            (self.on_applied, self.on_insert, self.on_delete,
+             self.on_confirm, self.on_write, self.on_ctrl) = hooks
+
     def install_group_snapshot(self, g: int, upto: int, blob: dict) -> None:
         if upto <= self.applied_upto[g]:
             return  # stale slab: we are already past it
+        self.restore_group(g, upto, blob)
+        if self.on_snapshot_installed is not None:
+            self.on_snapshot_installed(g)
+
+    def restore_group(self, g: int, upto: int, blob: dict) -> None:
         if blob["kind"] == "ctrl":
             import jax.numpy as jnp
             import numpy as np
@@ -263,8 +293,6 @@ class SplitShardKV(SplitFrontierMixin, BatchedShardKV):
             rep.pending_delete.clear()
             rep.pending_confirm.clear()
         self.applied_upto[g] = upto
-        if self.on_snapshot_installed is not None:
-            self.on_snapshot_installed(g)
 
     # -- apply: term-arbitrated payload choice -----------------------------
 

@@ -85,3 +85,56 @@ def test_split_shard_kill9_minority_owner_mid_migration(tmp_path):
         if clerk is not None:
             clerk.close()
         cluster.shutdown()
+
+
+def test_split_shard_durable_kill9_rejoin(tmp_path):
+    """Durable sharded split (the SplitPersistence adapter trio): a
+    kill -9'd process RESTARTS on its data_dir and REJOINS under the
+    same peer identity — persisted term/vote/log prevent double-votes,
+    and the service redo log re-applies shard/config state through the
+    live apply gates.  After the rejoin, a group whose QUORUM lives on
+    the restarted process works again (the survivor alone could not
+    commit it)."""
+    # Process 0 owns a MAJORITY of group 1's slots (and a minority of
+    # the others): killing it stalls gid 1 until the rejoin.
+    owners = {0: [0, 1, 1], 1: [0, 0, 1], 2: [0, 1, 1]}
+    cluster = SplitShardProcessCluster(
+        owners, n_procs=2, groups=G, delay_elections=[0, 400],
+        data_dir=str(tmp_path), snapshot_every_s=2.0,
+    )
+    clerk = None
+    try:
+        cluster.start_all()
+        clerk = cluster.clerk()
+        clerk.admin("join", {1: ["p1"]})
+        clerk.admin("join", {2: ["p2"]})
+        acked = {}
+        keys = [chr(ord("a") + i) + "key" for i in range(8)]
+        for k in keys:
+            clerk.append(k, f"[a-{k}]")
+            acked[k] = f"[a-{k}]"
+        # Let a snapshot + some WAL records land.
+        time.sleep(2.5)
+
+        cluster.kill(0)
+        # gid 1 lost its quorum (proc 0 owned 2 of 3): stalled, not
+        # lost.  Shards owned by OTHER gids still serve.
+        st = clerk.status(1)
+        assert st is not None
+
+        # REJOIN: restart process 0 from its data_dir.
+        cluster.start(0)
+        for k in keys:
+            got = clerk.get(k)
+            assert got == acked[k], f"acked write lost across rejoin: {k}"
+        # New writes commit on every gid — including gid 1, whose
+        # quorum needs the restarted process's slots.
+        for k in keys:
+            clerk.append(k, "[post]")
+            acked[k] += "[post]"
+        for k in keys:
+            assert clerk.get(k) == acked[k]
+    finally:
+        if clerk is not None:
+            clerk.close()
+        cluster.shutdown()
