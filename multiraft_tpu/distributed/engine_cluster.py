@@ -119,25 +119,31 @@ class EngineProcessCluster:
         self.proc = None
 
 
-class SplitProcessCluster:
-    """Several engine processes SHARING each replica group's peer slots
-    (engine/split.py + distributed/split_server.py) — the deployment
-    where one process's death loses only its owned peer slots, and any
-    group whose surviving slots hold a quorum keeps serving with every
-    acknowledged write intact (no WAL, no disk: replication is the
-    durability).  Contrast :class:`EngineFleetCluster`, which
-    partitions whole gids per process.
+class _SplitClusterBase:
+    """Shared driver for the split deployments (plain KV and sharded):
+    spec construction, the durable-vs-stay-dead crash discipline, and
+    process lifecycle live exactly once here; subclasses pin the server
+    kind, the label, and the clerk.
 
     ``owners[g][p]`` = process index owning peer slot ``p`` of group
     ``g`` (same map for every process).  ``delay_elections[i]`` biases
     process ``i``'s first election deadlines later — tests use it to
-    park initial leadership on a chosen process."""
+    park initial leadership on a chosen process.  Without ``data_dir``,
+    replication across surviving quorums IS the durability and a
+    killed member must stay dead (a fresh-state restart under an old
+    peer identity can double-vote, engine/split.py's crash-model
+    note); with it, each process is durable under its peer identity
+    (SplitPersistence) and ``kill(i)`` + ``start(i)`` REJOINS from the
+    persisted term/vote/log + service redo log."""
+
+    KIND: str
+    LABEL: str
 
     def __init__(
         self,
         owners: Dict[int, Sequence[int]],
         n_procs: int,
-        groups: int = 8,
+        groups: int,
         host: str = "127.0.0.1",
         seed: int = 0,
         delay_elections: Optional[Sequence[int]] = None,
@@ -146,13 +152,14 @@ class SplitProcessCluster:
     ) -> None:
         from . import engine_server  # noqa: F401  (codec registration)
         from . import split_server  # noqa: F401
+        from . import split_shard_server  # noqa: F401
 
         self.host = host
         self.ports = _reserve_ports(n_procs, host)
         self.specs = []
         for i in range(n_procs):
             spec = {
-                "kind": "split_kv",
+                "kind": self.KIND,
                 "me": i,
                 "host": host,
                 "ports": self.ports,
@@ -165,8 +172,6 @@ class SplitProcessCluster:
                 "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
             }
             if data_dir is not None:
-                # Durable peer identity (SplitPersistence): kill(i) +
-                # start(i) REJOINS from the persisted term/vote/log.
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
                 spec["snapshot_every_s"] = snapshot_every_s
             self.specs.append(spec)
@@ -177,33 +182,28 @@ class SplitProcessCluster:
     def start(self, i: int) -> None:
         assert self.procs[i] is None or self.procs[i].poll() is not None
         # Restarting a previously-killed member is only safe in durable
-        # mode — a fresh-state restart under an old peer identity can
-        # double-vote (engine/split.py crash-model note).
+        # mode (the double-vote hazard — see the class docstring).
         assert self.durable or i not in self._killed, (
             f"process {i} was killed; a non-durable split peer must "
             "stay dead (pass data_dir= for safe rejoin)"
         )
-        self.procs[i] = _launch_server(self.specs[i], f"split-{i}")
-        _check_ready(self.procs[i], f"split-{i}", timeout=300.0)
+        self.procs[i] = _launch_server(self.specs[i], f"{self.LABEL}-{i}")
+        _check_ready(self.procs[i], f"{self.LABEL}-{i}", timeout=300.0)
 
     def start_all(self) -> None:
-        # Same double-vote guard as start(): relaunching a previously
-        # killed member with fresh state is only safe in durable mode.
         assert self.durable or not self._killed, (
             f"processes {sorted(self._killed)} were killed; a "
             "non-durable split peer must stay dead (pass data_dir= "
             "for safe rejoin)"
         )
         for i, spec in enumerate(self.specs):
-            self.procs[i] = _launch_server(spec, f"split-{i}")
+            self.procs[i] = _launch_server(spec, f"{self.LABEL}-{i}")
         for i, p in enumerate(self.procs):
-            _check_ready(p, f"split-{i}", timeout=300.0)
+            _check_ready(p, f"{self.LABEL}-{i}", timeout=300.0)
 
     def kill(self, i: int) -> None:
         """SIGKILL process ``i``.  Durable mode: :meth:`start` rejoins
-        it from its data_dir.  Non-durable: it must stay dead — a split
-        peer restarted with fresh state can double-vote (see
-        engine/split.py's crash-model note)."""
+        it from its data_dir; non-durable: it must stay dead."""
         p = self.procs[i]
         if p is not None and p.poll() is None:
             p.kill()
@@ -211,12 +211,28 @@ class SplitProcessCluster:
         self.procs[i] = None
         self._killed.add(i)
 
-    def clerk(self) -> "BlockingSplitClerk":
-        return BlockingSplitClerk(self.ports, host=self.host)
-
     def shutdown(self) -> None:
         for i in range(len(self.procs)):
             self.kill(i)
+
+
+class SplitProcessCluster(_SplitClusterBase):
+    """Several engine processes SHARING each replica group's peer slots
+    (engine/split.py + distributed/split_server.py) — one process's
+    death loses only its owned peer slots; any group whose surviving
+    slots hold a quorum keeps serving with every acknowledged write
+    intact.  Contrast :class:`EngineFleetCluster`, which partitions
+    whole gids per process.  Crash/durability discipline:
+    :class:`_SplitClusterBase`."""
+
+    KIND = "split_kv"
+    LABEL = "split"
+
+    def __init__(self, owners, n_procs, groups: int = 8, **kw) -> None:
+        super().__init__(owners, n_procs, groups, **kw)
+
+    def clerk(self) -> "BlockingSplitClerk":
+        return BlockingSplitClerk(self.ports, host=self.host)
 
 
 class BlockingSplitClerk(_BlockingClerkBase):
@@ -233,92 +249,22 @@ class BlockingSplitClerk(_BlockingClerkBase):
         self._clerk = SplitNetClerk(self.sched, ends)
 
 
-class SplitShardProcessCluster:
+class SplitShardProcessCluster(_SplitClusterBase):
     """Several engine processes SHARING the sharded stack's peer slots
     (engine/split_shard.py + distributed/split_shard_server.py): the
     config RSM and every replica group survive any minority-owner
     process death — including mid-migration (the reference shardkv
     failure model, shardkv/config.go:204-262, at the process level).
-    Without ``data_dir``, replication across surviving quorums IS the
-    durability and a killed member must stay dead; with it, each
-    process is durable under its peer identity (SplitPersistence via
-    the shared service-adapter trio) and ``kill(i)`` + ``start(i)``
-    REJOINS from the persisted term/vote/log + service redo log."""
+    Crash/durability discipline: :class:`_SplitClusterBase`."""
 
-    def __init__(
-        self,
-        owners: Dict[int, Sequence[int]],
-        n_procs: int,
-        groups: int = 3,
-        host: str = "127.0.0.1",
-        seed: int = 0,
-        delay_elections: Optional[Sequence[int]] = None,
-        data_dir: Optional[str] = None,
-        snapshot_every_s: float = 30.0,
-    ) -> None:
-        from . import engine_server  # noqa: F401  (codec registration)
-        from . import split_shard_server  # noqa: F401
+    KIND = "split_shardkv"
+    LABEL = "splitshard"
 
-        self.host = host
-        self.ports = _reserve_ports(n_procs, host)
-        self.specs = []
-        for i in range(n_procs):
-            spec = {
-                "kind": "split_shardkv",
-                "me": i,
-                "host": host,
-                "ports": self.ports,
-                "owners": {str(g): list(o) for g, o in owners.items()},
-                "groups": groups,
-                "seed": seed + i,
-                "delay_elections": (
-                    int(delay_elections[i]) if delay_elections else 0
-                ),
-                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
-            }
-            if data_dir is not None:
-                # Durable peer identity (SplitPersistence): kill(i) +
-                # start(i) REJOINS from the persisted term/vote/log +
-                # service redo log.
-                spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
-                spec["snapshot_every_s"] = snapshot_every_s
-            self.specs.append(spec)
-        self.durable = data_dir is not None
-        self._killed: set = set()
-        self.procs: List[Optional[subprocess.Popen]] = [None] * n_procs
-
-    def start(self, i: int) -> None:
-        assert self.procs[i] is None or self.procs[i].poll() is not None
-        assert self.durable or i not in self._killed, (
-            f"process {i} was killed; a non-durable split peer must "
-            "stay dead (pass data_dir= for safe rejoin)"
-        )
-        self.procs[i] = _launch_server(self.specs[i], f"splitshard-{i}")
-        _check_ready(self.procs[i], f"splitshard-{i}", timeout=300.0)
-
-    def start_all(self) -> None:
-        assert self.durable or not self._killed, (
-            "a killed split peer must stay dead (non-durable identity)"
-        )
-        for i, spec in enumerate(self.specs):
-            self.procs[i] = _launch_server(spec, f"splitshard-{i}")
-        for i, p in enumerate(self.procs):
-            _check_ready(p, f"splitshard-{i}", timeout=300.0)
-
-    def kill(self, i: int) -> None:
-        p = self.procs[i]
-        if p is not None and p.poll() is None:
-            p.kill()
-            p.wait()
-        self.procs[i] = None
-        self._killed.add(i)
+    def __init__(self, owners, n_procs, groups: int = 3, **kw) -> None:
+        super().__init__(owners, n_procs, groups, **kw)
 
     def clerk(self) -> "BlockingSplitShardClerk":
         return BlockingSplitShardClerk(self.ports, host=self.host)
-
-    def shutdown(self) -> None:
-        for i in range(len(self.procs)):
-            self.kill(i)
 
 
 class BlockingSplitShardClerk(_BlockingClerkBase):

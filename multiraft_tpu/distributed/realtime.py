@@ -347,9 +347,11 @@ class PumpCadence:
             return self.interval
         if busy:
             self._hot = self.HOT_PUMPS
-        elif self._hot:
+            return self.hot_interval
+        if self._hot:
             self._hot -= 1
-        return self.hot_interval if self._hot else self.interval
+            return self.hot_interval
+        return self.interval
 
 
 def service_busy(svc) -> bool:
