@@ -117,6 +117,77 @@ def test_minority_partition_no_commit():
     assert int(d.np_state()["commit"][0].max()) > base_commit
 
 
+def test_follower_failure_progressive():
+    """Progressive follower loss: commits continue with one follower
+    dead, stop entirely once the leader has no quorum (engine form of
+    reference raft/test_test.go:189 For2023TestFollowerFailure2B)."""
+    d = make(G=1, P=3, seed=21)
+    assert d.run_until_quiet_leaders(300)
+    d.start(0, 101)
+    for _ in range(40):
+        d.step()
+    leader = d.leader_of(0)
+    d.set_alive(0, (leader + 1) % 3, False)
+
+    # Leader + remaining follower still agree.
+    d.start(0, 102)
+    d.start(0, 103)
+    for _ in range(60):
+        d.step()
+    st = d.np_state()
+    assert int(st["commit"][0, leader]) >= 3, st["commit"][0]
+
+    # Kill the remaining follower: no quorum, nothing more commits.
+    leader2 = d.leader_of(0)
+    for p in range(3):
+        if p != leader2 and bool(d.np_state()["alive"][0, p]):
+            d.set_alive(0, p, False)
+    before = int(d.np_state()["commit"][0].max())
+    d.start(0, 104)
+    for _ in range(120):
+        d.step()
+    assert int(d.np_state()["commit"][0].max()) == before, (
+        "committed without a majority"
+    )
+    d.check_log_matching(0)
+
+
+def test_leader_failure_progressive():
+    """Progressive leader loss: a replacement is elected after the
+    first kill; after the second there is no quorum and nothing
+    commits (engine form of reference raft/test_test.go:236
+    For2023TestLeaderFailure2B)."""
+    d = make(G=1, P=3, seed=22)
+    assert d.run_until_quiet_leaders(300)
+    d.start(0, 101)
+    for _ in range(40):
+        d.step()
+    leader1 = d.leader_of(0)
+    d.set_alive(0, leader1, False)
+
+    # The two survivors elect a replacement and keep committing
+    # (run_until_quiet_leaders is the failover assert: leader_of only
+    # ever returns a live replica, so it cannot name leader1 here).
+    assert d.run_until_quiet_leaders(400), "no failover leader"
+    leader2 = d.leader_of(0)
+    d.start(0, 102)
+    d.start(0, 103)
+    for _ in range(60):
+        d.step()
+    assert int(d.np_state()["commit"][0, leader2]) >= 3
+
+    # Kill the replacement too: one live replica, no quorum.
+    d.set_alive(0, leader2, False)
+    before = int(d.np_state()["commit"][0].max())
+    d.start(0, 104)
+    for _ in range(120):
+        d.step()
+    assert int(d.np_state()["commit"][0].max()) == before, (
+        "committed without a majority"
+    )
+    d.check_log_matching(0)
+
+
 def test_divergent_log_truncation():
     """A partitioned leader's uncommitted tail is overwritten after heal
     (2B rejoin / figure-8 analog)."""

@@ -85,6 +85,63 @@ def test_fail_no_agree():
     cfg.cleanup()
 
 
+def test_follower_failure():
+    """Progressive follower loss: agreement with one follower down,
+    then no commit once both are down (no quorum)
+    (reference: raft/test_test.go:189 For2023TestFollowerFailure2B)."""
+    cfg = RaftHarness(3, seed=18)
+    cfg.one(101, 3, retry=False)
+
+    # Disconnect one follower; leader + remaining follower still agree.
+    leader1 = cfg.check_one_leader()
+    cfg.disconnect((leader1 + 1) % 3)
+    cfg.one(102, 2, retry=False)
+    cfg.sched.run_for(ELECTION_TIMEOUT[1])
+    cfg.one(103, 2, retry=False)
+
+    # Disconnect the remaining follower: the leader has no quorum.
+    leader2 = cfg.check_one_leader()
+    cfg.disconnect((leader2 + 1) % 3)
+    cfg.disconnect((leader2 + 2) % 3)
+
+    index, _, ok = cfg.rafts[leader2].start(104)
+    assert ok, "leader rejected start()"
+    assert index == 4, f"expected index 4, got {index}"
+    cfg.sched.run_for(2 * ELECTION_TIMEOUT[1])
+    nd, _ = cfg.n_committed(index)
+    assert nd == 0, f"{nd} committed but no majority"
+    cfg.cleanup()
+
+
+def test_leader_failure():
+    """Progressive leader loss: a new leader takes over after the first
+    disconnect; after the second there is no quorum and nothing commits
+    (reference: raft/test_test.go:236 For2023TestLeaderFailure2B)."""
+    cfg = RaftHarness(3, seed=19)
+    cfg.one(101, 3, retry=False)
+
+    # Disconnect the leader; the two followers elect a replacement.
+    leader1 = cfg.check_one_leader()
+    cfg.disconnect(leader1)
+    cfg.one(102, 2, retry=False)
+    cfg.sched.run_for(ELECTION_TIMEOUT[1])
+    cfg.one(103, 2, retry=False)
+
+    # Disconnect the new leader too: only one connected server remains.
+    leader2 = cfg.check_one_leader()
+    cfg.disconnect(leader2)
+
+    # Submit a command to every server (the reference does — only the
+    # disconnected leader accepts it, and it must never commit).
+    for i in range(3):
+        cfg.rafts[i].start(104)
+
+    cfg.sched.run_for(2 * ELECTION_TIMEOUT[1])
+    nd, _ = cfg.n_committed(4)
+    assert nd == 0, f"{nd} committed but no majority"
+    cfg.cleanup()
+
+
 def test_concurrent_starts():
     """Concurrent Start()s in one term all commit
     (reference: raft/test_test.go:364-463)."""
