@@ -149,13 +149,16 @@ def _check_single(
     history: List[Operation],
     deadline: Optional[float],
     compute_partial: bool = False,
+    stats: Optional[dict] = None,
 ) -> Tuple[CheckResult, List[List[int]]]:
     """DFS over one partition (reference: porcupine/checker.go:179-253).
 
     Returns ``(verdict, partials)``; ``partials`` is non-empty only
     when ``compute_partial`` — the distinct longest linearizable
     prefixes covering each operation (recorded at every backtrack), or
-    the single full linearization on OK."""
+    the single full linearization on OK.  ``stats`` (optional dict)
+    receives ``{"steps": N}`` — the speed-ratio diagnostics compare it
+    against the native DFS's step counter."""
     if not history:
         return CheckResult.OK, ([[]] if compute_partial else [])
     head = _make_entries(history)
@@ -211,6 +214,8 @@ def _check_single(
             linearized &= ~(1 << top.op_id)
             _unlift(top)
             entry = top.next
+    if stats is not None:
+        stats["steps"] = steps
     if verdict is None:
         verdict = CheckResult.OK
     if (
@@ -242,6 +247,99 @@ def _check_single(
     return verdict, partials
 
 
+# -- model-generic native DFS (reference contract: model.go:5-49) ----------
+
+def _native_generic(
+    model: Model,
+    part: List[Operation],
+    deadline: Optional[float],
+    compute_partial: bool,
+) -> Optional[Tuple[CheckResult, List[List[int]]]]:
+    """Run one partition through the model-GENERIC C++ DFS: the search
+    (entry list, lift/unlift, set×state memo) runs compiled; the
+    model's own ``step`` is consulted through a callback once per
+    DISTINCT (state, op) pair — the C++ side memoizes transitions over
+    integer state ids, so an exponential DFS pays Python cost only
+    linear in the reachable transition graph.  Returns None (caller
+    falls back to the Python DFS) when the toolchain is unavailable,
+    the history is malformed (the Python entry builder raises the
+    proper error), or the model callback itself raised.
+    """
+    from .native import (
+        check_generic_partition_native,
+        check_generic_partition_native_verbose,
+    )
+
+    if not part:
+        return None  # _check_single owns the empty-history convention
+    if any(op.ret < op.call for op in part):
+        return None
+    if deadline is None:
+        max_steps, max_wall = 0, 0.0
+    else:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            return CheckResult.UNKNOWN, []
+        max_steps, max_wall = 0, remaining  # wall clock is the budget
+    events: List[Tuple[float, int, int]] = []
+    for i, op in enumerate(part):
+        events.append((op.call, 0, i))
+        events.append((op.ret, 1, i))
+    events.sort(key=lambda e: (e[0], e[1]))
+    ev = [(i, bool(kind)) for _, kind, i in events]
+
+    # Caller-owned automaton state ids: 0 is the initial state; new
+    # states are interned by their ``key_of`` (the same hashability
+    # contract the Python DFS's memo already imposes).
+    states: List[Any] = [model.init()]
+    ids: dict = {model.key_of(states[0]): 0}
+    errors: List[BaseException] = []
+
+    def step_cb(sid: int, op_id: int, out_ptr) -> int:
+        try:
+            op = part[op_id]
+            ok, new_state = model.step(states[sid], op.input, op.output)
+            if not ok:
+                return 0
+            key = model.key_of(new_state)
+            nid = ids.get(key)
+            if nid is None:
+                nid = len(states)
+                if nid > 0x7FFFFFFF:  # pragma: no cover - absurd history
+                    raise OverflowError("state id space exhausted")
+                states.append(new_state)
+                ids[key] = nid
+            out_ptr[0] = nid
+            return 1
+        except BaseException as e:  # must not unwind through C
+            errors.append(e)
+            return -1
+
+    if compute_partial:
+        out = check_generic_partition_native_verbose(
+            ev, len(part), step_cb, max_steps=max_steps, max_wall_s=max_wall
+        )
+        if out is None:
+            return None
+        rc, partials, _steps = out
+    else:
+        out = check_generic_partition_native(
+            ev, len(part), step_cb, max_steps=max_steps, max_wall_s=max_wall
+        )
+        if out is None:
+            return None
+        rc, _steps = out
+        partials = []
+    if errors and not isinstance(errors[0], Exception):
+        raise errors[0]  # KeyboardInterrupt/SystemExit must propagate
+    if rc == 3 or errors:
+        return None  # re-run in Python so the model's exception surfaces
+    return (
+        {0: CheckResult.ILLEGAL, 1: CheckResult.OK, 2: CheckResult.UNKNOWN}[rc],
+        partials,
+    )
+
+
 # -- parallel partition checking (reference: checker.go:274-353) -----------
 
 
@@ -258,6 +356,12 @@ def _worker(
             res, partials = out
     elif model.native_check is not None and not compute_partial:
         res = model.native_check(part, deadline)
+    if res is None and model.native_generic and (
+        model.native_check is None or compute_partial
+    ):
+        out = _native_generic(model, part, deadline, compute_partial)
+        if out is not None:
+            res, partials = out
     if res is None:
         res, partials = _check_single(model, part, deadline, compute_partial)
     return idx, res, partials

@@ -1,0 +1,133 @@
+"""Shard-controller specification model.
+
+Linearizability spec for shardctrler histories — a second NON-KV model
+(reference contract: porcupine/model.go:5-49; the reference ships only
+the KV instance, models/kv.go, and never linearizability-checks its
+controller — this model closes that gap the same way the service
+itself exceeds the reference's empty shardkv skeleton).
+
+The automaton state is the full CONFIG SEQUENCE (the controller is an
+append-only log of configs: Query(num) reads history, so the state
+cannot be just the latest config).  Join/Leave/Move append a new
+config derived with the SAME pure :func:`..services.shardctrler.
+rebalance` the replicated service applies — the spec and the
+implementation share one rebalancing core, so they cannot drift.
+
+States are tuples-of-tuples (hashable but large); the model rides the
+model-generic compiled DFS (:mod:`.checker`), which interns each
+distinct state to an int id once — exactly the shape where the
+compiled search pays off over the Python DFS re-hashing the whole
+config history every step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+from ..services.shardctrler import NSHARDS, Config, rebalance
+from .model import Model, Operation
+
+__all__ = [
+    "CtrlerOpInput",
+    "CtrlerOpOutput",
+    "ctrler_model",
+    "ctrler_model_py",
+    "freeze_config",
+    "CTRL_QUERY",
+    "CTRL_JOIN",
+    "CTRL_LEAVE",
+    "CTRL_MOVE",
+]
+
+CTRL_QUERY = "query"
+CTRL_JOIN = "join"
+CTRL_LEAVE = "leave"
+CTRL_MOVE = "move"
+
+# A frozen config: (num, shards tuple, ((gid, (server, ...)), ...)
+# sorted by gid).  Hashable, order-canonical.
+FrozenConfig = Tuple[int, Tuple[int, ...], Tuple[Tuple[int, Tuple[str, ...]], ...]]
+
+
+def freeze_config(cfg: Config) -> FrozenConfig:
+    return (
+        cfg.num,
+        tuple(cfg.shards),
+        tuple(sorted((g, tuple(s)) for g, s in cfg.groups.items())),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlerOpInput:
+    op: str = CTRL_QUERY
+    # join: ((gid, (server, ...)), ...); leave: (gid, ...)
+    servers: Tuple[Tuple[int, Tuple[str, ...]], ...] = ()
+    gids: Tuple[int, ...] = ()
+    shard: int = 0
+    gid: int = 0
+    num: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlerOpOutput:
+    config: FrozenConfig = (0, (0,) * NSHARDS, ())
+
+
+def _init() -> Tuple[FrozenConfig, ...]:
+    # Config 0: every shard owned by gid 0 (reference:
+    # shardctrler/common.go — the zeroth config).
+    return ((0, (0,) * NSHARDS, ()),)
+
+
+def _next_config(
+    state: Tuple[FrozenConfig, ...], inp: CtrlerOpInput
+) -> FrozenConfig:
+    num, shards, groups_t = state[-1]
+    groups = {g: list(s) for g, s in groups_t}
+    shards = list(shards)
+    if inp.op == CTRL_JOIN:
+        for g, srvs in inp.servers:
+            groups[g] = list(srvs)
+        shards = rebalance(shards, groups)
+    elif inp.op == CTRL_LEAVE:
+        for g in inp.gids:
+            groups.pop(g, None)
+        shards = rebalance(shards, groups)
+    else:  # move: explicit assignment, no rebalance
+        shards[inp.shard] = inp.gid
+    return (
+        num + 1,
+        tuple(shards),
+        tuple(sorted((g, tuple(s)) for g, s in groups.items())),
+    )
+
+
+def _step(state, inp: CtrlerOpInput, out: CtrlerOpOutput):
+    """(mirrors the service apply path, services/shardctrler.py;
+    reference: shardctrler/server.go:124-162)"""
+    if inp.op == CTRL_QUERY:
+        n = inp.num
+        want = state[n] if 0 <= n < len(state) else state[-1]
+        return out.config == want, state
+    return True, state + (_next_config(state, inp),)
+
+
+def _describe(inp: CtrlerOpInput, out: CtrlerOpOutput) -> str:
+    if inp.op == CTRL_QUERY:
+        return f"query({inp.num}) -> cfg#{out.config[0]}"
+    if inp.op == CTRL_JOIN:
+        return f"join({[g for g, _ in inp.servers]})"
+    if inp.op == CTRL_LEAVE:
+        return f"leave({list(inp.gids)})"
+    return f"move(shard {inp.shard} -> gid {inp.gid})"
+
+
+ctrler_model = Model(
+    init=_init,
+    step=_step,
+    describe_operation=_describe,
+)
+
+# Pure-Python oracle for differential tests of the generic native DFS.
+ctrler_model_py = dataclasses.replace(ctrler_model, native_generic=False)

@@ -189,6 +189,9 @@ kv_model = Model(
 
 # Pure-Python variant (oracle for differential tests of the native DFS);
 # derived from kv_model so the two can never drift apart.
+# native_generic is off too: the oracle must be the Python DFS itself,
+# not the generic compiled path.
 kv_model_py = dataclasses.replace(
-    kv_model, native_check=None, native_check_verbose=None
+    kv_model, native_check=None, native_check_verbose=None,
+    native_generic=False,
 )

@@ -64,6 +64,14 @@ class Model:
     native_check_verbose: Optional[
         Callable[[List[Operation], Optional[float]], Any]
     ] = None
+    # Whether the model-GENERIC compiled DFS may be used when no
+    # specialized hook applies (reference: the Go checker is generic
+    # over any Model, porcupine/model.go:5-49 + checker.go:179-253).
+    # The generic path runs the search compiled and consults ``step``
+    # through a callback once per distinct (state, op) pair; semantics
+    # are identical to the Python DFS.  Off = always use the Python
+    # DFS (differential-test oracles set this).
+    native_generic: bool = True
 
     def partitions(self, history: List[Operation]) -> List[List[Operation]]:
         if self.partition is None:

@@ -39,6 +39,23 @@ _COMMON_ARGS = [
 ]
 
 
+# Transition callback for the model-generic DFS:
+# (state_id, op_id, *new_state_id) -> 1 legal / 0 illegal / <0 error.
+STEP_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int32),
+)
+
+_GENERIC_ARGS = [
+    ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_uint8),
+    STEP_CB,
+    ctypes.c_int64,   # max_steps (0 = unlimited)
+    ctypes.c_double,  # max_wall_s (0 = unlimited)
+]
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     if _lib is not None:
@@ -52,6 +69,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.check_kv_partition_verbose.restype = ctypes.c_int
         lib.check_kv_partition_verbose.argtypes = list(_COMMON_ARGS) + [
             ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.check_generic_partition.restype = ctypes.c_int
+        lib.check_generic_partition.argtypes = list(_GENERIC_ARGS) + [
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.check_generic_partition_verbose.restype = ctypes.c_int
+        lib.check_generic_partition_verbose.argtypes = list(_GENERIC_ARGS) + [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.mrt_buf_free.restype = None
@@ -119,6 +146,67 @@ def check_kv_partition_native(
     return lib.check_kv_partition(*args, max_steps, max_wall_s)
 
 
+def _parse_partials(lib, buf, buf_len) -> List[List[int]]:
+    partials: List[List[int]] = []
+    if buf and buf_len.value > 0:
+        try:
+            flat = buf[: buf_len.value]
+            n_seqs = flat[0]
+            w = 1
+            for _ in range(n_seqs):
+                ln = flat[w]
+                w += 1
+                partials.append(list(flat[w: w + ln]))
+                w += ln
+        finally:
+            lib.mrt_buf_free(buf)
+    return partials
+
+
+def check_generic_partition_native(
+    events, n, step_cb, max_steps=0, max_wall_s=0.0,
+) -> Optional[Tuple[int, int]]:
+    """Run the model-generic C++ DFS on one pre-sorted partition.
+
+    ``step_cb(state_id, op_id, new_state_id_ptr)`` resolves transitions
+    (fired once per distinct pair — the C++ side memoizes).  Returns
+    ``(rc, steps_done)`` with rc 1 OK / 0 ILLEGAL / 2 budget /
+    3 callback error, or None when the native path is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    ev_op = (ctypes.c_int32 * len(events))(*[e[0] for e in events])
+    ev_ret = (ctypes.c_uint8 * len(events))(*[1 if e[1] else 0 for e in events])
+    cb = STEP_CB(step_cb)
+    steps = ctypes.c_int64(0)
+    rc = lib.check_generic_partition(
+        n, ev_op, ev_ret, cb, max_steps, max_wall_s, ctypes.byref(steps)
+    )
+    return rc, steps.value
+
+
+def check_generic_partition_native_verbose(
+    events, n, step_cb, max_steps=0, max_wall_s=0.0,
+) -> Optional[Tuple[int, List[List[int]], int]]:
+    """Verbose generic DFS: ``(rc, partials, steps_done)`` — same
+    computePartial evidence as the KV fast path.  None = unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    ev_op = (ctypes.c_int32 * len(events))(*[e[0] for e in events])
+    ev_ret = (ctypes.c_uint8 * len(events))(*[1 if e[1] else 0 for e in events])
+    cb = STEP_CB(step_cb)
+    steps = ctypes.c_int64(0)
+    buf = ctypes.POINTER(ctypes.c_int32)()
+    buf_len = ctypes.c_int64(0)
+    rc = lib.check_generic_partition_verbose(
+        n, ev_op, ev_ret, cb, max_steps, max_wall_s,
+        ctypes.byref(buf), ctypes.byref(buf_len), ctypes.byref(steps),
+    )
+    return rc, _parse_partials(lib, buf, buf_len), steps.value
+
+
 def check_kv_partition_native_verbose(
     events, op_kinds, op_values, op_outputs, max_steps=0, max_wall_s=0.0
 ) -> Optional[Tuple[int, List[List[int]]]]:
@@ -135,17 +223,4 @@ def check_kv_partition_native_verbose(
     rc = lib.check_kv_partition_verbose(
         *args, max_steps, max_wall_s, ctypes.byref(buf), ctypes.byref(buf_len)
     )
-    partials: List[List[int]] = []
-    if buf and buf_len.value > 0:
-        try:
-            flat = buf[: buf_len.value]
-            n_seqs = flat[0]
-            w = 1
-            for _ in range(n_seqs):
-                ln = flat[w]
-                w += 1
-                partials.append(list(flat[w: w + ln]))
-                w += ln
-        finally:
-            lib.mrt_buf_free(buf)
-    return rc, partials
+    return rc, _parse_partials(lib, buf, buf_len)

@@ -37,6 +37,7 @@
 #include <cstring>
 #include <ctime>
 #include <string>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -245,6 +246,73 @@ inline double mono_s() {
   return ts.tv_sec + ts.tv_nsec * 1e-9;
 }
 
+// computePartial capture (reference: porcupine/checker.go:219-234):
+// record the current DFS stack as the longest linearizable prefix for
+// every op on it that lacks a longer one, sharing one materialized
+// sequence per call (the reference's lazy-seq trick).  Works over both
+// checkers' Frame types (each has `call`); used at every backtrack and
+// for the live stack when a budget expires mid-descent.
+template <typename Stack>
+void capture_partials(const Stack& stack, std::vector<int32_t>& longest,
+                      std::vector<std::vector<int32_t>>& seqs) {
+  int32_t seq_idx = -1;
+  const size_t depth = stack.size();
+  for (const auto& f : stack) {
+    const int op = f.call->op;
+    if (longest[op] < 0 || seqs[longest[op]].size() < depth) {
+      if (seq_idx < 0) {
+        std::vector<int32_t> s;
+        s.reserve(depth);
+        for (const auto& g : stack) s.push_back(g.call->op);
+        seqs.push_back(std::move(s));
+        seq_idx = static_cast<int32_t>(seqs.size()) - 1;
+      }
+      longest[op] = seq_idx;
+    }
+  }
+}
+
+// Flatten the partial linearizations into the caller-freed int32 buffer
+// [n_seqs, len_0, ops_0..., len_1, ops_1...].  On OK the single full
+// linearization (the final stack) is emitted; otherwise the identity-
+// distinct longest prefixes in FIRST-REFERENCING-OP order — exactly the
+// Python oracle's insertion-ordered dedup, so native and fallback
+// produce identical evidence.
+template <typename Stack>
+void emit_partials(int verdict, const Stack& stack, int32_t n,
+                   const std::vector<int32_t>& longest,
+                   const std::vector<std::vector<int32_t>>& seqs,
+                   int32_t** out_buf, int64_t* out_len) {
+  std::vector<int32_t> full;
+  std::vector<const std::vector<int32_t>*> outs;
+  if (verdict == 1) {
+    for (const auto& f : stack) full.push_back(f.call->op);
+    outs.push_back(&full);
+  } else {
+    std::vector<char> emitted(seqs.size(), 0);
+    for (int32_t i = 0; i < n; i++) {
+      const int32_t s = longest[i];
+      if (s >= 0 && !emitted[s]) {
+        emitted[s] = 1;
+        outs.push_back(&seqs[s]);
+      }
+    }
+  }
+  int64_t total = 1;
+  for (const auto* s : outs) total += 1 + static_cast<int64_t>(s->size());
+  int32_t* buf =
+      static_cast<int32_t*>(std::malloc(total * sizeof(int32_t)));
+  if (buf == nullptr) return;  // partials dropped, verdict kept
+  int64_t w = 0;
+  buf[w++] = static_cast<int32_t>(outs.size());
+  for (const auto* s : outs) {
+    buf[w++] = static_cast<int32_t>(s->size());
+    for (int32_t v : *s) buf[w++] = v;
+  }
+  *out_buf = buf;
+  *out_len = w;
+}
+
 }  // namespace
 
 extern "C" {
@@ -336,24 +404,7 @@ static int check_impl(
         verdict = 0;
         break;
       }
-      if (compute_partial) {
-        int32_t seq_idx = -1;
-        const size_t depth = c.stack.size();
-        for (const auto& f : c.stack) {
-          const int op = f.call->op;
-          if (longest[op] < 0 ||
-              seqs[longest[op]].size() < depth) {
-            if (seq_idx < 0) {
-              std::vector<int32_t> s;
-              s.reserve(depth);
-              for (const auto& g : c.stack) s.push_back(g.call->op);
-              seqs.push_back(std::move(s));
-              seq_idx = static_cast<int32_t>(seqs.size()) - 1;
-            }
-            longest[op] = seq_idx;
-          }
-        }
-      }
+      if (compute_partial) capture_partials(c.stack, longest, seqs);
       entry = c.backtrack();
     }
   }
@@ -364,60 +415,211 @@ static int check_impl(
     // linearizable prefix no backtrack recorded yet — capture it so
     // the evidence is never empty for exactly the runs verbose mode
     // exists to debug.
-    int32_t seq_idx = -1;
-    const size_t depth = c.stack.size();
-    for (const auto& f : c.stack) {
-      const int op = f.call->op;
-      if (longest[op] < 0 || seqs[longest[op]].size() < depth) {
-        if (seq_idx < 0) {
-          std::vector<int32_t> s;
-          s.reserve(depth);
-          for (const auto& g : c.stack) s.push_back(g.call->op);
-          seqs.push_back(std::move(s));
-          seq_idx = static_cast<int32_t>(seqs.size()) - 1;
-        }
-        longest[op] = seq_idx;
-      }
-    }
+    capture_partials(c.stack, longest, seqs);
   }
 
   if (compute_partial && out_buf) {
-    std::vector<int32_t> full;
-    std::vector<const std::vector<int32_t>*> outs;
-    if (verdict == 1) {
-      // Full linearization from the final stack.
-      for (const auto& f : c.stack) full.push_back(f.call->op);
-      outs.push_back(&full);
-    } else {
-      // Identity-distinct longest prefixes, emitted in
-      // FIRST-REFERENCING-OP order — exactly the Python oracle's
-      // dedup (`for seq in longest: uniq[id(seq)] = seq`, insertion-
-      // ordered), so native and fallback produce identical evidence.
-      std::vector<char> emitted(seqs.size(), 0);
-      for (int32_t i = 0; i < n; i++) {
-        const int32_t s = longest[i];
-        if (s >= 0 && !emitted[s]) {
-          emitted[s] = 1;
-          outs.push_back(&seqs[s]);
-        }
-      }
-    }
-    int64_t total = 1;
-    for (const auto* s : outs) total += 1 + static_cast<int64_t>(s->size());
-    int32_t* buf =
-        static_cast<int32_t*>(std::malloc(total * sizeof(int32_t)));
-    if (buf == nullptr) return verdict;  // partials dropped, verdict kept
-    int64_t w = 0;
-    buf[w++] = static_cast<int32_t>(outs.size());
-    for (const auto* s : outs) {
-      buf[w++] = static_cast<int32_t>(s->size());
-      for (int32_t v : *s) buf[w++] = v;
-    }
-    *out_buf = buf;
-    *out_len = w;
+    emit_partials(verdict, c.stack, n, longest, seqs, out_buf, out_len);
   }
   return verdict;
 }
+
+// ---------------------------------------------------------------------------
+// Model-GENERIC DFS (reference contract: porcupine/model.go:5-49 — any
+// Model, not just KV).  The automaton state is an opaque int32 id
+// owned by the caller; transitions are resolved through a callback
+// (Python model.step) but MEMOIZED in an in-C++ table, so the
+// callback fires once per distinct (state, op) pair and the
+// exponential DFS — revisits, lift/unlift, set-memo pruning — runs
+// entirely compiled.  This is what keeps a pure-Python model at
+// compiled speed: the search is native, the semantics stay Python.
+//
+// step_cb(state_id, op_id, &new_state_id) -> 1 legal / 0 illegal /
+// negative = caller error (aborts the DFS with rc=3; the Python shim
+// falls back to the pure DFS, which raises the real exception).
+
+typedef int (*mrt_step_cb)(int32_t state_id, int32_t op_id,
+                           int32_t* new_state_id);
+
+namespace {
+
+struct GenericChecker {
+  int32_t n;
+  mrt_step_cb step_cb;
+
+  std::vector<Entry> pool;
+  Entry* head;
+  int32_t state = 0;  // id of the automaton state (0 = initial)
+  uint64_t zob = 0, zob2 = 0;
+  std::vector<uint64_t> zkeys, zkeys2;
+
+  struct Frame {
+    Entry* call;
+    int32_t old_state;
+  };
+  std::vector<Frame> stack;
+  std::unordered_set<Key128, Key128Hash> memo;
+  // (state_id << 32 | op) -> (ok << 32 | new_state_id).  Exact — ids,
+  // not hashes — so the callback result is never conflated.
+  std::unordered_map<uint64_t, uint64_t> trans;
+
+  void build(const int32_t* ev_op, const uint8_t* ev_is_ret) {
+    const int64_t n_events = 2 * static_cast<int64_t>(n);
+    pool.resize(n_events + 1);
+    std::vector<Entry*> call_of(n, nullptr);
+    head = &pool[0];
+    head->op = -1;
+    head->is_return = false;
+    head->prev = nullptr;
+    Entry* tail = head;
+    for (int64_t i = 0; i < n_events; i++) {
+      Entry* e = &pool[i + 1];
+      e->op = ev_op[i];
+      e->is_return = ev_is_ret[i] != 0;
+      e->match = nullptr;
+      if (!e->is_return) {
+        call_of[e->op] = e;
+      } else {
+        call_of[e->op]->match = e;
+      }
+      tail->next = e;
+      e->prev = tail;
+      tail = e;
+    }
+    tail->next = nullptr;
+    zkeys.resize(n);
+    zkeys2.resize(n);
+    for (int32_t i = 0; i < n; i++) {
+      zkeys[i] = splitmix64(0xC0FFEE ^ i);
+      zkeys2[i] = splitmix64(0xB00B1E5ull + 0x9E37ull * i);
+    }
+    stack.reserve(n);
+  }
+
+  // 1 legal (fills next), 0 illegal, -1 callback error.
+  int step_ok(int op, int32_t& next) {
+    const uint64_t tkey =
+        (static_cast<uint64_t>(static_cast<uint32_t>(state)) << 32) |
+        static_cast<uint32_t>(op);
+    auto it = trans.find(tkey);
+    if (it != trans.end()) {
+      if (!(it->second >> 32)) return 0;
+      next = static_cast<int32_t>(it->second & 0xffffffffull);
+      return 1;
+    }
+    int32_t out = 0;
+    const int rc = step_cb(state, op, &out);
+    if (rc < 0) return -1;
+    trans.emplace(tkey, (static_cast<uint64_t>(rc != 0) << 32) |
+                            static_cast<uint32_t>(out));
+    if (!rc) return 0;
+    next = out;
+    return 1;
+  }
+
+  Key128 memo_key(uint64_t nzob, uint64_t nzob2, int32_t nstate) const {
+    const uint64_t s1 = splitmix64(0x5EED0001ull + nstate);
+    const uint64_t s2 = splitmix64(0x5EED0002ull * 0x9E3779B9ull + nstate);
+    return Key128{splitmix64(nzob ^ s1), splitmix64(nzob2 ^ s2)};
+  }
+};
+
+int check_generic_impl(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    mrt_step_cb step_cb,
+    int64_t max_steps,
+    double max_wall_s,
+    bool compute_partial,
+    int32_t** out_buf,
+    int64_t* out_len,
+    int64_t* steps_done) {
+  if (out_buf) {
+    *out_buf = nullptr;
+    *out_len = 0;
+  }
+  if (steps_done) *steps_done = 0;
+  if (n == 0) return 1;
+
+  GenericChecker c;
+  c.n = n;
+  c.step_cb = step_cb;
+  c.build(ev_op, ev_is_ret);
+
+  std::vector<int32_t> longest;
+  std::vector<std::vector<int32_t>> seqs;
+  if (compute_partial) longest.assign(n, -1);
+
+  const double wall_deadline =
+      max_wall_s > 0 ? mono_s() + max_wall_s : 0.0;
+  Entry* entry = c.head->next;
+  int64_t steps = 0;
+  int verdict = -1;
+  while (c.head->next != nullptr) {
+    ++steps;
+    if (max_steps > 0 && steps > max_steps) {
+      verdict = 2;
+      break;
+    }
+    if (wall_deadline > 0 && (steps & 8191) == 0 &&
+        mono_s() > wall_deadline) {
+      verdict = 2;
+      break;
+    }
+    if (!entry->is_return) {
+      int32_t nstate = 0;
+      bool advanced = false;
+      const int ok = c.step_ok(entry->op, nstate);
+      if (ok < 0) {
+        verdict = 3;  // callback error
+        break;
+      }
+      if (ok) {
+        const uint64_t nzob = c.zob ^ c.zkeys[entry->op];
+        const uint64_t nzob2 = c.zob2 ^ c.zkeys2[entry->op];
+        if (c.memo.insert(c.memo_key(nzob, nzob2, nstate)).second) {
+          c.stack.push_back({entry, c.state});
+          c.state = nstate;
+          c.zob = nzob;
+          c.zob2 = nzob2;
+          Checker::lift(entry);
+          entry = c.head->next;
+          advanced = true;
+        }
+      }
+      if (!advanced) entry = entry->next;
+    } else {
+      if (c.stack.empty()) {
+        verdict = 0;
+        break;
+      }
+      if (compute_partial) capture_partials(c.stack, longest, seqs);
+      GenericChecker::Frame& f = c.stack.back();
+      c.state = f.old_state;
+      c.zob ^= c.zkeys[f.call->op];
+      c.zob2 ^= c.zkeys2[f.call->op];
+      Checker::unlift(f.call);
+      entry = f.call->next;
+      c.stack.pop_back();
+    }
+  }
+  if (verdict < 0) verdict = 1;
+  if (steps_done) *steps_done = steps;
+
+  if (compute_partial && verdict == 2 && !c.stack.empty()) {
+    // Budget expired mid-descent: capture the live stack (same
+    // convention as the KV DFS above).
+    capture_partials(c.stack, longest, seqs);
+  }
+
+  if (compute_partial && out_buf && verdict != 3) {
+    emit_partials(verdict, c.stack, n, longest, seqs, out_buf, out_len);
+  }
+  return verdict;
+}
+
+}  // namespace
 
 int check_kv_partition(
     int32_t n,
@@ -451,6 +653,40 @@ int check_kv_partition_verbose(
   return check_impl(n, ev_op, ev_is_ret, op_kind, op_value, op_value_len,
                     op_output, op_output_len, max_steps, max_wall_s,
                     true, out_buf, out_len);
+}
+
+// Model-generic DFS over caller-owned int32 state ids (0 = initial
+// state).  ``step_cb`` resolves transitions (memoized in C++, so it
+// fires once per distinct (state, op) pair).  rc: 1 OK / 0 ILLEGAL /
+// 2 budget exhausted / 3 callback error (caller falls back to its own
+// DFS to surface the real exception).  ``steps_done`` (optional)
+// reports DFS steps executed — the speed-ratio diagnostics use it.
+int check_generic_partition(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    mrt_step_cb step_cb,
+    int64_t max_steps,
+    double max_wall_s,
+    int64_t* steps_done) {
+  return check_generic_impl(n, ev_op, ev_is_ret, step_cb, max_steps,
+                            max_wall_s, false, nullptr, nullptr,
+                            steps_done);
+}
+
+int check_generic_partition_verbose(
+    int32_t n,
+    const int32_t* ev_op,
+    const uint8_t* ev_is_ret,
+    mrt_step_cb step_cb,
+    int64_t max_steps,
+    double max_wall_s,
+    int32_t** out_buf,
+    int64_t* out_len,
+    int64_t* steps_done) {
+  return check_generic_impl(n, ev_op, ev_is_ret, step_cb, max_steps,
+                            max_wall_s, true, out_buf, out_len,
+                            steps_done);
 }
 
 void mrt_buf_free(int32_t* buf) { std::free(buf); }

@@ -1,0 +1,103 @@
+"""Compare-and-swap register specification model.
+
+A NON-KV model exercising the checker's model-generic contract
+(reference: porcupine/model.go:5-49 — the Go checker is generic over
+any Model; the KV model, models/kv.go, is just one instance).  CAS
+semantics cannot be expressed by the KV specialization: whether the
+state changes depends on a comparison against the *observed* output
+(``ok``), so this model rides the model-generic compiled DFS
+(:func:`..checker._native_generic`) rather than the KV-specialized
+C++ fast path.
+
+Partitioned per register name, like the KV model's per-key split
+(reference: models/kv.go:18-34).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import List
+
+from .model import Model, Operation
+
+__all__ = [
+    "RegInput",
+    "RegOutput",
+    "cas_register_model",
+    "cas_register_model_py",
+    "REG_READ",
+    "REG_WRITE",
+    "REG_CAS",
+]
+
+REG_READ = 0
+REG_WRITE = 1
+REG_CAS = 2
+
+_OP_NAMES = {REG_READ: "read", REG_WRITE: "write", REG_CAS: "cas"}
+
+
+@dataclasses.dataclass(frozen=True)
+class RegInput:
+    op: int = REG_READ
+    reg: str = ""
+    # write: ``arg1`` is the new value.
+    # cas:   ``arg1`` is the expected value, ``arg2`` the replacement.
+    arg1: int = 0
+    arg2: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RegOutput:
+    value: int = 0   # read's observed value
+    ok: bool = False  # cas's observed success
+
+
+def _partition(history: List[Operation]) -> List[List[Operation]]:
+    by_reg: dict = defaultdict(list)
+    for op in history:
+        by_reg[op.input.reg].append(op)
+    return list(by_reg.values())
+
+
+def _init() -> int:
+    return 0  # registers start at zero
+
+
+def _step(state: int, inp: RegInput, out: RegOutput):
+    if inp.op == REG_READ:
+        return out.value == state, state
+    if inp.op == REG_WRITE:
+        return True, inp.arg1
+    # CAS: legal iff the observed success bit matches whether the
+    # expected value held; the state advances only on success.
+    succeeded = state == inp.arg1
+    if out.ok != succeeded:
+        return False, state
+    return True, inp.arg2 if succeeded else state
+
+
+def _describe(inp: RegInput, out: RegOutput) -> str:
+    name = _OP_NAMES.get(inp.op, "?")
+    if inp.op == REG_READ:
+        return f"read('{inp.reg}') -> {out.value}"
+    if inp.op == REG_WRITE:
+        return f"write('{inp.reg}', {inp.arg1})"
+    return (
+        f"cas('{inp.reg}', {inp.arg1} -> {inp.arg2}) = "
+        f"{'ok' if out.ok else 'failed'}"
+    )
+
+
+cas_register_model = Model(
+    init=_init,
+    step=_step,
+    partition=_partition,
+    describe_operation=_describe,
+)
+
+# Pure-Python oracle for differential tests of the generic native DFS.
+cas_register_model_py = dataclasses.replace(
+    cas_register_model, native_generic=False
+)

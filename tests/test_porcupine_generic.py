@@ -1,0 +1,328 @@
+"""Model-generic native DFS: differential tests against the Python
+oracle on NON-KV models (CAS register, shard-controller), a live
+concurrent controller run, and the compiled-speed gate.
+
+(reference contract: porcupine/model.go:5-49 — the Go checker is
+generic over any Model; VERDICT r04 #4 asked for the native path to
+cover non-KV models at compiled speed.)
+"""
+
+import random
+import time
+
+import pytest
+
+from multiraft_tpu.porcupine.checker import (
+    CheckResult,
+    _check_single,
+    _native_generic,
+    check_operations,
+    check_operations_verbose,
+)
+from multiraft_tpu.porcupine.ctrler import (
+    CTRL_JOIN,
+    CTRL_LEAVE,
+    CTRL_QUERY,
+    CtrlerOpInput,
+    CtrlerOpOutput,
+    ctrler_model,
+    ctrler_model_py,
+    freeze_config,
+)
+from multiraft_tpu.porcupine.model import Model, Operation
+from multiraft_tpu.porcupine.register import (
+    REG_CAS,
+    REG_READ,
+    REG_WRITE,
+    RegInput,
+    RegOutput,
+    cas_register_model,
+    cas_register_model_py,
+)
+from multiraft_tpu.porcupine.native import native_available
+
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no g++ toolchain for the native DFS"
+)
+
+
+# -- CAS register: semantics sanity ---------------------------------------
+
+
+def test_cas_register_semantics():
+    """A cas that observes success must have matched; state advances
+    only on success."""
+    h = [
+        Operation(0, RegInput(op=REG_WRITE, reg="r", arg1=5), 0, RegOutput(), 1),
+        Operation(1, RegInput(op=REG_CAS, reg="r", arg1=5, arg2=7), 2,
+                  RegOutput(ok=True), 3),
+        Operation(2, RegInput(op=REG_READ, reg="r"), 4, RegOutput(value=7), 5),
+        Operation(3, RegInput(op=REG_CAS, reg="r", arg1=5, arg2=9), 6,
+                  RegOutput(ok=False), 7),
+        Operation(4, RegInput(op=REG_READ, reg="r"), 8, RegOutput(value=7), 9),
+    ]
+    assert check_operations(cas_register_model, h) is CheckResult.OK
+
+    bad = list(h)
+    bad[3] = Operation(3, RegInput(op=REG_CAS, reg="r", arg1=5, arg2=9), 6,
+                       RegOutput(ok=True), 7)
+    assert check_operations(cas_register_model, bad) is CheckResult.ILLEGAL
+
+
+def _random_register_history(rng, n_clients, n_ops, mutate):
+    """Simulate a real linearizable CAS register; optionally corrupt
+    one observation."""
+    t, value, history = 0.0, 0, []
+    for i in range(n_ops):
+        cid = rng.randrange(n_clients)
+        call = t + rng.random() * 0.5
+        ret = call + 0.1 + rng.random()
+        t = call
+        kind = rng.choice([REG_READ, REG_WRITE, REG_CAS])
+        if kind == REG_READ:
+            history.append(Operation(cid, RegInput(op=REG_READ, reg="r"),
+                                     call, RegOutput(value=value), ret))
+        elif kind == REG_WRITE:
+            value = i + 1
+            history.append(Operation(
+                cid, RegInput(op=REG_WRITE, reg="r", arg1=value), call,
+                RegOutput(), ret))
+        else:
+            expect = rng.choice([value, value + 100])
+            ok = expect == value
+            history.append(Operation(
+                cid, RegInput(op=REG_CAS, reg="r", arg1=expect, arg2=i + 1),
+                call, RegOutput(ok=ok), ret))
+            if ok:
+                value = i + 1
+    if mutate and history:
+        k = rng.randrange(len(history))
+        op = history[k]
+        if op.input.op == REG_READ:
+            op.output = RegOutput(value=op.output.value + 1)
+        elif op.input.op == REG_CAS:
+            op.output = RegOutput(ok=not op.output.ok)
+    return history
+
+
+def test_generic_matches_python_on_random_register_histories():
+    rng = random.Random(42)
+    for trial in range(60):
+        hist = _random_register_history(
+            rng, n_clients=4, n_ops=rng.randrange(4, 28),
+            mutate=trial % 2 == 1,
+        )
+        want = check_operations(cas_register_model_py, hist, parallel=False)
+        out = _native_generic(cas_register_model, hist, None, False)
+        assert out is not None, "generic native path unavailable"
+        assert out[0] is want, f"trial {trial}: native {out[0]} != {want}"
+
+
+def test_generic_verbose_partials_match_python():
+    rng = random.Random(7)
+    for trial in range(20):
+        hist = _random_register_history(
+            rng, n_clients=3, n_ops=rng.randrange(4, 16), mutate=True
+        )
+        want, partials_py = _check_single(
+            cas_register_model_py, hist, None, True
+        )
+        out = _native_generic(cas_register_model, hist, None, True)
+        assert out is not None
+        got, partials_nat = out
+        assert got is want
+        assert sorted(partials_nat) == sorted(partials_py), (
+            f"trial {trial}: partial linearizations diverge"
+        )
+
+
+def test_generic_callback_exception_falls_back_and_raises():
+    """A model whose step raises must surface the exception (via the
+    Python fallback), not crash or silently pass."""
+
+    def bad_step(state, inp, out):
+        raise RuntimeError("model bug")
+
+    bad_model = Model(init=lambda: 0, step=bad_step)
+    h = [Operation(0, RegInput(), 0, RegOutput(), 1)]
+    with pytest.raises(RuntimeError, match="model bug"):
+        check_operations(bad_model, h, parallel=False)
+
+
+# -- shard-controller model -----------------------------------------------
+
+
+def _ctrler_history(depth, n_queries, n_joins=2, corrupt=False):
+    """Sequential joins build a deep config history, then a contended
+    window of ``n_joins`` joins concurrent with ``n_queries`` queries
+    observing pre/post states — the DFS must thread the joins between
+    the queries."""
+    from multiraft_tpu.porcupine.ctrler import _init, _step
+
+    ops, t, state = [], 0.0, _init()
+    for i in range(depth):
+        inp = CtrlerOpInput(
+            op=CTRL_JOIN, servers=(((i % 7) + 1, (f"s{i}a", f"s{i}b")),)
+        )
+        _, state = _step(state, inp, CtrlerOpOutput())
+        ops.append(Operation(0, inp, t, CtrlerOpOutput(), t + 0.5))
+        t += 1.0
+    pre = state[-1]
+    win = [
+        CtrlerOpInput(op=CTRL_JOIN, servers=((100 + j, (f"x{j}",)),))
+        for j in range(n_joins)
+    ]
+    st2 = state
+    for inp in win:
+        _, st2 = _step(st2, inp, CtrlerOpOutput())
+    post = st2[-1]
+    if corrupt:
+        post = post[:1] + (tuple(reversed(post[1])),) + post[2:]
+    call, ret = t, t + 50.0
+    for j, inp in enumerate(win):
+        ops.append(
+            Operation(1 + j, inp, call + j * 1e-3, CtrlerOpOutput(), ret)
+        )
+    for q in range(n_queries):
+        obs = pre if q % 2 == 0 else post
+        ops.append(Operation(
+            10 + q, CtrlerOpInput(op=CTRL_QUERY, num=-1),
+            call + 0.01 + q * 1e-3, CtrlerOpOutput(config=obs), ret))
+    return ops
+
+
+def test_generic_matches_python_on_ctrler_histories():
+    for corrupt in (False, True):
+        hist = _ctrler_history(depth=6, n_queries=8, corrupt=corrupt)
+        want = check_operations(ctrler_model_py, hist, parallel=False)
+        out = _native_generic(ctrler_model, hist, None, False)
+        assert out is not None
+        assert out[0] is want
+        assert want is (CheckResult.ILLEGAL if corrupt else CheckResult.OK)
+
+
+def test_live_concurrent_ctrler_run_is_linearizable():
+    """Drive a real 3-server controller with concurrent clerks and
+    porcupine-check the recorded history against the spec model — the
+    check the reference never had for its controller
+    (cf. kvraft/test_test.go:365-381 for its KV form)."""
+    from multiraft_tpu.harness.ctrler_harness import CtrlerHarness
+
+    cfg = CtrlerHarness(3, seed=33)
+    history = []
+
+    def record(cid, inp, out, call, ret):
+        history.append(Operation(cid, inp, call, out, ret))
+
+    def joiner(cid, ck, gid):
+        call = cfg.sched.now
+        yield from ck.join({gid: [f"{gid}-a", f"{gid}-b"]})
+        record(cid, CtrlerOpInput(
+            op=CTRL_JOIN, servers=((gid, (f"{gid}-a", f"{gid}-b")),)),
+            CtrlerOpOutput(), call, cfg.sched.now)
+
+    def leaver(cid, ck, gid):
+        call = cfg.sched.now
+        yield from ck.leave([gid])
+        record(cid, CtrlerOpInput(op=CTRL_LEAVE, gids=(gid,)),
+               CtrlerOpOutput(), call, cfg.sched.now)
+
+    def querier(cid, ck, n):
+        for _ in range(n):
+            call = cfg.sched.now
+            got = yield from ck.query(-1)
+            record(cid, CtrlerOpInput(op=CTRL_QUERY, num=-1),
+                   CtrlerOpOutput(config=freeze_config(got)),
+                   call, cfg.sched.now)
+
+    clerks = [cfg.make_client() for _ in range(6)]
+    futs = [
+        cfg.sched.spawn(joiner(0, clerks[0], 1)),
+        cfg.sched.spawn(joiner(1, clerks[1], 2)),
+        cfg.sched.spawn(querier(2, clerks[2], 3)),
+        cfg.sched.spawn(querier(3, clerks[3], 3)),
+    ]
+    for f in futs:
+        cfg.sched.run_until(f)
+    futs = [
+        cfg.sched.spawn(leaver(0, clerks[0], 1)),
+        cfg.sched.spawn(joiner(1, clerks[1], 3)),
+        cfg.sched.spawn(querier(4, clerks[4], 3)),
+        cfg.sched.spawn(querier(5, clerks[5], 3)),
+    ]
+    for f in futs:
+        cfg.sched.run_until(f)
+    cfg.cleanup()
+
+    assert len(history) >= 14
+    verdict = check_operations(ctrler_model, history, timeout=30.0)
+    assert verdict is not CheckResult.ILLEGAL, (
+        "controller history not linearizable"
+    )
+
+
+def test_generic_native_speed_on_non_kv_model():
+    """The VERDICT r04 #4 gate: a non-KV model rides the compiled DFS
+    at >=100x the Python DFS.  Both engines run the IDENTICAL search
+    (equal step counts, asserted), so the per-step rate ratio is the
+    honest comparison; the Python side is capped by a deadline to keep
+    the test fast."""
+    hist = _ctrler_history(depth=160, n_queries=24)
+
+    # Native: full check (verdict OK), timed.
+    t0 = time.perf_counter()
+    out = _native_generic(ctrler_model, hist, None, False)
+    t_native = time.perf_counter() - t0
+    assert out is not None and out[0] is CheckResult.OK
+
+    # Python oracle on the same search, capped at ~1.2 s of wall.
+    stats = {}
+    t0 = time.perf_counter()
+    res, _ = _check_single(
+        ctrler_model_py, hist, time.monotonic() + 1.2, False, stats
+    )
+    t_py = time.perf_counter() - t0
+    py_steps = stats["steps"]
+
+    # Native step count comes from the library's own counter on a
+    # fresh run (cheap).
+    from multiraft_tpu.porcupine.native import check_generic_partition_native
+    from multiraft_tpu.porcupine.ctrler import _init, _step
+
+    events = []
+    for i, op in enumerate(hist):
+        events.append((op.call, 0, i))
+        events.append((op.ret, 1, i))
+    events.sort(key=lambda e: (e[0], e[1]))
+    ev = [(i, bool(kind)) for _, kind, i in events]
+    states = [_init()]
+    ids = {states[0]: 0}
+
+    def step_cb(sid, op_id, out_ptr):
+        op = hist[op_id]
+        ok, new = _step(states[sid], op.input, op.output)
+        if not ok:
+            return 0
+        nid = ids.get(new)
+        if nid is None:
+            nid = len(states)
+            states.append(new)
+            ids[new] = nid
+        out_ptr[0] = nid
+        return 1
+
+    rc, native_steps = check_generic_partition_native(ev, len(hist), step_cb)
+    assert rc == 1
+
+    rate_native = native_steps / t_native
+    rate_py = py_steps / t_py
+    ratio = rate_native / rate_py
+    # Same search: if Python finished (OK) its step count must equal
+    # the native one; if it hit the deadline it did a prefix.
+    if res is CheckResult.OK:
+        assert py_steps == native_steps
+    assert ratio >= 100.0, (
+        f"generic native DFS only {ratio:.0f}x the Python DFS "
+        f"({rate_native:,.0f} vs {rate_py:,.0f} steps/s)"
+    )
