@@ -51,6 +51,7 @@ __all__ = [
     "concat_records",
     "detect_churn",
     "latency_histogram",
+    "prepare_records",
     "verify_sampled_groups",
 ]
 
@@ -64,13 +65,34 @@ def concat_records(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]
     }
 
 
+def prepare_records(
+    rec: Dict[str, np.ndarray],
+    seed_last: np.ndarray,
+    seed_commit: np.ndarray,
+) -> Dict[str, object]:
+    """One-time i64 conversion + frontier derivation + invariant
+    asserts for a trace.  :func:`latency_histogram` and
+    :func:`verify_sampled_groups` each need this; callers that run
+    both (bench.py) pass the result to BOTH via ``prep=`` so the
+    [N, G] copies and the all-groups asserts happen once."""
+    arrs = _accept_arrays(rec)
+    I, C = _frontiers(rec, seed_last, seed_commit, arrs)
+    return {"arrs": arrs, "I": I, "C": C}
+
+
 def _frontiers(
-    rec: Dict[str, np.ndarray], seed_last: np.ndarray, seed_commit: np.ndarray
+    rec: Dict[str, np.ndarray],
+    seed_last: np.ndarray,
+    seed_commit: np.ndarray,
+    arrs: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """(I, C): per-tick ingest/commit frontier curves [N, G], with the
     pre-window seeds folded in, plus the invariant asserts."""
-    ing_hi = np.asarray(rec["ing_hi"], np.int64)
-    acc = np.asarray(rec["accepted"], np.int64)
+    if arrs is not None:
+        acc, ing_hi, _ = arrs
+    else:
+        ing_hi = np.asarray(rec["ing_hi"], np.int64)
+        acc = np.asarray(rec["accepted"], np.int64)
     C = np.asarray(rec["commit"], np.int64)
     I = np.maximum.accumulate(np.where(acc > 0, ing_hi, 0), axis=0)
     I = np.maximum(I, np.asarray(seed_last, np.int64)[None, :])
@@ -109,18 +131,34 @@ def detect_churn(
     return churn_tick.any(axis=0)
 
 
+def _accept_arrays(
+    rec: Dict[str, np.ndarray],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """ONE-TIME i64 conversion of the accept records.  The per-group
+    helpers slice these; converting inside the per-group path would
+    memcpy the whole [N, G] record once per group — at 100k groups
+    with thousands churned that is terabytes of hidden copying."""
+    return (
+        np.asarray(rec["accepted"], np.int64),
+        np.asarray(rec["ing_hi"], np.int64),
+        np.asarray(rec["accept_term"], np.int64),
+    )
+
+
 def _group_accepts(
-    rec: Dict[str, np.ndarray], g: int
+    arrs: Tuple[np.ndarray, np.ndarray, np.ndarray], g: int
 ) -> List[Tuple[int, int, int, int]]:
     """Group ``g``'s accept events, in tick order:
     ``(tick, start, end, term)`` — indices ``start+1..end`` were bound
     at ``tick`` with ``term``.  A later event overlapping an earlier
     one is a leader rebind (the later binding supersedes unless the
     ring proves the earlier branch won — see the arbitration in
-    :func:`verify_sampled_groups`)."""
-    acc = np.asarray(rec["accepted"], np.int64)[:, g]
-    ing = np.asarray(rec["ing_hi"], np.int64)[:, g]
-    terms = np.asarray(rec["accept_term"], np.int64)[:, g]
+    :func:`verify_sampled_groups`).  ``arrs`` is
+    :func:`_accept_arrays` output."""
+    acc_all, ing_all, term_all = arrs
+    acc = acc_all[:, g]
+    ing = ing_all[:, g]
+    terms = term_all[:, g]
     out = []
     for t in np.nonzero(acc > 0)[0]:
         a = int(acc[t])
@@ -157,7 +195,7 @@ def _bindings_from_accepts(
 
 
 def _churned_group_latencies(
-    rec: Dict[str, np.ndarray],
+    arrs: Tuple[np.ndarray, np.ndarray, np.ndarray],
     seed_commit: np.ndarray,
     g: int,
     C: np.ndarray,
@@ -167,7 +205,7 @@ def _churned_group_latencies(
     won; a superseded binding's entry was truncated and re-accepted).
     Returns (latencies, pre_window_count, rebound_count)."""
     origin = int(seed_commit[g])
-    accepts = _group_accepts(rec, g)
+    accepts = _group_accepts(arrs, g)
     bind_tick, _, _, multi = _bindings_from_accepts(accepts, origin)
     c_final = int(C[-1, g])
     n_committed = min(c_final - origin, len(bind_tick) - 1)
@@ -194,6 +232,7 @@ def latency_histogram(
     seed_last: np.ndarray,
     seed_commit: np.ndarray,
     max_ticks: int = 256,
+    prep: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Exact ingest→commit latency histogram (ticks) for every entry
     both ingested and committed inside the window; entries ingested
@@ -201,8 +240,13 @@ def latency_histogram(
     unknown) and entries still in flight at window end are excluded.
     Calm groups go through the vectorized overlap algebra; churned
     groups (leader rebinds) are measured exactly from their accept
-    bindings — faulted runs lose no coverage."""
-    I, C = _frontiers(rec, seed_last, seed_commit)
+    bindings — faulted runs lose no coverage.  ``prep`` is
+    :func:`prepare_records` output, shared with
+    :func:`verify_sampled_groups` so the [N, G] conversions and the
+    invariant asserts run once per trace."""
+    if prep is None:
+        prep = prepare_records(rec, seed_last, seed_commit)
+    I, C = prep["I"], prep["C"]
     N = I.shape[0]
     seed_last = np.asarray(seed_last, np.int64)
     seed_commit = np.asarray(seed_commit, np.int64)
@@ -232,13 +276,16 @@ def latency_histogram(
         if counted >= target_calm:
             break  # every calm in-window entry accounted — stop early
     rebound_entries = 0
+    churn_hist: Dict[int, int] = {}
+    arrs = prep["arrs"]
     for g in np.nonzero(churned)[0]:
-        lat, pre, reb = _churned_group_latencies(rec, seed_commit, int(g), C)
+        lat, pre, reb = _churned_group_latencies(arrs, seed_commit, int(g), C)
         pre_window += pre
         rebound_entries += reb
         if lat.size:
             for k, n in zip(*np.unique(lat, return_counts=True)):
                 hist[int(k)] = hist.get(int(k), 0) + int(n)
+                churn_hist[int(k)] = churn_hist.get(int(k), 0) + int(n)
                 counted += int(n)
     committed_total = int((C[-1] - seed_commit).sum())
     # Entries the algebra could not place: latency beyond max_ticks
@@ -246,16 +293,11 @@ def latency_histogram(
     # asserted — the bench JSON surfaces it so silent coverage loss is
     # impossible.
     unaccounted = committed_total - pre_window - counted
-    total = max(counted, 1)
-    cum = 0
-    p50 = p99 = max(hist) if hist else 0
-    for k in sorted(hist):
-        cum += hist[k]
-        if cum >= 0.50 * total and p50 == max(hist):
-            p50 = k
-        if cum >= 0.99 * total:
-            p99 = k
-            break
+    p50, p99 = _hist_percentiles(hist)
+    # Churned-group-only (failover) distribution: the global p99 is
+    # diluted by the healthy groups' entries, so the failover tail
+    # gets its own first-class percentiles (VERDICT r04 #7).
+    fo_p50, fo_p99 = _hist_percentiles(churn_hist)
     return {
         "hist_ticks": hist,
         "entries": counted,
@@ -265,7 +307,30 @@ def latency_histogram(
         "rebound_entries": int(rebound_entries),
         "p50_ticks": int(p50),
         "p99_ticks": int(p99),
+        "failover_entries": int(sum(churn_hist.values())),
+        "failover_p50_ticks": int(fo_p50),
+        "failover_p99_ticks": int(fo_p99),
     }
+
+
+def _hist_percentiles(hist: Dict[int, int]) -> Tuple[int, int]:
+    """(p50, p99) of an {latency_ticks: count} histogram; (0, 0) when
+    empty."""
+    total = sum(hist.values())
+    if not total:
+        return 0, 0
+    cum = 0
+    p50 = p99 = max(hist)
+    seen50 = False
+    for k in sorted(hist):
+        cum += hist[k]
+        if not seen50 and cum >= 0.50 * total:
+            p50 = k
+            seen50 = True
+        if cum >= 0.99 * total:
+            p99 = k
+            break
+    return p50, p99
 
 
 def verify_sampled_groups(
@@ -278,6 +343,8 @@ def verify_sampled_groups(
     budget_s: float = 240.0,
     n_multi: int = 8,
     n_clients: int = 4,
+    n_dfs_oracle: int = 8,
+    prep: Optional[Dict[str, object]] = None,
 ) -> Dict[str, object]:
     """Reconstruct each sampled group's operation history from the
     device records, cross-check it against the final device ring, and
@@ -293,14 +360,23 @@ def verify_sampled_groups(
 
     ``budget_s`` bounds the TOTAL checking wall-clock: groups not
     reached in budget report UNKNOWN (the porcupine timeout
-    convention) — an ILLEGAL anywhere still fails the verdict."""
+    convention) — an ILLEGAL anywhere still fails the verdict.
+
+    Each group's verdict comes from the EXACT O(n) unique-order
+    admissibility scan (:func:`_check_unique_order` — vectorized, so
+    128-group sampling costs what 8 used to); the first
+    ``n_dfs_oracle`` groups (superset of the multi-client ones) are
+    ALSO checked by the full native porcupine DFS as an independent
+    oracle, and any disagreement fails loudly."""
     import time as _time
 
     from ..porcupine.model import CheckResult
 
     t_end = _time.monotonic() + budget_s
 
-    I, C = _frontiers(rec, seed_last, seed_commit)
+    if prep is None:
+        prep = prepare_records(rec, seed_last, seed_commit)
+    I, C = prep["I"], prep["C"]
     st = {
         "log_term": np.asarray(final_state.log_term),
         "base": np.asarray(final_state.base),
@@ -318,14 +394,16 @@ def verify_sampled_groups(
     ring_checked = 0
     multi_groups = 0
     max_concurrency = 0
+    dfs_checked = 0
     results = []
+    arrs = prep["arrs"]
     for j, g in enumerate(sample):
         if _time.monotonic() >= t_end:
             unknown += 1
             results.append((g, "budget-unknown"))
             continue
         origin = int(seed_commit[g])
-        accepts = _group_accepts(rec, g)
+        accepts = _group_accepts(arrs, g)
         bind_tick, bind_term, first_tick, multi = _bindings_from_accepts(
             accepts, origin
         )
@@ -370,26 +448,22 @@ def verify_sampled_groups(
         # partial-history convention.
         commit_final = int(C[-1, g])
         n_comm = min(commit_final - origin, len(bind_tick) - 1)
-        offs = [o for o in range(1, n_comm + 1) if bind_tick[o] >= 0]
-        idxs = [origin + o for o in offs]
+        offs = np.nonzero(bind_tick[1: max(n_comm, 0) + 1] >= 0)[0] + 1
+        idxs = origin + offs
         # Ambiguous: multi-bound, not ring-arbitrable (compacted away)
         # — widen the call interval to the EARLIEST binding (a larger
         # window admits strictly more linearizations: conservative).
-        call_ticks = []
-        for o in offs:
-            idx = origin + o
-            if (
-                multi[o]
-                and not (base < idx <= ring_hi)
-                and chosen_tick[o] == bind_tick[o]
-            ):
-                call_ticks.append(int(first_tick[o]))
-                ambiguous += 1
-            else:
-                call_ticks.append(int(chosen_tick[o]))
-        t_cs = np.searchsorted(C[:, g], np.asarray(idxs, np.int64), "left")
-        calls = np.asarray(call_ticks, np.float64)
-        rets = np.asarray(t_cs, np.float64) + 0.5
+        amb = (
+            multi[offs]
+            & ~((base < idxs) & (idxs <= ring_hi))
+            & (chosen_tick[offs] == bind_tick[offs])
+        )
+        ambiguous += int(amb.sum())
+        t_cs = np.searchsorted(C[:, g], idxs, "left")
+        calls = np.where(amb, first_tick[offs], chosen_tick[offs]).astype(
+            np.float64
+        )
+        rets = t_cs.astype(np.float64) + 0.5
 
         # Multi-client reconstruction: round-robin entries over logical
         # clients; per-client sequentiality is enforced by flooring each
@@ -400,16 +474,39 @@ def verify_sampled_groups(
         # the same tick share a return time, so consecutive SAME-client
         # ops must land in different batches for the floor to stay
         # below the op's own return.  Different clients within a batch
-        # still fully overlap — the DFS arbitrates their order.
+        # still fully overlap — the checker arbitrates their order.
         if j < n_multi and len(t_cs):
             _, batch_sizes = np.unique(t_cs, return_counts=True)
             k_eff = max(n_clients, int(batch_sizes.max()) + 1)
             if len(idxs) > k_eff:
                 multi_groups += 1
-                for i in range(k_eff, len(idxs)):
-                    calls[i] = max(calls[i], rets[i - k_eff] + 0.25)
-        remaining = max(t_end - _time.monotonic(), 1.0)
-        verdict, conc = _check_group_history(idxs, calls, rets, g, N, remaining)
+                calls[k_eff:] = np.maximum(
+                    calls[k_eff:], rets[:-k_eff] + 0.25
+                )
+        # Exact O(n) decision (see _check_unique_order: the appended
+        # tokens are distinct, so the valid linearization order is
+        # UNIQUE and linearizability reduces to a vectorized real-time
+        # admissibility scan — same verdict the DFS would return).
+        verdict, conc = _check_unique_order(calls, rets)
+        # Independent oracle: the first ``n_dfs_oracle`` groups (which
+        # include the multi-client reconstructions) ALSO run the full
+        # native porcupine DFS; any disagreement is a rig bug and
+        # fails loudly.  Failures always get the DFS pass too, so an
+        # ILLEGAL verdict carries DFS-confirmed evidence.
+        if j < n_dfs_oracle or verdict is not CheckResult.OK:
+            remaining = max(t_end - _time.monotonic(), 1.0)
+            dfs_verdict, conc = _check_group_history(
+                [int(i) for i in idxs], calls, rets, g, N, remaining
+            )
+            dfs_checked += 1
+            assert (
+                dfs_verdict is CheckResult.UNKNOWN
+                or dfs_verdict is verdict
+            ), (
+                f"group {g}: fast admissibility check says {verdict} "
+                f"but the porcupine DFS says {dfs_verdict} — "
+                "verification rig bug"
+            )
         max_concurrency = max(max_concurrency, conc)
         results.append((g, verdict.name))
         if verdict == CheckResult.ILLEGAL:
@@ -435,7 +532,51 @@ def verify_sampled_groups(
         "multi_client_groups": multi_groups,
         "multi_client_clients": n_clients,
         "max_concurrency": max_concurrency,
+        "dfs_oracle_groups": dfs_checked,
     }
+
+
+def _check_unique_order(
+    calls: np.ndarray, rets: np.ndarray
+) -> Tuple["CheckResult", int]:
+    """Exact linearizability decision for the bench's reconstructed
+    histories, O(n) vectorized.
+
+    The reconstruction appends DISTINCT tokens (one per log index) and
+    closes with a single read of the final value.  Distinct tokens
+    mean the final value pins a UNIQUE admissible append order — the
+    index order — and the read must follow every append (its observed
+    value contains all of them).  A history is therefore linearizable
+    iff that one order respects real-time precedence: no op may
+    precede (in index order) an op that finished strictly before it
+    was called.  Violation test: exists i<j with rets[j] < calls[i]
+    — strict, because the entry-order tie-break (calls sort before
+    returns at equal times, checker._make_entries) makes touching
+    intervals concurrent.  Equivalent to the porcupine DFS verdict on
+    the same constructed history (the DFS search over orders collapses
+    to this single candidate); ``verify_sampled_groups`` cross-checks
+    the equivalence against the real DFS on an oracle subsample every
+    run.
+
+    Returns ``(verdict, max_concurrency)`` — concurrency measured the
+    same way the DFS path measures it (peak in-flight ops)."""
+    from ..porcupine.model import CheckResult
+
+    n = len(calls)
+    if n == 0:
+        return CheckResult.OK, 0
+    prefix_max_call = np.maximum.accumulate(calls)
+    viol = bool((rets[1:] < prefix_max_call[:-1]).any())
+    times = np.concatenate([calls, rets])
+    kinds = np.concatenate(
+        [np.zeros(n, np.int8), np.ones(n, np.int8)]
+    )
+    order = np.lexsort((kinds, times))  # calls first at equal times
+    depth = np.cumsum(np.where(kinds[order] == 0, 1, -1))
+    conc = int(depth.max(initial=0))
+    return (
+        CheckResult.ILLEGAL if viol else CheckResult.OK
+    ), conc
 
 
 def _check_group_history(idxs, calls, rets, g, N, timeout_s):
@@ -464,24 +605,22 @@ def _check_group_history(idxs, calls, rets, g, N, timeout_s):
     pieces = [f"[{i}]" for i in idxs]
     value = "".join(pieces)
     # Sort (time, kind, op) events; kind 0 (call) before kind 1
-    # (return) at equal times.  Calls/rets are each monotone in op
-    # index (commit ticks are monotone; flooring preserves it), so a
-    # two-stream merge beats a full sort.
-    events = []
-    a = b = 0
-    while a < n or b < n:
-        if a < n and (b >= n or calls[a] <= rets[b]):
-            events.append((a, False))
-            a += 1
-        else:
-            events.append((b, True))
-            b += 1
+    # (return) at equal times.  A real sort, NOT a two-stream merge:
+    # churned reconstructions can have NON-monotone call ticks (a
+    # ring-arbitrated or ambiguity-widened index can carry an earlier
+    # binding than its predecessor), and a merge that assumes
+    # monotonicity would hand the DFS a mis-ordered event sequence.
+    times = np.concatenate([np.asarray(calls), np.asarray(rets)])
+    ev_kind = np.concatenate([np.zeros(n, np.int8), np.ones(n, np.int8)])
+    order = np.lexsort((ev_kind, times))  # calls first at equal times
+    events = [
+        (int(k) % n, bool(ev_kind[k])) for k in order
+    ]
     events.append((n, False))
     events.append((n, True))
-    open_ops = depth = 0
-    for _, is_ret in events:
-        open_ops += -1 if is_ret else 1
-        depth = max(depth, open_ops)
+    depth = int(
+        np.cumsum(np.where(ev_kind[order] == 0, 1, -1)).max(initial=0)
+    )
     kinds = [OP_APPEND] * n + [OP_GET]
     values = pieces + [""]
     outputs = [""] * n + [value]

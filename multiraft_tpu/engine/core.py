@@ -1008,3 +1008,23 @@ def run_ticks_traced(
         body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
     )
     return state, inbox, rec
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1, 2))
+def run_ticks_traced_vec(
+    cfg: EngineConfig,
+    state: EngineState,
+    inbox: Mailbox,
+    n_ticks: int,
+    new_cmds: jnp.ndarray,
+    key: jax.Array,
+) -> Tuple[EngineState, Mailbox, Dict[str, jnp.ndarray]]:
+    """:func:`run_ticks_traced` with a per-group ingest VECTOR — the
+    skewed-firehose form (10% hot groups at full rate, the rest
+    trickling) the config-#5 capture drives (BASELINE.json configs[4]:
+    churn + snapshot storm + skewed shard load at 100k x 5)."""
+    body = make_traced_body(cfg, new_cmds, key)
+    (state, inbox), rec = jax.lax.scan(
+        body, (state, inbox), jnp.arange(n_ticks, dtype=jnp.int32)
+    )
+    return state, inbox, rec

@@ -27,6 +27,48 @@ def _run(name, capsys):
     return rec
 
 
+def test_bench_main_json_smoke(monkeypatch):
+    """bench.py end-to-end at tiny CPU shapes: the driver-facing JSON
+    must carry the cross-run statistics, the measured + failover
+    latency fields, the porcupine summary, and the config5 block."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        MULTIRAFT_BENCH_PLATFORM="cpu",
+        MULTIRAFT_BENCH_G="16",
+        MULTIRAFT_BENCH_CHUNK="40",
+        MULTIRAFT_BENCH_CHUNKS="2",
+        MULTIRAFT_BENCH_RUNS="2",
+        MULTIRAFT_BENCH_SAMPLE="6",
+        MULTIRAFT_BENCH_FAULTS="4",
+        MULTIRAFT_BENCH_CONFIG5_G="20",
+        MULTIRAFT_BENCH_CONFIG5_P="5",
+        MULTIRAFT_BENCH_CONFIG5_CHUNK="40",
+        MULTIRAFT_BENCH_CONFIG5_CHUNKS="2",
+    )
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=here,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert rec["runs"] == 2 and len(rec["run_commits_per_sec"]) == 2
+    assert rec["min"] <= rec["value"] <= rec["max"]
+    assert rec["porcupine"] in ("ok", "unknown")
+    assert rec["dfs_oracle_groups"] > 0
+    assert "failover_p99_ms" in rec and "failover_entries" in rec
+    c5 = rec["config5"]
+    assert "error" not in c5, c5
+    assert c5["commits_per_sec"] > 0
+    assert c5["leader_kills"] > 0
+    assert c5["hot_commits_per_sec"] > c5["cold_commits_per_sec"]
+    assert c5["latency_unaccounted"] == 0
+
+
 def test_churn_scenario_commits_under_churn(capsys):
     rec = _run("churn", capsys)
     assert rec["value"] > 0

@@ -86,6 +86,45 @@ def test_sampled_groups_verify_ok(traced_run):
     assert out["ring_entries_crosschecked"] > 0
 
 
+def test_unique_order_check_matches_dfs():
+    """The vectorized unique-order decision and the porcupine DFS must
+    agree on admissible AND violating histories (the live runs assert
+    this on an oracle subsample; here both directions are pinned)."""
+    import numpy as np
+
+    from multiraft_tpu.engine.bench_verify import (
+        _check_group_history,
+        _check_unique_order,
+    )
+    from multiraft_tpu.porcupine.model import CheckResult
+
+    rng = np.random.default_rng(5)
+    for trial in range(40):
+        # Violating trials stay small: on a FAILING append-only
+        # history the DFS has no memo pruning (every order yields a
+        # distinct value string) and must exhaust ~n! orders — the
+        # fast path decides the same question in O(n).  That asymmetry
+        # is exactly why the fast path is the bench's primary check.
+        n = int(rng.integers(2, 40 if trial % 2 == 0 else 8))
+        calls = np.sort(rng.uniform(0, 50, n))
+        rets = calls + rng.uniform(0.5, 10, n)
+        rets = np.maximum.accumulate(rets)  # commit ticks are monotone
+        if trial % 2 == 1 and n >= 2:
+            # Violation: swap two ops' windows so index order demands
+            # an op precede one that finished strictly before it began.
+            i = int(rng.integers(0, n - 1))
+            calls[i], rets[i] = rets[i + 1] + 1.0, rets[i + 1] + 2.0
+        fast, _ = _check_unique_order(calls, rets)
+        dfs, _ = _check_group_history(
+            list(range(100, 100 + n)), calls, rets, 0, 60, 30.0
+        )
+        assert fast is dfs, (
+            f"trial {trial}: fast {fast} != DFS {dfs}\n{calls}\n{rets}"
+        )
+        if trial % 2 == 1:
+            assert fast is CheckResult.ILLEGAL
+
+
 def test_sampled_groups_ring_crosscheck_catches_divergence(traced_run):
     """If the records disagree with the device log (reconstruction
     bug, or a log-corrupting engine bug), the entry-for-entry ring
