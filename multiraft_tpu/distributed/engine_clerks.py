@@ -15,6 +15,7 @@ from .engine_wire import OK, EngineCmdArgs
 
 __all__ = [
     "EngineClerk",
+    "FirehoseClerk",
     "PipelinedClerk",
     "EngineShardNetClerk",
     "EngineFleetClerk",
@@ -115,6 +116,102 @@ class PipelinedClerk(EngineClerk):
             ):
                 continue  # lost/partial frame: retry whole (dedup-safe)
             return [r.value for r in reply]
+
+
+class FirehoseClerk(EngineClerk):
+    """Columnar clerk: packs a whole op batch into ONE firehose blob
+    (engine/firehose.py) and retries only the rows the server failed —
+    per-row RETRY errs come back in the reply columns, and the retry
+    frame reuses the same command ids, so session dedup keeps the
+    at-least-once wire exactly-once.
+
+    This is the client half of the columnar serving path: no per-op
+    dataclasses, no per-op codec — numpy columns end to end."""
+
+    # The server's wire-level cap, from the shared wire module:
+    # oversized batches split into compliant frames client-side (the
+    # server's rejection is permanent, so retrying an oversized frame
+    # would spin forever).
+    from ..engine.firehose import MAX_FIREHOSE_ROWS as MAX_FRAME
+
+    def __init__(self, sched, end, service: str = "EngineKV") -> None:
+        super().__init__(sched, end, service)
+        self._G = None
+
+    def _topology(self, deadline):
+        while self._G is None:
+            if self.sched.now >= deadline:
+                raise TimeoutError("topology fetch exceeded deadline")
+            fut: Future = self.end.call(f"{self.service}.info", None)
+            reply = yield self.sched.with_timeout(fut, 3.5)
+            if reply is not None and reply is not TIMEOUT:
+                self._G = int(reply["G"])
+        return self._G
+
+    def run_batch(self, ops, deadline_s: float = 30.0):
+        """ops = [(op, key, value), ...] → list of values (Gets) in
+        order.  Generator (spawn on the scheduler)."""
+        out = []
+        for s in range(0, len(ops), self.MAX_FRAME):
+            part = yield from self._one_frame(
+                ops[s: s + self.MAX_FRAME], deadline_s
+            )
+            out.extend(part)
+        return out
+
+    def _one_frame(self, ops, deadline_s: float):
+        import numpy as np
+
+        from ..engine.firehose import (
+            FH_OK,
+            pack_request,
+            unpack_reply,
+        )
+        from .engine_wire import _OPCODE, route_group
+
+        deadline = self.sched.now + deadline_s
+        G = yield from self._topology(deadline)
+        n = len(ops)
+        op_col = np.zeros(n, np.uint8)
+        group_col = np.zeros(n, np.uint32)
+        cmd_col = np.zeros(n, np.uint64)
+        keys = [b""] * n
+        vals = [b""] * n
+        for i, (op, key, value) in enumerate(ops):
+            op_col[i] = _OPCODE[op]
+            group_col[i] = route_group(key, G)
+            if op != "Get":
+                self.command_id += 1
+                cmd_col[i] = self.command_id
+            keys[i] = key.encode()
+            vals[i] = value.encode()
+        client_col = np.full(n, self.client_id, np.uint64)
+
+        values = [""] * n
+        todo = np.arange(n)
+        while len(todo) and self.sched.now < deadline:
+            blob = pack_request(
+                op_col[todo], group_col[todo], client_col[todo],
+                cmd_col[todo],
+                [keys[i] for i in todo.tolist()],
+                [vals[i] for i in todo.tolist()],
+            )
+            fut: Future = self.end.call(f"{self.service}.firehose", blob)
+            reply = yield self.sched.with_timeout(fut, 10.0)
+            if reply is None or reply is TIMEOUT:
+                continue  # whole frame lost: retry whole (dedup-safe)
+            if isinstance(reply, tuple) and reply and reply[0] == "err":
+                raise ValueError(reply[1])
+            err, row_vals = unpack_reply(reply)
+            ok = err == FH_OK
+            for j in np.nonzero(ok)[0].tolist():
+                values[int(todo[j])] = row_vals[j]
+            todo = todo[~ok]
+        if len(todo):
+            raise TimeoutError(
+                f"{len(todo)} rows unresolved after {deadline_s}s"
+            )
+        return values
 
 
 class EngineShardNetClerk(EngineClerk):

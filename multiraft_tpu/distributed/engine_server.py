@@ -31,6 +31,7 @@ import os
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
+from ..engine.firehose import MAX_FIREHOSE_ROWS
 from ..engine.host import EngineDriver
 from ..engine.kv import BatchedKV, KVOp
 from ..porcupine.kv import OP_GET
@@ -62,6 +63,7 @@ __all__ = [
     "EngineKVService",
     "EngineShardKVService",
     "EngineClerk",
+    "FirehoseClerk",
     "PipelinedClerk",
     "PipelinedFleetClerk",
     "EngineShardNetClerk",
@@ -253,6 +255,81 @@ class EngineKVService:
 
         return run()
 
+    # Largest columnar frame one firehose RPC may carry (the shared
+    # wire-level limit — clerks split on the same constant).
+    MAX_FIREHOSE = MAX_FIREHOSE_ROWS
+
+    def info(self, _args=None) -> dict:
+        """Topology the columnar clerks need for client-side routing."""
+        return {"G": self.G}
+
+    def firehose(self, blob):
+        """Columnar frame (engine/firehose.py): ONE bytes blob in, one
+        out — no per-op objects anywhere on the server path.  Rows that
+        lose their log slot to a leader change come back as per-row
+        RETRY errors; the CLIENT retries them under the same command
+        ids (dedup keeps that exactly-once), which takes retry
+        bookkeeping off this hot loop entirely."""
+        import numpy as np
+
+        from ..engine.firehose import FH_RETRY, pack_reply
+
+        def run():
+            raw = bytes(blob)
+            if len(raw) < 4:
+                return ("err", "ErrMalformedFrame")
+            n = int(np.frombuffer(raw, np.dtype("<u4"), 1, 0)[0])
+            if n > self.MAX_FIREHOSE:
+                return ("err", f"ErrFrameTooLarge:{self.MAX_FIREHOSE}")
+            try:
+                f = self.kv.submit_frame(raw)
+            except ValueError as e:
+                return ("err", str(e))
+            deadline = self.sched.now + self.DEADLINE_S
+            while not f.done and self.sched.now < deadline:
+                yield 0.002
+            err = f.err.copy()
+            if not f.done or (err[f.write_rows] != 0).any():
+                # Writes unresolved OR failed: Gets must NOT answer
+                # (they would read before the frame's own writes) —
+                # fail them so the client's retry frame carries the
+                # gets together with the retried writes.
+                err[f.ops == 0] = FH_RETRY
+            # Durable mode: gate OK acks on the apply-time WAL records
+            # being fsynced (same contract as the batch path).
+            if self._dur is not None:
+                ok_rows = [
+                    int(r) for r in f.write_rows.tolist()
+                    if err[r] == 0
+                ]
+                while self.sched.now < deadline:
+                    unsynced = [
+                        r for r in ok_rows
+                        if (seq := self._write_seqs.get(
+                            (f.clients_l[r], f.commands_l[r])
+                        )) is not None and not self._dur.synced(seq)
+                    ]
+                    if not unsynced:
+                        break
+                    yield 0.002
+                else:
+                    for r in ok_rows:
+                        seq = self._write_seqs.get(
+                            (f.clients_l[r], f.commands_l[r])
+                        )
+                        if seq is not None and not self._dur.synced(seq):
+                            err[r] = FH_RETRY
+            # Gets answer at frame completion from the applied state
+            # (read-after-own-frame-writes, like the batch path).
+            values = [b""] * len(f)
+            for r in np.nonzero(f.ops == 0)[0].tolist():
+                if err[r] == 0:
+                    t = self.kv.get(int(f.groups[r]), f.keys[r])
+                    values[r] = t.value.encode()
+            return pack_reply(err, values)
+
+        return run()
+
     def command(self, args: EngineCmdArgs):
         g = route_group(args.key, self.G)
         if args.op == "Get":
@@ -339,7 +416,15 @@ def serve_engine_kv(
             if blob:
                 kv.load_state_dict(blob)
         else:
-            cfg = EngineConfig(G=G, P=3, L=64, E=8, INGEST=8)
+            # Shape knobs for throughput deployments (the firehose
+            # bench serves G=256 at INGEST=24; defaults match the
+            # round-2 serving shape).
+            cfg = EngineConfig(
+                G=G, P=3,
+                L=int(os.environ.get("MULTIRAFT_SERVE_L", "64")),
+                E=int(os.environ.get("MULTIRAFT_SERVE_E", "8")),
+                INGEST=int(os.environ.get("MULTIRAFT_SERVE_INGEST", "8")),
+            )
             driver = EngineDriver(cfg, seed=seed, mesh=mesh)
             kv = BatchedKV(driver, record_groups=list(record_groups or []))
             driver.run_until_quiet_leaders(2000)
@@ -359,7 +444,12 @@ def serve_engine_kv(
         )
         if node.tracer is not None:
             driver.tracer = node.tracer  # ticks + RPCs on one timeline
-        svc = EngineKVService(sched, kv, durability=dur)
+        svc = EngineKVService(
+            sched, kv, durability=dur,
+            ticks_per_pump=int(
+                os.environ.get("MULTIRAFT_SERVE_TICKS_PER_PUMP", "2")
+            ),
+        )
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
             # Fold the replayed state into a fresh checkpoint and
@@ -379,6 +469,7 @@ def serve_engine_kv(
 from .engine_clerks import (  # noqa: E402,F401
     EngineClerk,
     EngineFleetClerk,
+    FirehoseClerk,
     EngineShardNetClerk,
     PipelinedClerk,
     PipelinedFleetClerk,

@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from .host import EngineDriver
+from .host import EngineDriver, PayloadSlice
 
 __all__ = ["FrontierService"]
 
@@ -49,6 +49,15 @@ class FrontierService:
 
     def _apply(self, g: int, idx: int, payload: Any, now: int) -> None:
         raise NotImplementedError
+
+    def _apply_slice(self, g: int, idx: int, sl: PayloadSlice, now: int) -> None:
+        """Apply one bound firehose slice (``sl.count`` consecutive
+        committed indices starting at ``idx``).  Services that accept
+        firehose frames override with a bulk apply; the default keeps
+        non-firehose services correct if a slice ever reaches them."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not accept firehose slices"
+        )
 
     def _on_evicted(self, payload: Any) -> None:
         raise NotImplementedError
@@ -94,9 +103,30 @@ class FrontierService:
                     payload = self.driver.payloads.get((g, idx))
                 else:
                     payload = self.driver.payloads.pop((g, idx), None)
-                self._apply(g, idx, payload, now)
-                self.applied_upto[g] = idx
-                applied += 1
+                if isinstance(payload, PayloadSlice):
+                    # Bulk path: the slice covers consecutive indices;
+                    # apply the committed prefix whole and re-key any
+                    # uncommitted tail at the split point.
+                    assert not self.retain_payloads, (
+                        "firehose slices are pop-applied; split-group "
+                        "services (retain_payloads) have no firehose "
+                        "surface"
+                    )
+                    avail = upto - idx + 1
+                    if payload.count > avail:
+                        tail_key = (g, idx + avail)
+                        stale = self.driver.payloads.get(tail_key)
+                        if stale is not None:
+                            self._on_evicted(stale)
+                        self.driver.payloads[tail_key] = payload
+                        payload = payload.split_head(avail)
+                    self._apply_slice(g, idx, payload, now)
+                    self.applied_upto[g] = idx + payload.count - 1
+                    applied += payload.count
+                else:
+                    self._apply(g, idx, payload, now)
+                    self.applied_upto[g] = idx
+                    applied += 1
         self.last_applied = applied
         self._post_pump()
         # Periodically fail bindings orphaned by log truncation (a
@@ -111,7 +141,14 @@ class FrontierService:
         """Fail tickets whose bound (group, index) log entry no longer
         exists in the current leader's log — it was truncated by a
         leader change and can never commit as bound.  Returns the number
-        of tickets failed."""
+        of tickets failed.
+
+        Slice-aware: a firehose slice wholly beyond the log end is
+        evicted whole; one straddling it is truncated (the surviving
+        prefix stays bound).  Stale bindings shadowed below the applied
+        frontier (their slots were rewritten and applied through a
+        fresher binding) are failed too, so their rows resolve promptly
+        instead of waiting out the frame deadline."""
         if not self.driver.payloads:
             return 0
         st = self.driver.np_state()
@@ -126,8 +163,30 @@ class FrontierService:
                     else int(st["base"][g, p] + st["log_len"][g, p])
                 )
             last = last_cache[g]
-            if last is not None and idx > last:
-                payload = self.driver.payloads.pop((g, idx))
-                self._on_evicted(payload)
+            payload = self.driver.payloads.get((g, idx))
+            count = payload.count if isinstance(payload, PayloadSlice) else 1
+            if (
+                not self.retain_payloads
+                and idx + count - 1 <= self.applied_upto[g]
+            ):
+                # Stale: the frontier passed this whole binding via a
+                # fresher covering binding — these rows lost their
+                # slots and can never apply as bound.  (Split-group
+                # mode RETAINS applied payloads for peer resends —
+                # below-frontier there is the normal state, not stale.)
+                self._on_evicted(self.driver.payloads.pop((g, idx)))
+                failed += 1
+                continue
+            if last is None:
+                continue
+            if idx > last:
+                self._on_evicted(self.driver.payloads.pop((g, idx)))
+                failed += 1
+            elif idx + count - 1 > last:
+                # Straddles the log end: fail the truncated tail only.
+                keep = last - idx + 1
+                tail = PayloadSlice(payload.frame, payload.rows[keep:])
+                payload.rows = payload.rows[:keep]
+                self._on_evicted(tail)
                 failed += 1
         return failed

@@ -40,7 +40,60 @@ from .core import (
     tick,
 )
 
-__all__ = ["EngineDriver", "apply_faults", "mask_active"]
+__all__ = [
+    "EngineDriver",
+    "PayloadRun",
+    "PayloadSlice",
+    "apply_faults",
+    "mask_active",
+]
+
+
+class PayloadRun:
+    """A pending firehose run: ``rows`` (original frame row indices,
+    submission order) of ``frame`` awaiting log slots in one group.
+    Consumed incrementally by the binding loop — each accept batch
+    takes a prefix as one :class:`PayloadSlice`."""
+
+    __slots__ = ("frame", "rows", "consumed")
+
+    def __init__(self, frame: Any, rows: "np.ndarray") -> None:
+        self.frame = frame
+        self.rows = rows
+        self.consumed = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.rows) - self.consumed
+
+    def take(self, k: int) -> "PayloadSlice":
+        s = PayloadSlice(self.frame, self.rows[self.consumed: self.consumed + k])
+        self.consumed += k
+        return s
+
+
+class PayloadSlice:
+    """A bound contiguous range of log slots carrying firehose rows:
+    stored in ``driver.payloads`` keyed by its FIRST (group, index);
+    covers ``len(rows)`` consecutive indices.  The frontier sweep
+    applies it whole (or splits it at the commit frontier); eviction
+    fails all its rows at once."""
+
+    __slots__ = ("frame", "rows")
+
+    def __init__(self, frame: Any, rows: "np.ndarray") -> None:
+        self.frame = frame
+        self.rows = rows
+
+    @property
+    def count(self) -> int:
+        return len(self.rows)
+
+    def split_head(self, k: int) -> "PayloadSlice":
+        """Split off the first ``k`` rows; self keeps the tail."""
+        head = PayloadSlice(self.frame, self.rows[:k])
+        self.rows = self.rows[k:]
+        return head
 
 # The message channels' liveness fields; every fault transform (drop,
 # partition, crash edge-kill) is a mask over exactly these.  Derived
@@ -153,6 +206,10 @@ class EngineDriver:
         # orders (term, index); data stays here (SURVEY §7.1).
         self.payloads: Dict[tuple, Any] = {}
         self._pending_payloads: Dict[int, list] = defaultdict(list)
+        # Per-group bind high-water mark: an accept starting at or
+        # below it is a truncation REBIND and triggers the stale-
+        # binding eviction scan (see _bind_accepted).
+        self._max_bound: Dict[int, int] = {}
         self.last_metrics: Dict[str, Any] = {}
         self.mesh = None
         self._mesh_tick = None
@@ -317,6 +374,93 @@ class EngineDriver:
     def start_bulk(self, counts: np.ndarray) -> None:
         self.backlog += counts
 
+    def start_run(self, g: int, frame: Any, rows: "np.ndarray") -> None:
+        """Queue a contiguous RUN of firehose-frame rows for group
+        ``g`` — ONE pending entry and one backlog bump of ``len(rows)``
+        instead of a per-op append (the columnar serving path,
+        engine/firehose.py).  ``rows`` are original frame row indices
+        in submission order."""
+        self.backlog[g] += len(rows)
+        self._pending_payloads[g].append(PayloadRun(frame, rows))
+
+    def _evict_rebound_range(self, g: int, lo: int, hi: int) -> None:
+        """A fresh accept is about to bind slots ``[lo, hi]`` of group
+        ``g``: every EXISTING binding overlapping ``[lo, ...)`` is
+        stale — the log was truncated below it and those slots rewritten
+        (an accept at start s0 means the leader's log ended at s0, so
+        everything above is gone; slots beyond ``hi`` bound earlier are
+        equally stale).  Per-op bindings sit at their own key; a slice
+        keyed BELOW ``lo`` can straddle into the range, but its length
+        is bounded by cfg.INGEST (one accept batch), so a bounded
+        backward scan finds it.  A straddler's prefix below ``lo``
+        survived the truncation and stays bound; the tail is evicted."""
+        pay = self.payloads
+        for idx in range(max(1, lo - self.cfg.INGEST + 1), hi + 1):
+            old = pay.get((g, idx))
+            if old is None:
+                continue
+            if isinstance(old, PayloadSlice):
+                end = idx + old.count - 1
+                if end < lo:
+                    continue  # wholly below the rewrite: still valid
+                if idx < lo:
+                    # Straddler: keep the surviving prefix, evict the
+                    # rewritten tail.
+                    tail = PayloadSlice(old.frame, old.rows[lo - idx:])
+                    old.rows = old.rows[: lo - idx]
+                    if self.on_payload_evicted:
+                        self.on_payload_evicted(tail)
+                    continue
+                pay.pop((g, idx))
+                if self.on_payload_evicted:
+                    self.on_payload_evicted(old)
+            elif idx >= lo:
+                pay.pop((g, idx))
+                if self.on_payload_evicted:
+                    self.on_payload_evicted(old)
+
+    def _bind_accepted(
+        self, g: int, k: int, s0: int, term: Optional[int]
+    ) -> None:
+        """Bind ``k`` accepted slots ``s0+1..s0+k`` of group ``g`` to
+        pending payloads/runs, evicting whatever stale bindings the
+        rewrite invalidated first (see :meth:`_evict_rebound_range` —
+        without it, a slice bound before a truncation could later
+        bulk-apply rows over slots that now hold different entries).
+
+        The eviction scan only fires on a REBIND — an accept starting
+        at or below the group's bind high-water mark (leader-churn
+        truncation); steady-state accepts pay one dict probe."""
+        lo, hi = s0 + 1, s0 + k
+        mb = self._max_bound.get(g, 0)
+        if self.payloads and lo <= mb:
+            self._evict_rebound_range(g, lo, hi)
+        if hi > mb:
+            self._max_bound[g] = hi
+        pend = self._pending_payloads.get(g)
+        if not pend:
+            return
+        off = 0
+        while off < k and pend:
+            head = pend[0]
+            slot = (g, s0 + 1 + off)
+            if isinstance(head, PayloadRun):
+                # One bound entry covers a whole run prefix —
+                # per-slice, not per-op.
+                take = min(head.remaining, k - off)
+                self.payloads[slot] = head.take(take)
+                if head.remaining == 0:
+                    pend.pop(0)
+                if term is not None:
+                    for j in range(take):
+                        self.on_payload_bound(slot[0], slot[1] + j, term)
+                off += take
+            else:
+                self.payloads[slot] = pend.pop(0)
+                if term is not None:
+                    self.on_payload_bound(slot[0], slot[1], term)
+                off += 1
+
     # -- tick loop --------------------------------------------------------
 
     def step(self, n: int = 1) -> Dict[str, Any]:
@@ -368,19 +512,10 @@ class EngineDriver:
                 for g in np.nonzero(accepted)[0]:
                     k = int(accepted[g])
                     self.backlog[g] -= k
-                    pend = self._pending_payloads.get(int(g))
-                    if pend:
-                        s0 = int(starts[g])
-                        for off in range(min(k, len(pend))):
-                            slot = (int(g), s0 + 1 + off)
-                            old = self.payloads.get(slot)
-                            if old is not None and self.on_payload_evicted:
-                                self.on_payload_evicted(old)
-                            self.payloads[slot] = pend.pop(0)
-                            if terms is not None:
-                                self.on_payload_bound(
-                                    slot[0], slot[1], int(terms[g])
-                                )
+                    self._bind_accepted(
+                        int(g), k, int(starts[g]),
+                        int(terms[g]) if terms is not None else None,
+                    )
             # Accumulate on device; converted lazily by readers.
             self._commits_dev = (
                 getattr(self, "_commits_dev", jnp.int32(0)) + metrics["commits"]
@@ -540,6 +675,14 @@ class EngineDriver:
         d.backlog = blob["backlog"]
         d.payloads = blob["payloads"]
         d._pending_payloads = defaultdict(list, blob["pending_payloads"])
+        # Rebuild the bind high-water marks from the restored bindings
+        # (a zeroed mark would skip the rebind eviction scan and let a
+        # post-restore truncation phantom-apply a stale slice).
+        d._max_bound = {}
+        for (g, idx), p in d.payloads.items():
+            end = idx + (p.count - 1 if isinstance(p, PayloadSlice) else 0)
+            if end > d._max_bound.get(g, 0):
+                d._max_bound[g] = end
         d.edge_up = blob["edge_up"]
         d.replica_conn = blob["replica_conn"]
         d._edge_dev = None

@@ -19,10 +19,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from ..porcupine.kv import OP_GET, OP_PUT, KvInput, KvOutput
 from ..porcupine.model import Operation
+from .firehose import FirehoseFrame
 from .frontier import FrontierService
-from .host import EngineDriver
+from .host import EngineDriver, PayloadSlice
 
 __all__ = ["KVOp", "Ticket", "BatchedKV", "apply_kv_op"]
 
@@ -164,11 +167,82 @@ class BatchedKV(FrontierService):
         its log slot to a leader change and will never commit there —
         fail its ticket so the caller can resubmit (the batched analog of
         kvraft's ErrWrongLeader wait-channel resolution,
-        reference: kvraft/server.go:98-128)."""
+        reference: kvraft/server.go:98-128).  Firehose slices fail all
+        their rows at once — the CLIENT resubmits those (row-level
+        RETRY errs in the reply; dedup keeps the retry exactly-once)."""
+        if isinstance(payload, PayloadSlice):
+            payload.frame.rows_failed(payload.rows)
+            return
         _, ticket = payload
         if ticket is not None and not ticket.done:
             ticket.done = True
             ticket.failed = True
+
+    # -- columnar firehose (engine/firehose.py) --------------------------
+
+    def submit_frame(self, blob: bytes) -> FirehoseFrame:
+        """Enqueue one columnar frame: write rows are grouped into
+        contiguous per-group RUNS (one pending entry + one backlog bump
+        per run — no per-op Python on the submit path).  Stable sort
+        preserves each client's submission order within a group, which
+        session dedup requires.  Gets do not ride the log; they answer
+        at frame completion (read-after-own-frame-writes, like the
+        framed batch path)."""
+        f = FirehoseFrame(blob, self._now())
+        if len(f.groups) and int(f.groups.max()) >= self.driver.cfg.G:
+            raise ValueError(
+                f"frame routes to group {int(f.groups.max())} >= G="
+                f"{self.driver.cfg.G}"
+            )
+        wr = f.write_rows
+        if len(wr):
+            g = f.groups[wr]
+            order = np.argsort(g, kind="stable")
+            rows_sorted = wr[order]
+            gs = g[order]
+            bounds = np.nonzero(np.diff(gs))[0] + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [len(gs)]])
+            for s, e in zip(starts.tolist(), ends.tolist()):
+                self.driver.start_run(int(gs[s]), f, rows_sorted[s:e])
+        return f
+
+    def _apply_slice(self, g: int, idx: int, sl: PayloadSlice, now: int) -> None:
+        """Bulk apply of one committed firehose slice: the per-row work
+        is exactly the state machine (dup check + dict mutate + session
+        update — apply_kv_op semantics, reference: kvraft/server.go:
+        98-128); everything around it resolved per-slice."""
+        f = sl.frame
+        data = self.data[g]
+        sess = self.sessions[g]
+        ops_l = f.ops_l
+        clients_l = f.clients_l
+        commands_l = f.commands_l
+        keys = f.keys
+        vals = f.vals
+        record = g in self._record
+        on_write = self.on_write
+        for r in sl.rows.tolist():
+            cid = clients_l[r]
+            cmd = commands_l[r]
+            if cmd > 0 and sess.get(cid, 0) >= cmd:
+                continue  # duplicate write: already applied
+            k = keys[r]
+            if ops_l[r] == OP_PUT:
+                data[k] = vals[r]
+            else:
+                data[k] = data.get(k, "") + vals[r]
+            if cmd > 0:
+                sess[cid] = cmd
+            if on_write is not None:
+                on_write(g, KVOp(op=ops_l[r], key=k, value=vals[r],
+                                 client_id=cid, command_id=cmd))
+            if record:
+                self._record_op(
+                    g, KvInput(op=ops_l[r], key=k, value=vals[r]),
+                    "", f.submit_tick, now,
+                )
+        f.rows_applied(sl.rows)
 
     # -- pumping/sweeping inherited from FrontierService -----------------
 

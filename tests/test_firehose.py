@@ -1,0 +1,343 @@
+"""Columnar firehose path: wire format, run binding, slice apply,
+eviction/retry semantics, and equivalence with the per-op path."""
+
+import numpy as np
+import pytest
+
+from multiraft_tpu.engine.core import EngineConfig
+from multiraft_tpu.engine.firehose import (
+    FH_OK,
+    FH_RETRY,
+    FH_TIMEOUT,
+    FirehoseFrame,
+    pack_reply,
+    pack_request,
+    unpack_reply,
+    unpack_request,
+)
+from multiraft_tpu.engine.host import EngineDriver
+from multiraft_tpu.engine.kv import BatchedKV, KVOp
+from multiraft_tpu.porcupine.kv import OP_APPEND, OP_GET, OP_PUT
+
+
+def make_kv(G=4, P=3, seed=0, **kw):
+    d = EngineDriver(EngineConfig(G=G, P=P, **kw), seed=seed)
+    assert d.run_until_quiet_leaders(400)
+    kv = BatchedKV(d)
+    return kv
+
+
+def frame_blob(rows, G=4):
+    """rows: list of (op, key, value, client_id, command_id)."""
+    ops = np.array([r[0] for r in rows], np.uint8)
+    groups = np.array(
+        [sum(r[1].encode()) % G for r in rows], np.uint32
+    )
+    clients = np.array([r[3] for r in rows], np.uint64)
+    commands = np.array([r[4] for r in rows], np.uint64)
+    keys = [r[1].encode() for r in rows]
+    vals = [r[2].encode() for r in rows]
+    return pack_request(ops, groups, clients, commands, keys, vals), groups
+
+
+def test_wire_roundtrip():
+    rows = [
+        (OP_PUT, "alpha", "1", 7, 1),
+        (OP_APPEND, "beta", "xy", 7, 2),
+        (OP_GET, "alpha", "", 8, 0),
+    ]
+    blob, groups = frame_blob(rows)
+    ops, gs, cl, cm, keys, vals = unpack_request(blob)
+    assert ops.tolist() == [OP_PUT, OP_APPEND, OP_GET]
+    assert gs.tolist() == groups.tolist()
+    assert keys == ["alpha", "beta", "alpha"]
+    assert vals == ["1", "xy", ""]
+    assert cl.tolist() == [7, 7, 8] and cm.tolist() == [1, 2, 0]
+
+    rep = pack_reply(np.array([0, 0, 1], np.uint8), [b"", b"", b"v"])
+    err, values = unpack_reply(rep)
+    assert err.tolist() == [0, 0, 1] and values == ["", "", "v"]
+
+
+def test_frame_applies_and_matches_per_op_path():
+    """A firehose frame and the same ops through per-op submit must
+    produce identical KV state."""
+    kv_a = make_kv(G=4, seed=1)
+    kv_b = make_kv(G=4, seed=1)
+    rows = []
+    for i in range(200):
+        op = OP_PUT if i % 3 == 0 else OP_APPEND
+        rows.append((op, f"k{i % 17}", f"v{i},", 1 + i % 5, i + 1))
+    blob, groups = frame_blob(rows)
+
+    f = kv_a.submit_frame(blob)
+    for _ in range(200):
+        kv_a.pump(1)
+        if f.done:
+            break
+    assert f.done
+    assert (f.err[f.write_rows] == FH_OK).all()
+
+    for (op, key, val, cid, cmd), g in zip(rows, groups.tolist()):
+        kv_b.submit(int(g), KVOp(op=op, key=key, value=val,
+                                 client_id=cid, command_id=cmd))
+    for _ in range(200):
+        kv_b.pump(1)
+        if not kv_b.driver.payloads and not kv_b.driver.backlog.any():
+            break
+    assert kv_a.data == kv_b.data
+    assert kv_a.sessions == kv_b.sessions
+
+
+def test_frame_dedup_exactly_once():
+    """Re-submitting the same frame (client retry) must not re-apply."""
+    kv = make_kv(G=2, seed=2)
+    rows = [(OP_APPEND, "k", f"[{i}]", 9, i + 1) for i in range(20)]
+    blob, groups = frame_blob(rows, G=2)
+    f1 = kv.submit_frame(blob)
+    for _ in range(100):
+        kv.pump(1)
+        if f1.done:
+            break
+    assert f1.done
+    g = int(groups[0])
+    want = "".join(f"[{i}]" for i in range(20))
+    assert kv.data[g]["k"] == want
+
+    f2 = kv.submit_frame(blob)  # full retry: every row is a duplicate
+    for _ in range(100):
+        kv.pump(1)
+        if f2.done:
+            break
+    assert f2.done
+    assert (f2.err[f2.write_rows] == FH_OK).all()
+    assert kv.data[g]["k"] == want  # no double-apply
+
+
+def test_mixed_per_op_and_frame_traffic():
+    """Per-op submits and frame runs interleave in one group's queue."""
+    kv = make_kv(G=1, seed=3)
+    t1 = kv.submit(0, KVOp(op=OP_APPEND, key="k", value="A"))
+    rows = [(OP_APPEND, "k", "B", 1, 1), (OP_APPEND, "k", "C", 1, 2)]
+    ops = np.array([r[0] for r in rows], np.uint8)
+    blob = pack_request(
+        ops, np.zeros(2, np.uint32), np.array([1, 1], np.uint64),
+        np.array([1, 2], np.uint64),
+        [b"k", b"k"], [b"B", b"C"],
+    )
+    f = kv.submit_frame(blob)
+    t2 = kv.submit(0, KVOp(op=OP_APPEND, key="k", value="D"))
+    for _ in range(100):
+        kv.pump(1)
+        if f.done and t1.done and t2.done:
+            break
+    assert f.done and t1.done and t2.done
+    assert kv.data[0]["k"] == "ABCD"  # submission order preserved
+
+
+def test_leader_kill_fails_rows_for_client_retry():
+    """Kill leaders while a large frame is in flight: every write row
+    must RESOLVE (OK, RETRY, or still-pending-at-deadline TIMEOUT —
+    never a wrong apply), and retrying the failed rows completes the
+    frame with the exact once-per-command state."""
+    kv = make_kv(G=2, P=3, seed=4)
+    n = 400
+    rows = [(OP_APPEND, "k", f"[{i}]", 5, i + 1) for i in range(n)]
+    blob, groups = frame_blob(rows, G=2)
+    f = kv.submit_frame(blob)
+    for round_ in range(6):
+        kv.pump(3)
+        for g in range(2):
+            lead = kv.driver.leader_of(g)
+            if lead is not None and round_ % 2 == 0:
+                kv.driver.set_alive(g, lead, False)
+                kv.pump(1)
+                kv.driver.restart_replica(g, lead)
+    for _ in range(600):
+        kv.pump(1)
+        if f.done:
+            break
+    # Retry rows the server failed (the client contract), until done.
+    for attempt in range(8):
+        bad = np.nonzero(
+            (f.err != FH_OK) & (np.asarray([r[0] != OP_GET for r in rows]))
+        )[0]
+        if len(bad) == 0:
+            break
+        sub = [rows[i] for i in bad.tolist()]
+        blob2, _ = frame_blob(sub, G=2)
+        f2 = kv.submit_frame(blob2)
+        for _ in range(600):
+            kv.pump(1)
+            if f2.done:
+                break
+        # fold the retry outcome back
+        for j, i in enumerate(bad.tolist()):
+            f.err[i] = f2.err[j]
+    g_of = {r[1]: int(g) for r, g in zip(rows, groups.tolist())}
+    got = kv.data[g_of["k"]]["k"]
+    # Exactly-once: every op applied once, in command order per client.
+    want = "".join(f"[{i}]" for i in range(n))
+    assert got == want, f"{got[:80]}... != {want[:80]}..."
+
+
+def test_truncation_rebind_evicts_stale_slice():
+    """The phantom-apply hazard, pinned: a slice bound at slots 10-17,
+    then the log truncates to 12 and a fresh accept rebinds 13-15.
+    The slice's rewritten tail rows (slots 13+) must be evicted at
+    BIND time (not left to bulk-apply over slots that now hold
+    different entries); the surviving prefix (10-12) stays bound."""
+    from multiraft_tpu.engine.host import PayloadSlice
+
+    kv = make_kv(G=1, seed=6)
+    d = kv.driver
+    rows = [(OP_APPEND, "k", f"[{i}]", 3, i + 1) for i in range(8)]
+    blob = pack_request(
+        np.array([r[0] for r in rows], np.uint8),
+        np.zeros(8, np.uint32),
+        np.array([r[3] for r in rows], np.uint64),
+        np.array([r[4] for r in rows], np.uint64),
+        [r[1].encode() for r in rows],
+        [r[2].encode() for r in rows],
+    )
+    f = FirehoseFrame(blob, 0)
+    sl = PayloadSlice(f, np.arange(8))
+    d.payloads[(0, 10)] = sl
+    d._max_bound[0] = 17
+    # Fresh per-op commands pending for the rebinding accept at 13-15.
+    for j in range(3):
+        d._pending_payloads[0].append(
+            (KVOp(op=OP_APPEND, key="k", value=f"N{j}"), None)
+        )
+    d._bind_accepted(0, 3, 12, None)
+
+    # Prefix (slots 10-12 = rows 0-2) survives; tail rows failed.
+    assert d.payloads[(0, 10)] is sl and sl.count == 3
+    assert (f.err[3:8] == FH_RETRY).all()
+    assert (f.err[0:3] == FH_TIMEOUT).all()  # still in flight
+    assert f.pending_writes == 3
+    # The fresh bindings own slots 13-15.
+    for j in range(3):
+        p = d.payloads[(0, 13 + j)]
+        assert not isinstance(p, PayloadSlice)
+        assert p[0].value == f"N{j}"
+
+    # A second rebind BELOW the slice start evicts the remainder whole.
+    for j in range(2):
+        d._pending_payloads[0].append(
+            (KVOp(op=OP_APPEND, key="k", value=f"M{j}"), None)
+        )
+    d._bind_accepted(0, 2, 9, None)
+    assert (0, 10) not in d.payloads or d.payloads[(0, 10)] is not sl
+    assert f.pending_writes == 0
+    assert (f.err[0:3] == FH_RETRY).all()
+
+
+def test_firehose_served_over_real_sockets():
+    """The columnar path end-to-end over TCP: one blob per frame, gets
+    see the frame's own writes, whole-frame retry stays exactly-once,
+    and oversized frames are rejected cleanly."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.distributed.engine_server import FirehoseClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=16, seed=7)
+    cli = None
+    try:
+        cluster.start()
+        cli = RpcNode()
+        sched = cli.sched
+        end = cli.client_end(cluster.host, cluster.port)
+        ck = FirehoseClerk(sched, end)
+
+        ops = [("Append", f"fk{i % 4}", f"[{i}]") for i in range(40)]
+        ops.append(("Get", "fk0", ""))
+        vals = sched.wait(sched.spawn(ck.run_batch(ops)), 60.0)
+        assert vals is not TIMEOUT
+        want = "".join(f"[{i}]" for i in range(0, 40, 4))
+        assert vals[-1] == want
+
+        # Whole-frame client retry under the same command ids: dedup
+        # must keep it exactly-once.
+        ck.command_id -= sum(1 for op, *_ in ops if op != "Get")
+        vals2 = sched.wait(sched.spawn(ck.run_batch(ops)), 60.0)
+        assert vals2 is not TIMEOUT and vals2[-1] == want
+
+        # Mixed clients interleave safely: a second clerk's writes to
+        # the same keys land exactly once too.
+        ck2 = FirehoseClerk(sched, end)
+        vals3 = sched.wait(
+            sched.spawn(ck2.run_batch(
+                [("Append", "fk0", "(x)"), ("Get", "fk0", "")]
+            )),
+            60.0,
+        )
+        assert vals3 is not TIMEOUT and vals3[-1] == want + "(x)"
+    finally:
+        if cli is not None:
+            cli.close()
+        cluster.shutdown()
+
+
+def test_firehose_durable_acks_survive_kill(tmp_path):
+    """Durable server: firehose acks gate on the WAL fsync; kill -9 +
+    restart recovers every acked row."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.distributed.engine_server import FirehoseClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=8, seed=8,
+        data_dir=str(tmp_path / "fh"), checkpoint_every_s=3600.0,
+    )
+    cli = None
+    try:
+        cluster.start()
+        cli = RpcNode()
+        sched = cli.sched
+        end = cli.client_end(cluster.host, cluster.port)
+        ck = FirehoseClerk(sched, end)
+        ops = [("Append", f"dk{i % 3}", f"[{i}]") for i in range(24)]
+        assert sched.wait(sched.spawn(ck.run_batch(ops)), 60.0) is not TIMEOUT
+
+        cluster.kill()
+        cluster.start()
+        end2 = cli.client_end(cluster.host, cluster.port)
+        ck2 = FirehoseClerk(sched, end2)
+        got = sched.wait(
+            sched.spawn(ck2.run_batch([("Get", f"dk{k}", "") for k in range(3)])),
+            120.0,
+        )
+        assert got is not TIMEOUT
+        for k in range(3):
+            want = "".join(f"[{i}]" for i in range(24) if i % 3 == k)
+            assert got[k] == want, f"dk{k}: {got[k]!r} != {want!r}"
+    finally:
+        if cli is not None:
+            cli.close()
+        cluster.shutdown()
+
+
+def test_firehose_inprocess_bench_smoke():
+    """The serving-throughput firehose rig at tiny shapes: every op
+    resolves OK and the JSON schema holds."""
+    from benchmarks.serving_throughput import bench_firehose_inprocess
+
+    out = bench_firehose_inprocess(
+        G=16, ingest=8, clerks=2, frames_per_clerk=2, frame=256
+    )
+    assert out["ops_ok"] == out["ops"] == 2 * 2 * 256
+    assert out["ops_per_sec"] > 0
+
+
+def test_frame_get_routing_bounds_checked():
+    kv = make_kv(G=2, seed=5)
+    blob = pack_request(
+        np.array([OP_PUT], np.uint8), np.array([9], np.uint32),
+        np.array([1], np.uint64), np.array([1], np.uint64),
+        [b"k"], [b"v"],
+    )
+    with pytest.raises(ValueError, match="routes to group"):
+        kv.submit_frame(blob)
