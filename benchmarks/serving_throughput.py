@@ -148,6 +148,9 @@ def bench_firehose_inprocess(
     # The hot pump is the right mode for THIS measure: clerks are
     # coroutines on the server's own scheduler (no co-located client
     # process to starve — the reason the 1-CPU default gates it off).
+    # Saved/restored so it cannot leak into later measures or spawned
+    # server children in the same process.
+    saved_hot = os.environ.get("MRT_PUMP_HOT")
     os.environ.setdefault("MRT_PUMP_HOT", "1")
 
     import jax
@@ -179,34 +182,41 @@ def bench_firehose_inprocess(
 
     sched.run_call(build, timeout=600.0)
     svc = box["svc"]
-    all_frames = [
-        _pack_clerk_frames(G, ci + 1, frames_per_clerk, frame)
-        for ci in range(clerks)
-    ]
-    # Warm both tick variants + the handler path.
-    warm = _pack_clerk_frames(G, 99, 1, frame)[0]
-    from multiraft_tpu.sim.scheduler import TIMEOUT
-    assert sched.wait(sched.spawn(svc.firehose(warm)), 120.0) is not TIMEOUT
+    try:
+        all_frames = [
+            _pack_clerk_frames(G, ci + 1, frames_per_clerk, frame)
+            for ci in range(clerks)
+        ]
+        # Warm both tick variants + the handler path.
+        warm = _pack_clerk_frames(G, 99, 1, frame)[0]
+        from multiraft_tpu.sim.scheduler import TIMEOUT
+        assert sched.wait(sched.spawn(svc.firehose(warm)), 120.0) is not TIMEOUT
 
-    results = []
+        results = []
 
-    def clerk_driver(ci):
-        for blob in all_frames[ci]:
-            reply = yield sched.spawn(svc.firehose(blob))
-            err, _ = unpack_reply(reply)
-            results.append(int((err == FH_OK).sum()))
+        def clerk_driver(ci):
+            for blob in all_frames[ci]:
+                reply = yield sched.spawn(svc.firehose(blob))
+                err, _ = unpack_reply(reply)
+                results.append(int((err == FH_OK).sum()))
 
-    t0 = time.perf_counter()
-    futs = [sched.spawn(clerk_driver(ci)) for ci in range(clerks)]
-    for f in futs:
-        assert sched.wait(f, 600.0) is not TIMEOUT
-    elapsed = time.perf_counter() - t0
-    total_ok = int(np.sum(results))
-    total = clerks * frames_per_clerk * frame
-    # Tear the engine down: a leftover pump thread would contend with
-    # any measurement that follows in this process.
-    svc.stop()
-    sched.stop()
+        t0 = time.perf_counter()
+        futs = [sched.spawn(clerk_driver(ci)) for ci in range(clerks)]
+        for f in futs:
+            assert sched.wait(f, 600.0) is not TIMEOUT
+        elapsed = time.perf_counter() - t0
+        total_ok = int(np.sum(results))
+        total = clerks * frames_per_clerk * frame
+    finally:
+        # Tear the engine down even on failure: a leftover pump thread
+        # (and a leaked MRT_PUMP_HOT) would contend with / reconfigure
+        # any measurement that follows in this process.
+        svc.stop()
+        sched.stop()
+        if saved_hot is None:
+            os.environ.pop("MRT_PUMP_HOT", None)
+        else:
+            os.environ["MRT_PUMP_HOT"] = saved_hot
     return {
         "mode": "firehose-inprocess",
         "G": G,
@@ -225,11 +235,14 @@ def bench_firehose_sockets(
     G: int = 256, ingest: int = 24, verify: bool = True,
 ) -> dict:
     """Multi-client socket throughput of the columnar path: each
-    client owns its own TCP connection (separate RpcNode), ships
-    pre-packed frames, and retries failed rows; two additional
-    verifier clerks interleave ops on SHARED keys recording wall-clock
-    histories that are porcupine-checked at the end — the
-    check-the-actual-run pattern across real sockets."""
+    client owns its own TCP connection (separate RpcNode) and ships
+    pre-packed frames, counting only rows the server acked OK (no
+    client-side retry in the throughput driver — row-retry semantics
+    are FirehoseClerk's job, exercised by the verifier clerks and the
+    test suite); two verifier clerks interleave ops on SHARED keys
+    through the real FirehoseClerk, recording wall-clock histories
+    porcupine-checked at the end — the check-the-actual-run pattern
+    across real sockets."""
     import os
     import threading
 
@@ -276,7 +289,6 @@ def bench_firehose_sockets(
             for ci in range(n_clients)
         ]
         ok_counts = [0] * n_clients
-        elapsed_by = [0.0] * n_clients
 
         def client_main(ci):
             node = RpcNode()
@@ -296,10 +308,8 @@ def bench_firehose_sockets(
                     ok += int((err == FH_OK).sum())
                 return ok
 
-            t0 = time.perf_counter()
             fut = sched.spawn(driver())
             out = sched.wait(fut, 600.0)
-            elapsed_by[ci] = time.perf_counter() - t0
             ok_counts[ci] = 0 if out is TIMEOUT else int(out)
 
         history = []

@@ -73,6 +73,25 @@ __all__ = [
 ]
 
 
+class _FrameRowArgs:
+    """Adapter presenting a firehose frame's rows through the
+    ``args_list[i].client_id/.command_id`` shape
+    :func:`~.engine_durability.await_frame_synced` indexes — so the
+    firehose and batch handlers share ONE durable-ack gate."""
+
+    __slots__ = ("f",)
+
+    def __init__(self, f) -> None:
+        self.f = f
+
+    def __getitem__(self, i):
+        import types
+
+        return types.SimpleNamespace(
+            client_id=self.f.clients_l[i], command_id=self.f.commands_l[i]
+        )
+
+
 class EngineKVService:
     """``EngineKV.command`` RPC front for a :class:`BatchedKV`.
 
@@ -296,29 +315,20 @@ class EngineKVService:
                 # gets together with the retried writes.
                 err[f.ops == 0] = FH_RETRY
             # Durable mode: gate OK acks on the apply-time WAL records
-            # being fsynced (same contract as the batch path).
+            # being fsynced — the SAME shared gate the batch path uses
+            # (never a false durable ack; unsynced rows demote to
+            # RETRY at the deadline).
             if self._dur is not None:
-                ok_rows = [
-                    int(r) for r in f.write_rows.tolist()
-                    if err[r] == 0
-                ]
-                while self.sched.now < deadline:
-                    unsynced = [
-                        r for r in ok_rows
-                        if (seq := self._write_seqs.get(
-                            (f.clients_l[r], f.commands_l[r])
-                        )) is not None and not self._dur.synced(seq)
-                    ]
-                    if not unsynced:
-                        break
-                    yield 0.002
-                else:
-                    for r in ok_rows:
-                        seq = self._write_seqs.get(
-                            (f.clients_l[r], f.commands_l[r])
-                        )
-                        if seq is not None and not self._dur.synced(seq):
-                            err[r] = FH_RETRY
+                ok_rows = {
+                    int(r) for r in f.write_rows.tolist() if err[r] == 0
+                }
+                yield from await_frame_synced(
+                    self.sched, self._dur, self._write_seqs, ok_rows,
+                    _FrameRowArgs(f), deadline,
+                )
+                for r in f.write_rows.tolist():
+                    if err[r] == 0 and r not in ok_rows:
+                        err[r] = FH_RETRY
             # Gets answer at frame completion from the applied state
             # (read-after-own-frame-writes, like the batch path).
             values = [b""] * len(f)

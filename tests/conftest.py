@@ -22,6 +22,18 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compilation cache, shared with every spawned server
+# child (the env var is inherited): the suite's dominant wall-clock
+# cost was each engine subprocess re-jitting the same tick programs
+# (~10-20 s per child, dozens of children).  Cache keys are HLO
+# fingerprints, so code changes invalidate cleanly.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
