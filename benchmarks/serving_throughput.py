@@ -180,9 +180,9 @@ def bench_firehose_inprocess(
         # INGEST=24 (576k vs 562k at 2, 497k at 6 on this box).
         box["svc"] = EngineKVService(sched, kv, ticks_per_pump=4)
 
-    sched.run_call(build, timeout=600.0)
-    svc = box["svc"]
     try:
+        sched.run_call(build, timeout=600.0)
+        svc = box["svc"]
         all_frames = [
             _pack_clerk_frames(G, ci + 1, frames_per_clerk, frame)
             for ci in range(clerks)
@@ -208,10 +208,12 @@ def bench_firehose_inprocess(
         total_ok = int(np.sum(results))
         total = clerks * frames_per_clerk * frame
     finally:
-        # Tear the engine down even on failure: a leftover pump thread
-        # (and a leaked MRT_PUMP_HOT) would contend with / reconfigure
-        # any measurement that follows in this process.
-        svc.stop()
+        # Tear the engine down even on failure (including a failed
+        # build): a leftover pump thread (and a leaked MRT_PUMP_HOT)
+        # would contend with / reconfigure any measurement that
+        # follows in this process.
+        if box.get("svc") is not None:
+            box["svc"].stop()
         sched.stop()
         if saved_hot is None:
             os.environ.pop("MRT_PUMP_HOT", None)

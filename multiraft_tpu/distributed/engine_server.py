@@ -28,6 +28,7 @@ Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
 from __future__ import annotations
 
 import os
+import types as _types
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
@@ -71,25 +72,6 @@ __all__ = [
     "serve_engine_kv",
     "serve_engine_shardkv",
 ]
-
-
-class _FrameRowArgs:
-    """Adapter presenting a firehose frame's rows through the
-    ``args_list[i].client_id/.command_id`` shape
-    :func:`~.engine_durability.await_frame_synced` indexes — so the
-    firehose and batch handlers share ONE durable-ack gate."""
-
-    __slots__ = ("f",)
-
-    def __init__(self, f) -> None:
-        self.f = f
-
-    def __getitem__(self, i):
-        import types
-
-        return types.SimpleNamespace(
-            client_id=self.f.clients_l[i], command_id=self.f.commands_l[i]
-        )
 
 
 class EngineKVService:
@@ -322,9 +304,16 @@ class EngineKVService:
                 ok_rows = {
                     int(r) for r in f.write_rows.tolist() if err[r] == 0
                 }
+                # One row->(client, command) view built per frame: the
+                # gate polls args_list[i] per pending row every 2 ms,
+                # so per-access allocation would sit on the hot path.
+                rows_view = [
+                    _types.SimpleNamespace(client_id=c, command_id=m)
+                    for c, m in zip(f.clients_l, f.commands_l)
+                ]
                 yield from await_frame_synced(
                     self.sched, self._dur, self._write_seqs, ok_rows,
-                    _FrameRowArgs(f), deadline,
+                    rows_view, deadline,
                 )
                 for r in f.write_rows.tolist():
                     if err[r] == 0 and r not in ok_rows:

@@ -29,6 +29,11 @@ def launch_server(spec: dict, label: Any) -> subprocess.Popen:
 
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # server procs never need a chip
+    # Server children never talk to the TPU tunnel: dropping the axon
+    # activation env skips its sitecustomize entirely (measured 1.76 s
+    # -> 0.05 s interpreter startup per child — across the suite's
+    # ~50 children that was ~1.5 min of pure startup).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )

@@ -62,13 +62,30 @@ _PAGE = """<!DOCTYPE html>
  .linpt {{ pointer-events: none; }}
  #tip {{ position: fixed; background: #212121; color: #fff; padding: 4px 8px;
         border-radius: 4px; font-size: 12px; display: none; z-index: 10; }}
+ .legend {{ margin: 0 0 12px; font-size: 12px; color: #444; }}
+ .legend span {{ display: inline-block; margin-right: 16px; }}
+ .sw {{ display: inline-block; width: 14px; height: 11px; border-radius: 2px;
+       vertical-align: -1px; margin-right: 4px; border: 1px solid; }}
+ .sw.lin {{ background: #a5d6a7; border-color: #2e7d32; }}
+ .sw.stuck {{ background: #ef9a9a; border-color: #c62828; }}
+ .sw.plain {{ background: #90caf9; border-color: #1565c0; }}
+ .sw.pt {{ background: #263238; border-color: #263238; border-radius: 50%;
+          width: 11px; }}
+ .jump {{ margin: 2px 0 6px; font-size: 12px; }}
 </style></head><body>
 <h2>Operation history</h2>
 <div class="banner {verdict_class}">{verdict}</div>
 <p class="hint">Numbered dots mark linearization points of the longest
 partial linearization; red bars never linearized within it.  Click a
 bar to show the longest partial that includes that operation; click
-the background to restore the largest.</p>
+the background to restore the largest.  The per-partition selector
+jumps to (and selects) any operation by description.</p>
+<div class="legend">
+ <span><i class="sw lin"></i>linearized in the shown partial</span>
+ <span><i class="sw stuck"></i>not absorbed by it</span>
+ <span><i class="sw plain"></i>unchecked partition</span>
+ <span><i class="sw pt"></i>linearization point (numbered in order)</span>
+</div>
 <div id="tip"></div>
 <div id="content"></div>
 <script>
@@ -89,6 +106,21 @@ for (const part of DATA.partitions) {{
     (part.largest >= 0 ? part.partials[part.largest].length : 0) + '/' +
     part.ops.length) + ')';
   content.appendChild(div);
+  // Jump-to-operation: select an op by description to scroll to it,
+  // select it, and show the longest partial containing it.
+  const jump = document.createElement('select');
+  jump.className = 'jump';
+  const opt0 = document.createElement('option');
+  opt0.textContent = 'jump to operation…';
+  opt0.value = '-1';
+  jump.appendChild(opt0);
+  part.ops.forEach((op, i) => {{
+    const o = document.createElement('option');
+    o.value = String(i);
+    o.textContent = '#' + i + '  ' + op.desc;
+    jump.appendChild(o);
+  }});
+  content.appendChild(jump);
   const clients = [...new Set(part.ops.map(o => o.client))].sort((a,b)=>a-b);
   const rowH = 26, pad = 44, width = 1100;
   const t0 = Math.min(...part.ops.map(o => o.call));
@@ -189,6 +221,14 @@ for (const part of DATA.partitions) {{
   }}
   showPartial(part.largest, -1);
   document.body.addEventListener('click', () => showPartial(part.largest, -1));
+  jump.addEventListener('click', ev => ev.stopPropagation());
+  jump.addEventListener('change', ev => {{
+    ev.stopPropagation();
+    const i = parseInt(jump.value, 10);
+    if (i < 0) return;
+    showPartial(part.op_partial[i] >= 0 ? part.op_partial[i] : part.largest, i);
+    opEls[i].scrollIntoView({{ block: 'center', behavior: 'smooth' }});
+  }});
   content.appendChild(svg);
 }}
 </script></body></html>
