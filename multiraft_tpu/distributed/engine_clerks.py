@@ -16,6 +16,7 @@ from .engine_wire import OK, EngineCmdArgs
 __all__ = [
     "EngineClerk",
     "FirehoseClerk",
+    "ShardFirehoseClerk",
     "PipelinedClerk",
     "EngineShardNetClerk",
     "EngineFleetClerk",
@@ -212,6 +213,163 @@ class FirehoseClerk(EngineClerk):
                 f"{len(todo)} rows unresolved after {deadline_s}s"
             )
         return values
+
+
+class ShardFirehoseClerk:
+    """Columnar clerk for the SHARDED fleet: each round partitions its
+    rows by owning gid (key→shard→gid from the replicated config) and
+    ships ONE firehose blob per process; WRONG_GROUP rows refresh the
+    config and re-route; RETRY rows resubmit under the same command
+    ids (per-shard dedup travels with the shard, so the retry stays
+    exactly-once across migrations).
+
+    Order safety: within a round at most ONE write per shard is in
+    flight from this clerk, and a shard's ops never reorder (a
+    deferred op defers everything after it on that shard).  A
+    pipelined same-shard chain could otherwise invert across an
+    away-and-back migration — op N bounces WRONG_GROUP while N+1
+    applies, and N's retry dedup-swallows into a false OK (the hazard
+    the per-op fleet clerk's serial chains guard, engine_shard_server.
+    batch).  Cross-shard rows keep full columnar parallelism."""
+
+    from ..engine.firehose import MAX_FIREHOSE_ROWS as MAX_FRAME
+
+    def __init__(self, sched, ends_by_gid: dict) -> None:
+        self.sched = sched
+        self.ends = dict(ends_by_gid)
+        self._all = list(dict.fromkeys(self.ends.values()))
+        self.client_id = unique_client_id(next(EngineClerk._next))
+        self.command_id = 0
+        self._cfg = None
+
+    def _refresh_config(self, deadline):
+        while True:
+            if self.sched.now >= deadline:
+                raise TimeoutError("config fetch exceeded deadline")
+            for end in self._all:
+                fut: Future = end.call("EngineShardKV.config", None)
+                reply = yield self.sched.with_timeout(fut, 3.5)
+                if reply is not None and reply is not TIMEOUT:
+                    self._cfg = reply
+                    return reply
+            yield self.sched.sleep(0.05)
+
+    def run_batch(self, ops, deadline_s: float = 60.0):
+        """ops = [(op, key, value), ...] → list of values in order.
+        Generator (spawn on the scheduler)."""
+        import numpy as np
+
+        from ..engine.firehose import (
+            FH_NO_KEY,
+            FH_OK,
+            FH_WRONG_GROUP,
+            pack_request,
+            unpack_reply,
+        )
+        from ..services.shardkv import key2shard
+        from .engine_wire import _OPCODE
+
+        n = len(ops)
+        rows = []
+        for op, key, value in ops:
+            cmd = 0
+            if op != "Get":
+                self.command_id += 1
+                cmd = self.command_id
+            rows.append((op, key, value, cmd))
+        shards = [key2shard(key) for _, key, _, _ in rows]
+        results = [""] * n
+        done = [False] * n
+        deadline = self.sched.now + deadline_s
+        remaining = list(range(n))
+        while remaining:
+            if self.sched.now >= deadline:
+                raise TimeoutError(
+                    f"{len(remaining)} rows unresolved after {deadline_s}s"
+                )
+            # ROUND: program-order prefix per shard — one in-flight
+            # write per shard; a deferred op defers everything after
+            # it on that shard.
+            taken = []
+            write_taken: set = set()
+            deferred: set = set()
+            for i in remaining:
+                sh = shards[i]
+                if sh in deferred:
+                    continue
+                if rows[i][0] != "Get":
+                    if sh in write_taken:
+                        deferred.add(sh)
+                        continue
+                    write_taken.add(sh)
+                taken.append(i)
+                if len(taken) >= self.MAX_FRAME:
+                    break
+            todo = list(taken)
+            while todo and self.sched.now < deadline:
+                cfg = self._cfg
+                if cfg is None:
+                    cfg = yield from self._refresh_config(deadline)
+                by_end: dict = {}
+                retry = []
+                unrouted = 0
+                for i in todo:
+                    gid = cfg[1][shards[i]]
+                    end = self.ends.get(gid)
+                    if end is None:
+                        # Shard unassigned (gid 0) or owned by a
+                        # process we have no end for: wait for the
+                        # config to move — re-query, don't spin.
+                        unrouted += 1
+                        retry.append(i)
+                    else:
+                        by_end.setdefault(end, []).append((i, gid))
+                if unrouted:
+                    self._cfg = None
+                    yield self.sched.sleep(0.02)
+                flights = []
+                for end, members in by_end.items():
+                    idxs = [i for i, _ in members]
+                    blob = pack_request(
+                        np.array([_OPCODE[rows[i][0]] for i in idxs],
+                                 np.uint8),
+                        np.array([g for _, g in members], np.uint32),
+                        np.full(len(idxs), self.client_id, np.uint64),
+                        np.array([rows[i][3] for i in idxs], np.uint64),
+                        [rows[i][1].encode() for i in idxs],
+                        [rows[i][2].encode() for i in idxs],
+                    )
+                    flights.append(
+                        (idxs, end.call("EngineShardKV.firehose", blob))
+                    )
+                for idxs, fut in flights:
+                    reply = yield self.sched.with_timeout(fut, 10.0)
+                    if reply is None or reply is TIMEOUT:
+                        retry.extend(idxs)
+                        continue
+                    if (
+                        isinstance(reply, tuple)
+                        and reply
+                        and reply[0] == "err"
+                    ):
+                        raise ValueError(reply[1])
+                    err, vals = unpack_reply(reply)
+                    for j, i in enumerate(idxs):
+                        if err[j] == FH_OK:
+                            done[i] = True
+                            results[i] = vals[j]
+                        elif err[j] == FH_NO_KEY:
+                            done[i] = True
+                            results[i] = ""
+                        else:
+                            if err[j] == FH_WRONG_GROUP:
+                                self._cfg = None  # routing moved
+                            retry.append(i)
+                if retry and self._cfg is None:
+                    yield self.sched.sleep(0.02)
+                todo = sorted(retry)
+            remaining = [i for i in remaining if not done[i]]
+        return results
 
 
 class EngineShardNetClerk(EngineClerk):

@@ -108,6 +108,29 @@ def await_frame_synced(sched, dur, write_seqs, ok, args_list, deadline):
         yield 0.002
 
 
+def demote_unsynced_rows(sched, dur, write_seqs, frame, err, deadline):
+    """Firehose form of the frame-ack gate (yield-from inside the
+    handler generator): wait for every OK write ROW's apply-time WAL
+    record to fsync; at the deadline, unsynced rows demote to RETRY in
+    ``err`` — never a false durable ack.  Shared by the plain and
+    sharded firehose handlers so the protocol lives once."""
+    import types as _types
+
+    ok_rows = {int(r) for r in frame.write_rows.tolist() if err[r] == 0}
+    rows_view = [
+        _types.SimpleNamespace(client_id=c, command_id=m)
+        for c, m in zip(frame.clients_l, frame.commands_l)
+    ]
+    yield from await_frame_synced(
+        sched, dur, write_seqs, ok_rows, rows_view, deadline
+    )
+    from ..engine.firehose import FH_RETRY
+
+    for r in frame.write_rows.tolist():
+        if err[r] == 0 and r not in ok_rows:
+            err[r] = FH_RETRY
+
+
 def replay_kv_wal(kv, dur, G: int) -> int:
     """Re-submit every plain-KV WAL record through consensus (recovery
     path; runs to completion before the server starts answering).

@@ -28,7 +28,6 @@ Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
 from __future__ import annotations
 
 import os
-import types as _types
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
@@ -39,6 +38,7 @@ from ..porcupine.kv import OP_GET
 from .engine_durability import (
     EngineDurability,
     await_frame_synced,
+    demote_unsynced_rows,
     replay_kv_wal,
 )
 from .engine_wire import (
@@ -65,6 +65,7 @@ __all__ = [
     "EngineShardKVService",
     "EngineClerk",
     "FirehoseClerk",
+    "ShardFirehoseClerk",
     "PipelinedClerk",
     "PipelinedFleetClerk",
     "EngineShardNetClerk",
@@ -296,28 +297,13 @@ class EngineKVService:
                 # fail them so the client's retry frame carries the
                 # gets together with the retried writes.
                 err[f.ops == 0] = FH_RETRY
-            # Durable mode: gate OK acks on the apply-time WAL records
-            # being fsynced — the SAME shared gate the batch path uses
-            # (never a false durable ack; unsynced rows demote to
-            # RETRY at the deadline).
+            # Durable mode: the shared firehose ack gate (never a
+            # false durable ack; unsynced rows demote to RETRY).
             if self._dur is not None:
-                ok_rows = {
-                    int(r) for r in f.write_rows.tolist() if err[r] == 0
-                }
-                # One row->(client, command) view built per frame: the
-                # gate polls args_list[i] per pending row every 2 ms,
-                # so per-access allocation would sit on the hot path.
-                rows_view = [
-                    _types.SimpleNamespace(client_id=c, command_id=m)
-                    for c, m in zip(f.clients_l, f.commands_l)
-                ]
-                yield from await_frame_synced(
-                    self.sched, self._dur, self._write_seqs, ok_rows,
-                    rows_view, deadline,
+                yield from demote_unsynced_rows(
+                    self.sched, self._dur, self._write_seqs, f, err,
+                    deadline,
                 )
-                for r in f.write_rows.tolist():
-                    if err[r] == 0 and r not in ok_rows:
-                        err[r] = FH_RETRY
             # Gets answer at frame completion from the applied state
             # (read-after-own-frame-writes, like the batch path).
             values = [b""] * len(f)
@@ -469,6 +455,7 @@ from .engine_clerks import (  # noqa: E402,F401
     EngineClerk,
     EngineFleetClerk,
     FirehoseClerk,
+    ShardFirehoseClerk,
     EngineShardNetClerk,
     PipelinedClerk,
     PipelinedFleetClerk,

@@ -18,12 +18,14 @@ import os
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
+from ..engine.firehose import MAX_FIREHOSE_ROWS
 from ..engine.host import EngineDriver
 from ..sim.scheduler import TIMEOUT
 from .engine_durability import (
     EngineDurability,
     ShardWalReplay,
     await_frame_synced,
+    demote_unsynced_rows,
 )
 from .engine_wire import (
     ERR_TIMEOUT,
@@ -277,6 +279,75 @@ class EngineShardKVService:
             list(cfg.shards),
             {g: list(v) for g, v in cfg.groups.items()},
         )
+
+    # Shared wire-level frame cap (clerks split on the same constant).
+    MAX_FIREHOSE = MAX_FIREHOSE_ROWS
+
+    def firehose(self, blob):
+        """Columnar frame for the sharded service (engine/firehose.py):
+        the group column carries GLOBAL gids; ownership re-checks at
+        apply produce per-row WRONG_GROUP outcomes the client re-routes
+        after a config refresh.  Gets answer from the applied frontier
+        (get_fast's ownership-gated ReadIndex) at frame completion —
+        but a get whose shard had a NON-OK write row in this frame
+        mirrors that row's outcome instead, preserving
+        read-after-own-frame-writes under migration."""
+        import numpy as np
+
+        from ..engine.firehose import (
+            FH_NO_KEY,
+            FH_OK,
+            FH_RETRY,
+            FH_WRONG_GROUP,
+            pack_reply,
+        )
+        from ..engine.shardkv import ERR_NO_KEY, ERR_WRONG_GROUP, OK
+        from ..services.shardkv import key2shard
+
+        def run():
+            raw = bytes(blob)
+            if len(raw) < 4:
+                return ("err", "ErrMalformedFrame")
+            n = int(np.frombuffer(raw, np.dtype("<u4"), 1, 0)[0])
+            if n > self.MAX_FIREHOSE:
+                return ("err", f"ErrFrameTooLarge:{self.MAX_FIREHOSE}")
+            try:
+                f = self.skv.submit_frame(raw)
+            except ValueError as e:
+                return ("err", str(e))
+            deadline = self.sched.now + self.DEADLINE_S
+            while not f.done and self.sched.now < deadline:
+                yield 0.002
+            err = f.err.copy()
+            # Durable mode: the shared firehose ack gate.
+            if self._dur is not None:
+                yield from demote_unsynced_rows(
+                    self.sched, self._dur, self._write_seqs, f, err,
+                    deadline,
+                )
+            # Shards whose write rows did not land OK: gets there mirror
+            # the write outcome so the client re-frames them together.
+            bad_shard_err: dict = {}
+            for r in f.write_rows.tolist():
+                if err[r] != FH_OK:
+                    bad_shard_err[key2shard(f.keys[r])] = int(err[r])
+            values = [b""] * len(f)
+            for r in np.nonzero(f.ops == 0)[0].tolist():
+                shard = key2shard(f.keys[r])
+                if shard in bad_shard_err:
+                    err[r] = bad_shard_err[shard]
+                    continue
+                t = self.skv.get_fast(f.keys[r])
+                if t.err == ERR_WRONG_GROUP:
+                    err[r] = FH_WRONG_GROUP
+                elif t.err == ERR_NO_KEY:
+                    err[r] = FH_NO_KEY
+                else:
+                    err[r] = FH_OK
+                    values[r] = t.value.encode()
+            return pack_reply(err, values)
+
+        return run()
 
     def stop(self) -> None:
         self._stopped = True

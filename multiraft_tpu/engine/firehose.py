@@ -46,6 +46,9 @@ __all__ = [
     "FH_OK",
     "FH_RETRY",
     "FH_TIMEOUT",
+    "FH_WRONG_GROUP",
+    "FH_NO_KEY",
+    "MAX_FIREHOSE_ROWS",
     "pack_request",
     "unpack_request",
     "pack_reply",
@@ -56,6 +59,14 @@ __all__ = [
 FH_OK = 0
 FH_RETRY = 1
 FH_TIMEOUT = 2
+# Sharded service only: the row's shard is not served by the addressed
+# replica group under the config its apply saw — the client re-queries
+# the config and re-routes (reference semantics: shardkv ErrWrongGroup,
+# shardkv/common.go:12-18).
+FH_WRONG_GROUP = 3
+# Sharded Get of an absent key (reference: ErrNoKey) — distinct from
+# the plain-KV convention of empty-string reads.
+FH_NO_KEY = 4
 
 # Largest row count one firehose frame may carry — the ONE limit both
 # the server (EngineKVService.MAX_FIREHOSE) and the clerks
@@ -195,4 +206,11 @@ class FirehoseFrame:
 
     def rows_failed(self, rows: np.ndarray) -> None:
         self.err[rows] = FH_RETRY
+        self.pending_writes -= len(rows)
+
+    def rows_done(self, rows: np.ndarray, errs: np.ndarray) -> None:
+        """Resolve rows with MIXED outcomes (the sharded apply path:
+        some rows OK, some ErrWrongGroup under the config their apply
+        saw)."""
+        self.err[rows] = errs
         self.pending_writes -= len(rows)

@@ -56,8 +56,9 @@ from ..services.shardkv import (
     SERVING,
     key2shard,
 )
+from .firehose import FH_OK, FH_WRONG_GROUP, FirehoseFrame
 from .frontier import FrontierService
-from .host import EngineDriver
+from .host import EngineDriver, PayloadSlice
 
 __all__ = [
     "ShardTicket",
@@ -488,10 +489,94 @@ class BatchedShardKV(FrontierService):
             self._orchestrate()
 
     def _on_evicted(self, payload: Any) -> None:
+        if isinstance(payload, PayloadSlice):
+            # Firehose rows that lost their slots: the CLIENT retries
+            # them (row-level RETRY errs; per-shard session dedup keeps
+            # the retry exactly-once even across a migration, because
+            # the dedup tables travel with the shard).
+            payload.frame.rows_failed(payload.rows)
+            return
         t = getattr(payload, "ticket", None)
         if t is not None and not t.done:
             t.done = True
             t.failed = True
+
+    # -- columnar firehose (engine/firehose.py) --------------------------
+
+    def submit_frame(self, blob: bytes) -> FirehoseFrame:
+        """Columnar frame for the SHARDED service: the ``group`` column
+        carries GLOBAL gids (the client routes key→shard→gid from its
+        config, reference clerk loop shardkv/client.go:68-129); rows
+        addressed to a gid this instance does not host resolve
+        immediately as WRONG_GROUP (the client re-queries the config
+        and re-routes).  Write rows enter each local group's log as
+        contiguous runs; ownership is re-checked per row AT APPLY TIME
+        (`_apply_slice`), exactly like the per-op path."""
+        f = FirehoseFrame(blob, self.driver.tick)
+        wr = f.write_rows
+        if not len(wr):
+            return f
+        gids = f.groups[wr]
+        local = np.full(len(gids), -1, np.int64)
+        for gid, loc in self._g2l.items():
+            local[gids == gid] = loc
+        bad = wr[local < 0]
+        if len(bad):
+            f.rows_done(bad, np.full(len(bad), FH_WRONG_GROUP, np.uint8))
+        good_rows = wr[local >= 0]
+        good_local = local[local >= 0]
+        if not len(good_rows):
+            return f
+        order = np.argsort(good_local, kind="stable")
+        rows_sorted = good_rows[order]
+        gs = good_local[order]
+        bounds = np.nonzero(np.diff(gs))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(gs)]])
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            self.driver.start_run(int(gs[s]), f, rows_sorted[s:e])
+        return f
+
+    def _apply_slice(self, g: int, idx: int, sl, now: int) -> None:
+        """Bulk apply of one committed firehose slice to a replica
+        group: per row the kvraft-with-shards semantics (ownership
+        gate + per-shard dup table + mutate — `_apply_client`);
+        everything around them per-slice."""
+        assert g != 0, "the config RSM's log never carries firehose rows"
+        f = sl.frame
+        rep = self.reps[self._l2g[g]]
+        errs = np.empty(len(sl.rows), np.uint8)
+        ops_l = f.ops_l
+        keys = f.keys
+        vals = f.vals
+        clients_l = f.clients_l
+        commands_l = f.commands_l
+        on_write = self.on_write
+        for j, r in enumerate(sl.rows.tolist()):
+            key = keys[r]
+            shard = key2shard(key)
+            if not rep.can_serve(shard):
+                errs[j] = FH_WRONG_GROUP
+                continue
+            sh = rep.shards[shard]
+            cid = clients_l[r]
+            cmd = commands_l[r]
+            if cmd > 0 and sh.latest.get(cid, -1) >= cmd:
+                errs[j] = FH_OK  # duplicate write: already applied
+                continue
+            if ops_l[r] == OP_PUT:
+                sh.data[key] = vals[r]
+            else:
+                sh.data[key] = sh.data.get(key, "") + vals[r]
+            if cmd > 0:
+                sh.latest[cid] = cmd
+            if on_write is not None:
+                on_write(rep.gid, _ClientOp(
+                    op=PUT if ops_l[r] == OP_PUT else APPEND,
+                    key=key, value=vals[r], client_id=cid, command_id=cmd,
+                ))
+            errs[j] = FH_OK
+        f.rows_done(sl.rows, errs)
 
     # -- apply path --------------------------------------------------------
 

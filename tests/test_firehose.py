@@ -320,6 +320,158 @@ def test_firehose_durable_acks_survive_kill(tmp_path):
         cluster.shutdown()
 
 
+def _shard_frame(rows):
+    """rows: list of (op_code, gid, key, value, client_id, command_id)."""
+    return pack_request(
+        np.array([r[0] for r in rows], np.uint8),
+        np.array([r[1] for r in rows], np.uint32),
+        np.array([r[4] for r in rows], np.uint64),
+        np.array([r[5] for r in rows], np.uint64),
+        [r[2].encode() for r in rows],
+        [r[3].encode() for r in rows],
+    )
+
+
+def test_shard_frame_ownership_dedup_and_migration():
+    """Sharded firehose at the engine level: rows apply under the
+    ownership gate, unknown gids bounce WRONG_GROUP immediately, a
+    full-frame retry is exactly-once, and rows addressed to the OLD
+    owner after a migration bounce WRONG_GROUP at apply — then land
+    at the new owner with dedup intact."""
+    from multiraft_tpu.engine.core import EngineConfig
+    from multiraft_tpu.engine.firehose import FH_WRONG_GROUP
+    from multiraft_tpu.engine.shardkv import BatchedShardKV
+    from multiraft_tpu.services.shardkv import key2shard
+
+    cfg = EngineConfig(G=3, P=3, L=64, E=8, INGEST=8)
+    driver = EngineDriver(cfg, seed=11)
+    assert driver.run_until_quiet_leaders(1000)
+    skv = BatchedShardKV(driver)
+    skv.admin_sync("join", {1: ["s1"]})
+
+    key = "fkey"
+    rows = [(OP_APPEND, 1, key, f"[{i}]", 7, i + 1) for i in range(12)]
+    rows.append((OP_PUT, 9, "other", "x", 8, 1))  # unknown gid
+    f = skv.submit_frame(_shard_frame(rows))
+    # The unknown-gid row resolves instantly.
+    assert f.err[12] == FH_WRONG_GROUP
+    for _ in range(300):
+        skv.pump(1)
+        if f.done:
+            break
+    assert f.done
+    want = "".join(f"[{i}]" for i in range(12))
+    shard = key2shard(key)
+    assert skv.reps[1].shards[shard].data[key] == want
+    assert (f.err[:12] == FH_OK).all()
+
+    # Full-frame retry: dedup swallows every row.
+    f2 = skv.submit_frame(_shard_frame(rows))
+    for _ in range(300):
+        skv.pump(1)
+        if f2.done:
+            break
+    assert f2.done and (f2.err[:12] == FH_OK).all()
+    assert skv.reps[1].shards[shard].data[key] == want
+
+    # Migrate shards to a second gid; rows addressed to the OLD owner
+    # for a moved shard must bounce WRONG_GROUP at apply, then land at
+    # the new owner under the SAME command ids (dedup travels with the
+    # shard).
+    skv.admin_sync("join", {2: ["s2"]})
+    _settle_shards(skv)
+    cfg_now = skv.query_latest()
+    moved = next(s for s in range(len(cfg_now.shards))
+                 if cfg_now.shards[s] == 2)
+    mkey = next(
+        chr(c) for c in range(32, 127) if key2shard(chr(c)) == moved
+    )
+    rows3 = [(OP_APPEND, 1, mkey, "[a]", 9, 1)]  # stale routing: gid 1
+    f3 = skv.submit_frame(_shard_frame(rows3))
+    for _ in range(300):
+        skv.pump(1)
+        if f3.done:
+            break
+    assert f3.done and f3.err[0] == FH_WRONG_GROUP
+
+    rows4 = [(OP_APPEND, 2, mkey, "[a]", 9, 1)]  # re-routed
+    f4 = skv.submit_frame(_shard_frame(rows4))
+    for _ in range(300):
+        skv.pump(1)
+        if f4.done:
+            break
+    assert f4.done and f4.err[0] == FH_OK
+    assert skv.reps[2].shards[moved].data[mkey] == "[a]"
+    # Retry after success: dedup-swallowed, no double apply.
+    f5 = skv.submit_frame(_shard_frame(rows4))
+    for _ in range(300):
+        skv.pump(1)
+        if f5.done:
+            break
+    assert f5.done and f5.err[0] == FH_OK
+    assert skv.reps[2].shards[moved].data[mkey] == "[a]"
+
+
+def _settle_shards(skv, max_ticks=4000):
+    from multiraft_tpu.services.shardkv import SERVING
+
+    target = skv.query_latest().num
+    for _ in range(0, max_ticks, 5):
+        skv.pump(5)
+        reps = [skv.reps[g] for g in skv.query_latest().groups]
+        if reps and all(
+            r.cur.num == target
+            and all(sh.state == SERVING for sh in r.shards.values())
+            for r in reps
+        ):
+            return
+    raise TimeoutError(f"cluster did not settle at config {target}")
+
+
+def test_shard_firehose_fleet_over_sockets():
+    """The sharded columnar path END TO END: a two-process fleet, a
+    ShardFirehoseClerk routing rows by config, a join-driven
+    cross-process migration mid-stream, WRONG_GROUP re-routing, and
+    exactly-once retries — every write readable afterwards."""
+    from multiraft_tpu.distributed.cluster import EngineFleetCluster
+    from multiraft_tpu.distributed.engine_server import ShardFirehoseClerk
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+
+    fleet = EngineFleetCluster([[1], [2]], seed=31)
+    cli = None
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        cli = RpcNode()
+        sched = cli.sched
+        ends = {
+            g: cli.client_end(*addr)
+            for g, addr in fleet.owner_addrs.items()
+        }
+        ck = ShardFirehoseClerk(sched, ends)
+
+        keys = [chr(c) for c in range(97, 117)]  # 20 keys, many shards
+        ops = [("Put", k, f"v-{k}") for k in keys]
+        ops += [("Append", k, "+1") for k in keys]
+        out = sched.wait(sched.spawn(ck.run_batch(ops)), 120.0)
+        assert out is not TIMEOUT
+
+        # gid 2 joins: ~half the shards migrate to the other PROCESS.
+        fleet.admin("join", [2])
+        ops2 = [("Append", k, "+2") for k in keys]
+        ops2 += [("Get", k, "") for k in keys]
+        out2 = sched.wait(sched.spawn(ck.run_batch(ops2)), 180.0)
+        assert out2 is not TIMEOUT
+        for j, k in enumerate(keys):
+            got = out2[len(keys) + j]
+            assert got == f"v-{k}+1+2", f"{k}: {got!r}"
+    finally:
+        if cli is not None:
+            cli.close()
+        fleet.shutdown()
+
+
 def test_firehose_inprocess_bench_smoke():
     """The serving-throughput firehose rig at tiny shapes: every op
     resolves OK and the JSON schema holds."""
