@@ -1,0 +1,243 @@
+"""Deterministic fault injection for the real TCP transport.
+
+The reference stack earns its correctness claims from a fault-injecting
+network (labrpc: dropped requests, dropped replies, long delays,
+reordering — mirrored for the sim backend in transport/network.py);
+this module brings the same fault model to the deployment path.  A
+:class:`ChaosState` hangs off an :class:`~.tcp.RpcNode` (``node.chaos``)
+and is consulted at the node's three traffic points:
+
+* **outbound requests** (``RpcNode._call``) — per-destination rules
+  plus a catch-all, so one process pair can be partitioned
+  asymmetrically while the rest of the fleet talks normally.  A
+  dropped/blocked request leaves the caller's future unresolved
+  forever: labrpc's "server never heard it" semantics — the caller's
+  own ``with_timeout`` fires and its retry loop takes over.
+* **inbound frames** (``RpcNode._on_event``) — one rule for everything
+  arriving at this process (requests AND the replies to its own
+  outbound calls), so "isolate this server" is a single rule.
+* **outbound replies** (``RpcNode._dispatch``'s reply path) — labrpc's
+  reply-drop case: the handler RAN (the op may have applied) but the
+  caller never learns; only session dedup keeps the retry
+  exactly-once.  This is the fault class that actually catches dedup
+  bugs.
+
+Delays reschedule the frame on the node's own scheduler loop (labrpc's
+short/long delay cases, including reordering: two delayed frames may
+fire out of order).  All randomness comes from one seeded
+``random.Random`` so a fixed seed plus a fixed traffic sequence makes
+the per-frame coin flips reproducible; the *schedule* of fault windows
+(what the nemesis reconfigures and when) is seeded separately in
+harness/nemesis.py and is exactly reproducible.
+
+**Control plane**: :class:`ChaosControl` is a normal RPC service
+registered as ``"Chaos"``, so a live fleet is reconfigured over the
+same sockets it serves on.  Frames whose ``svc_meth`` starts with
+``"Chaos."`` are EXEMPT from inbound and reply chaos (and the nemesis
+node carries no chaos of its own), so the harness can always heal a
+partitioned fleet — a chaos layer that can partition away its own
+antidote wedges the test run, not the system under test.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["ChaosRule", "ChaosState", "ChaosControl", "install_chaos"]
+
+# Decision verbs returned by ChaosState.decide_*: the frame proceeds,
+# vanishes, or proceeds after a delay (seconds).
+PASS = "pass"
+DROP = "drop"
+
+
+class ChaosRule:
+    """One edge's fault mix: independent drop/delay probabilities and a
+    hard ``block`` (the partition case — every frame vanishes).
+
+    ``delay_min``/``delay_max`` bound the uniform delay draw; labrpc's
+    two regimes map to (0, 0.027) for "unreliable" jitter and (0, 7.0)
+    for long-delay drops of requests to dead servers."""
+
+    __slots__ = ("drop", "delay", "delay_min", "delay_max", "block")
+
+    def __init__(
+        self,
+        drop: float = 0.0,
+        delay: float = 0.0,
+        delay_min: float = 0.0,
+        delay_max: float = 0.0,
+        block: bool = False,
+    ) -> None:
+        self.drop = float(drop)
+        self.delay = float(delay)
+        self.delay_min = float(delay_min)
+        self.delay_max = float(delay_max)
+        self.block = bool(block)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "drop": self.drop, "delay": self.delay,
+            "delay_min": self.delay_min, "delay_max": self.delay_max,
+            "block": self.block,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, Any]) -> "ChaosRule":
+        return cls(
+            drop=d.get("drop", 0.0),
+            delay=d.get("delay", 0.0),
+            delay_min=d.get("delay_min", 0.0),
+            delay_max=d.get("delay_max", 0.0),
+            block=d.get("block", False),
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"ChaosRule({self.to_wire()})"
+
+
+class ChaosState:
+    """The per-node fault configuration + seeded RNG.
+
+    Rules (any may be ``None`` = no faults on that path):
+
+    * ``peer_out[(host, port)]`` — outbound requests to that address;
+    * ``all_out`` — outbound requests to addresses with no peer rule;
+    * ``all_in`` — every non-exempt inbound frame;
+    * ``reply`` — every non-exempt outbound reply.
+
+    The RNG is lock-guarded: outbound calls may originate on any
+    thread (blocking facades call from their own threads), while
+    inbound/reply decisions run on the node's loop thread.
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.peer_out: Dict[Tuple[str, int], ChaosRule] = {}
+        self.all_out: Optional[ChaosRule] = None
+        self.all_in: Optional[ChaosRule] = None
+        self.reply: Optional[ChaosRule] = None
+        # Counters for test assertions / postmortems (best-effort, no
+        # lock beyond the RNG's — increments race benignly).
+        self.dropped = 0
+        self.delayed = 0
+
+    # -- decisions ---------------------------------------------------------
+
+    def _decide(self, rule: Optional[ChaosRule]):
+        if rule is None:
+            return PASS
+        if rule.block:
+            self.dropped += 1
+            return DROP
+        with self._lock:
+            if rule.drop > 0.0 and self._rng.random() < rule.drop:
+                self.dropped += 1
+                return DROP
+            if rule.delay > 0.0 and self._rng.random() < rule.delay:
+                t = self._rng.uniform(rule.delay_min, rule.delay_max)
+                self.delayed += 1
+                return t
+        return PASS
+
+    def decide_out(self, addr: Tuple[str, int]):
+        return self._decide(self.peer_out.get(addr, self.all_out))
+
+    def decide_in(self):
+        return self._decide(self.all_in)
+
+    def decide_reply(self):
+        return self._decide(self.reply)
+
+    # -- reconfiguration (full-state, idempotent) --------------------------
+
+    def configure(self, wire: Dict[str, Any]) -> None:
+        """Replace the whole rule set from its wire form (plain dicts —
+        nothing here needs codec registration).  Full-state replace
+        rather than incremental edits: a lost or duplicated control RPC
+        then cannot leave the node in a half-configured state."""
+        peers = {}
+        for name, rd in (wire.get("peers") or {}).items():
+            host, port = name.rsplit(":", 1)
+            peers[(host, int(port))] = ChaosRule.from_wire(rd)
+        self.peer_out = peers
+        self.all_out = (
+            ChaosRule.from_wire(wire["all_out"])
+            if wire.get("all_out") else None
+        )
+        self.all_in = (
+            ChaosRule.from_wire(wire["all_in"])
+            if wire.get("all_in") else None
+        )
+        self.reply = (
+            ChaosRule.from_wire(wire["reply"]) if wire.get("reply") else None
+        )
+
+    def clear(self) -> None:
+        self.peer_out = {}
+        self.all_out = self.all_in = self.reply = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "peers": {
+                f"{h}:{p}": r.to_wire()
+                for (h, p), r in self.peer_out.items()
+            },
+            "all_out": self.all_out.to_wire() if self.all_out else None,
+            "all_in": self.all_in.to_wire() if self.all_in else None,
+            "reply": self.reply.to_wire() if self.reply else None,
+            "dropped": self.dropped,
+            "delayed": self.delayed,
+        }
+
+
+class ChaosControl:
+    """The ``"Chaos"`` RPC service: live-fleet reconfiguration.
+
+    Handlers run on the node's loop thread (every RPC does), so rule
+    swaps are ordered against frame decisions without extra locking.
+    All payloads are plain dicts/tuples — codec-safe unregistered."""
+
+    def __init__(self, node, state: ChaosState) -> None:
+        self._node = node
+        self._state = state
+
+    def ping(self, _args=None) -> str:
+        return "pong"
+
+    def set_rules(self, wire) -> dict:
+        self._state.configure(dict(wire or {}))
+        return self._state.snapshot()
+
+    def clear(self, _args=None) -> dict:
+        self._state.clear()
+        return self._state.snapshot()
+
+    def sever(self, args=None) -> int:
+        """Close live connections mid-stream (both directions see a
+        reset; in-flight calls on them fail).  ``args`` may be
+        ``[host, port]`` to sever one outbound edge, else every
+        connection this node knows about is cut."""
+        addr = None
+        if args:
+            addr = (args[0], int(args[1]))
+        return self._node.sever(
+            addr, exclude=getattr(self._node, "_cur_conn", None)
+        )
+
+    def stats(self, _args=None) -> dict:
+        return self._state.snapshot()
+
+
+def install_chaos(node, seed: int = 0) -> ChaosState:
+    """Attach a seeded :class:`ChaosState` to ``node`` and register the
+    ``"Chaos"`` control service on it.  Idempotent per node (the last
+    install wins)."""
+    state = ChaosState(seed)
+    node.add_service("Chaos", ChaosControl(node, state))
+    node.chaos = state
+    return state

@@ -166,6 +166,14 @@ def _pin_platform(spec: dict) -> None:
 def _server_main() -> None:  # pragma: no cover - subprocess entry
     import json
 
+    # Before the first jit: server processes share one persistent
+    # compilation cache and may be SIGKILLed at any point (crash
+    # tests, the nemesis) — upstream's in-place cache write lets a
+    # torn entry segfault the next reader (utils/jaxcache.py).
+    from ..utils.jaxcache import harden_persistent_cache
+
+    harden_persistent_cache()
+
     spec = json.loads(sys.argv[2])
     kind = spec.get("kind", "kv")
     if kind == "kv":
@@ -270,6 +278,13 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
         )
     else:
         raise ValueError(f"unknown server kind {kind!r}")
+    if spec.get("chaos_seed") is not None:
+        # Fault-injection hooks + the "Chaos" control RPC, for every
+        # server kind — the nemesis harness reconfigures the live
+        # fleet over the same sockets it serves on (chaos.py).
+        from .chaos import install_chaos
+
+        install_chaos(node, int(spec["chaos_seed"]))
     print(f"ready {node.port}", flush=True)
     while True:
         time.sleep(3600)

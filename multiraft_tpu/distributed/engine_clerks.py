@@ -12,6 +12,7 @@ import itertools
 from ..sim.scheduler import TIMEOUT, Future
 from ..utils.ids import unique_client_id
 from .engine_wire import OK, EngineCmdArgs
+from .realtime import Backoff
 
 __all__ = [
     "EngineClerk",
@@ -40,6 +41,10 @@ class EngineClerk:
         self.service = service
         self.client_id = unique_client_id(next(EngineClerk._next))
         self.command_id = 0
+        # Failed calls that fail FAST (connection refused while the
+        # server restarts, a partitioned minority) must not turn the
+        # retry loop into a hot spin against the recovering process.
+        self._backoff = Backoff()
 
     def _command(self, op: str, key: str, value: str = ""):
         if op != "Get":
@@ -56,7 +61,10 @@ class EngineClerk:
                 or reply is TIMEOUT
                 or reply.err != OK
             ):
-                continue  # lost/timed out/old leader: retry (dedup-safe)
+                # lost/timed out/old leader: retry (dedup-safe)
+                yield self._backoff.next_delay()
+                continue
+            self._backoff.reset()
             return reply.value
 
     def get(self, key: str):
@@ -115,7 +123,10 @@ class PipelinedClerk(EngineClerk):
                 or reply is TIMEOUT
                 or any(r.err != OK for r in reply)
             ):
-                continue  # lost/partial frame: retry whole (dedup-safe)
+                # lost/partial frame: retry whole (dedup-safe)
+                yield self._backoff.next_delay()
+                continue
+            self._backoff.reset()
             return [r.value for r in reply]
 
 
@@ -147,6 +158,9 @@ class FirehoseClerk(EngineClerk):
             reply = yield self.sched.with_timeout(fut, 3.5)
             if reply is not None and reply is not TIMEOUT:
                 self._G = int(reply["G"])
+            else:
+                yield self._backoff.next_delay()
+        self._backoff.reset()
         return self._G
 
     def run_batch(self, ops, deadline_s: float = 30.0):
@@ -200,9 +214,12 @@ class FirehoseClerk(EngineClerk):
             fut: Future = self.end.call(f"{self.service}.firehose", blob)
             reply = yield self.sched.with_timeout(fut, 10.0)
             if reply is None or reply is TIMEOUT:
-                continue  # whole frame lost: retry whole (dedup-safe)
+                # whole frame lost: retry whole (dedup-safe)
+                yield self._backoff.next_delay()
+                continue
             if isinstance(reply, tuple) and reply and reply[0] == "err":
                 raise ValueError(reply[1])
+            self._backoff.reset()
             err, row_vals = unpack_reply(reply)
             ok = err == FH_OK
             for j in np.nonzero(ok)[0].tolist():
@@ -241,6 +258,7 @@ class ShardFirehoseClerk:
         self.client_id = unique_client_id(next(EngineClerk._next))
         self.command_id = 0
         self._cfg = None
+        self._backoff = Backoff()
 
     def _refresh_config(self, deadline):
         while True:
@@ -251,8 +269,9 @@ class ShardFirehoseClerk:
                 reply = yield self.sched.with_timeout(fut, 3.5)
                 if reply is not None and reply is not TIMEOUT:
                     self._cfg = reply
+                    self._backoff.reset()
                     return reply
-            yield self.sched.sleep(0.05)
+            yield self._backoff.next_delay()
 
     def run_batch(self, ops, deadline_s: float = 60.0):
         """ops = [(op, key, value), ...] → list of values in order.
@@ -383,6 +402,13 @@ class EngineFleetClerk:
     ErrWrongGroup — the reference clerk loop (shardkv/client.go:68-129)
     where each "group" is a chip-owning process."""
 
+    # Per-fetch budget: one config fetch attempt (cycling every known
+    # process with backoff) is bounded; a caller's retry loop decides
+    # whether to try again.  A fully partitioned clerk then cycles
+    # fetch → backoff → fetch instead of pinning its coroutine inside
+    # an unbounded inner loop.
+    CONFIG_DEADLINE_S = 30.0
+
     def __init__(self, sched, ends_by_gid: dict) -> None:
         self.sched = sched
         self.ends = dict(ends_by_gid)  # gid -> TcpClientEnd
@@ -390,16 +416,22 @@ class EngineFleetClerk:
         self.client_id = unique_client_id(next(EngineClerk._next))
         self.command_id = 0
         self._cfg = None  # cached (num, shards, groups)
+        self._backoff = Backoff()
 
-    def _refresh_config(self):
+    def _refresh_config(self, deadline=None):
+        if deadline is None:
+            deadline = self.sched.now + self.CONFIG_DEADLINE_S
         while True:
+            if self.sched.now >= deadline:
+                raise TimeoutError("config fetch exceeded deadline")
             for end in self._all:
                 fut = end.call("EngineShardKV.config", ())
                 reply = yield self.sched.with_timeout(fut, 2.0)
                 if reply is not None and reply is not TIMEOUT:
                     self._cfg = reply
+                    self._backoff.reset()
                     return reply
-            yield self.sched.sleep(0.05)
+            yield self._backoff.next_delay()
 
     def _command(self, op: str, key: str, value: str = ""):
         from ..engine.shardkv import ERR_WRONG_GROUP
@@ -414,23 +446,32 @@ class EngineFleetClerk:
         while True:
             cfg = self._cfg
             if cfg is None:
-                cfg = yield from self._refresh_config()
+                try:
+                    cfg = yield from self._refresh_config()
+                except TimeoutError:
+                    # Whole fleet unreachable for a full fetch budget:
+                    # back off and re-enter (the blocking facade's own
+                    # deadline bounds the caller).
+                    yield self._backoff.next_delay()
+                    continue
             gid = cfg[1][key2shard(key)]
             end = self.ends.get(gid)
             if end is None:  # unassigned shard / unknown gid: re-query
-                yield self.sched.sleep(0.05)
+                yield self._backoff.next_delay()
                 self._cfg = None
                 continue
             fut = end.call("EngineShardKV.command", args)
             reply = yield self.sched.with_timeout(fut, 3.5)
             if reply is None or reply is TIMEOUT:
                 self._cfg = None
+                yield self._backoff.next_delay()
                 continue  # dropped / wedged: re-route and retry
             if reply.err == OK:
+                self._backoff.reset()
                 return reply.value
             if reply.err == ERR_WRONG_GROUP:
                 self._cfg = None  # stale routing: re-query the config
-            yield self.sched.sleep(0.02)
+            yield self._backoff.next_delay()
 
     def get(self, key: str):
         return self._command("Get", key)
@@ -486,7 +527,11 @@ class PipelinedFleetClerk(EngineFleetClerk):
         while todo:
             cfg = self._cfg
             if cfg is None:
-                cfg = yield from self._refresh_config()
+                try:
+                    cfg = yield from self._refresh_config()
+                except TimeoutError:
+                    yield self._backoff.next_delay()
+                    continue
             by_end: dict = {}
             unrouted = []
             for i in todo:

@@ -65,6 +65,7 @@ class EngineProcessCluster:
         data_dir: Optional[str] = None,
         checkpoint_every_s: float = 30.0,
         mesh_devices: int = 0,
+        chaos_seed: Optional[int] = None,
     ) -> None:
         assert kind in ("engine_kv", "engine_shardkv")
         self.kind = kind
@@ -76,6 +77,10 @@ class EngineProcessCluster:
             "seed": seed,
             "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
         }
+        if chaos_seed is not None:
+            # Fault-injection mode: the server installs chaos hooks +
+            # the "Chaos" control RPC (harness/nemesis.py drives it).
+            self.spec["chaos_seed"] = int(chaos_seed)
         if join_gids is not None:
             self.spec["join_gids"] = list(join_gids)
         if data_dir is not None:
@@ -149,6 +154,7 @@ class _SplitClusterBase:
         delay_elections: Optional[Sequence[int]] = None,
         data_dir: Optional[str] = None,
         snapshot_every_s: float = 30.0,
+        chaos_seed: Optional[int] = None,
     ) -> None:
         from . import engine_server  # noqa: F401  (codec registration)
         from . import split_server  # noqa: F401
@@ -174,6 +180,9 @@ class _SplitClusterBase:
             if data_dir is not None:
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
                 spec["snapshot_every_s"] = snapshot_every_s
+            if chaos_seed is not None:
+                # Distinct per-process streams from one harness seed.
+                spec["chaos_seed"] = int(chaos_seed) + i
             self.specs.append(spec)
         self.durable = data_dir is not None
         self._killed: set = set()
@@ -307,6 +316,7 @@ class EngineFleetCluster:
         data_dir: Optional[str] = None,
         checkpoint_every_s: float = 30.0,
         mesh_devices: int = 0,
+        chaos_seed: Optional[int] = None,
     ) -> None:
         # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
         # codec — admin replies are refused as unregistered otherwise.
@@ -340,6 +350,9 @@ class EngineFleetCluster:
                 # len(gids)+1 engine groups must divide evenly over
                 # mesh_devices (loud error from engine/mesh.py if not).
                 spec["mesh_devices"] = mesh_devices
+            if chaos_seed is not None:
+                # Distinct per-process streams from one harness seed.
+                spec["chaos_seed"] = int(chaos_seed) + i
             self.specs.append(spec)
         self.procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._admin_node: Optional[RpcNode] = None

@@ -291,19 +291,25 @@ class EngineKVService:
             while not f.done and self.sched.now < deadline:
                 yield 0.002
             err = f.err.copy()
-            if not f.done or (err[f.write_rows] != 0).any():
-                # Writes unresolved OR failed: Gets must NOT answer
-                # (they would read before the frame's own writes) —
-                # fail them so the client's retry frame carries the
-                # gets together with the retried writes.
-                err[f.ops == 0] = FH_RETRY
-            # Durable mode: the shared firehose ack gate (never a
-            # false durable ack; unsynced rows demote to RETRY).
+            # Durable mode FIRST: the shared firehose ack gate (never
+            # a false durable ack; unsynced rows demote to RETRY).
+            # Must run before the Get gate below — a write that
+            # applied but missed its fsync deadline is RETRY, and a
+            # Get answering past it would observe state a crash could
+            # still un-happen (the sharded handler orders it the same
+            # way).
             if self._dur is not None:
                 yield from demote_unsynced_rows(
                     self.sched, self._dur, self._write_seqs, f, err,
                     deadline,
                 )
+            if not f.done or (err[f.write_rows] != 0).any():
+                # Writes unresolved, failed, OR demoted: Gets must NOT
+                # answer (they would read before the frame's own
+                # durable writes) — fail them so the client's retry
+                # frame carries the gets together with the retried
+                # writes.
+                err[f.ops == 0] = FH_RETRY
             # Gets answer at frame completion from the applied state
             # (read-after-own-frame-writes, like the batch path).
             values = [b""] * len(f)
@@ -422,6 +428,9 @@ def serve_engine_kv(
         driver.start(0, (KVOp(op=OP_GET, key=""), None))
         for _ in range(8):
             kv.pump(1)
+        # This service routes by key hash; reject firehose frames
+        # whose group column disagrees with it, server-side.
+        kv.route_check = route_group
         dur = (
             EngineDurability(data_dir, driver, kv,
                              checkpoint_every_s=checkpoint_every_s)

@@ -73,6 +73,7 @@ def check_ready(
         remaining = deadline - _time.monotonic()
         if remaining <= 0:
             proc.kill()
+            proc.wait()  # reap — crash/restart loops must not pile zombies
             raise RuntimeError(
                 f"server {label} produced no readiness line within "
                 f"{timeout:.0f}s; killed"
@@ -84,7 +85,13 @@ def check_ready(
             "utf-8", "replace"
         )
         if chunk == "":
-            raise RuntimeError(f"server {label} failed to start: {buf!r}")
+            # EOF: the child is gone.  Reap and report HOW it died —
+            # a negative returncode names the signal (a silent SIGKILL
+            # reads very differently from a clean exit-1).
+            rc = proc.wait()
+            raise RuntimeError(
+                f"server {label} failed to start (exit {rc}): {buf!r}"
+            )
         buf += chunk
         if "\n" in buf:
             line = buf.split("\n", 1)[0]

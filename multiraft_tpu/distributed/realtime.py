@@ -27,7 +27,50 @@ from typing import Any, Callable, Generator, Optional
 from ..sim.scheduler import TIMEOUT, Future, Timer
 from ..utils.cpus import usable_cpus
 
-__all__ = ["RealtimeScheduler", "IoScheduler", "PumpCadence", "service_busy"]
+__all__ = [
+    "RealtimeScheduler",
+    "IoScheduler",
+    "PumpCadence",
+    "Backoff",
+    "service_busy",
+]
+
+
+class Backoff:
+    """Bounded exponential backoff with equal jitter, for clerk retry
+    loops.  Without it, a fast-failing RPC (connection refused while a
+    server restarts, a partitioned minority answering instantly) turns
+    the reference retry loop into a hot spin — thousands of doomed
+    calls per second hammering the exact process trying to recover.
+
+    ``next_delay()`` draws uniformly from ``[cur/2, cur]`` (equal
+    jitter: a floor keeps the loop off the CPU, the random half
+    de-synchronizes clerks that failed together), then doubles ``cur``
+    up to ``cap``.  ``reset()`` on success re-arms the fast first
+    retry."""
+
+    def __init__(
+        self,
+        base: float = 0.02,
+        cap: float = 1.0,
+        factor: float = 2.0,
+        rng: Optional[Any] = None,
+    ) -> None:
+        import random
+
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self._cur = base
+        self._rng = rng if rng is not None else random.Random()
+
+    def next_delay(self) -> float:
+        cur = self._cur
+        self._cur = min(self.cap, cur * self.factor)
+        return cur / 2.0 + self._rng.random() * (cur / 2.0)
+
+    def reset(self) -> None:
+        self._cur = self.base
 
 
 class RealtimeScheduler:

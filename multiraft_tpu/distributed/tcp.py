@@ -24,6 +24,13 @@ reference: kvraft/server.go:56-96) are spawned; the reply ships when
 their future resolves.  A dropped connection resolves all its pending
 calls with ``None`` and the next call reconnects — the client-side
 retry loops (reference: kvraft/client.go:47-71) handle the rest.
+
+Fault injection: when ``self.chaos`` is set (see chaos.py), outbound
+requests, inbound frames, and outbound replies each consult it —
+dropped requests leave the caller's future unresolved (labrpc's lost
+RPC; the caller's own timeout fires), delays reschedule the frame on
+the loop, and ``sever`` cuts live connections mid-stream.  The hot
+path pays one ``is None`` check per frame when chaos is off.
 """
 
 from __future__ import annotations
@@ -36,7 +43,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ..sim.scheduler import Future
 from ..transport import codec
-from .native import EV_CLOSED, EV_FRAME, NativeTransport
+from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
 from .realtime import IoScheduler
 
 __all__ = ["RpcNode", "TcpClientEnd"]
@@ -74,7 +81,10 @@ class RpcNode:
         self._lock = threading.Lock()
         self._pending: Dict[int, Tuple[int, Future]] = {}  # req_id → (conn, fut)
         self._conns: Dict[Tuple[str, int], int] = {}  # addr → conn id
+        self._accepted: set = set()  # inbound conn ids (for sever)
         self._closed = False
+        # Fault injection (chaos.py ChaosState); None = clean network.
+        self.chaos = None
         # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
         self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
         # MRT_TRACE_DIR=<dir>: record a Chrome-trace span per handled
@@ -150,11 +160,30 @@ class RpcNode:
 
     def _call(self, addr: Tuple[str, int], svc_meth: str, args: Any) -> Future:
         fut = Future()
+        chaos = self.chaos
+        if chaos is not None and not svc_meth.startswith("Chaos."):
+            act = chaos.decide_out(addr)
+            if act == "drop":
+                # Lost request: the future never resolves — the
+                # caller's with_timeout fires and its retry loop takes
+                # over (labrpc's "server never heard it").
+                return fut
+            if act != "pass":  # a delay in seconds
+                self.sched.call_after(
+                    act, self._send_request, addr, svc_meth, args, fut
+                )
+                return fut
+        self._send_request(addr, svc_meth, args, fut)
+        return fut
+
+    def _send_request(
+        self, addr: Tuple[str, int], svc_meth: str, args: Any, fut: Future
+    ) -> None:
         cid = self._conn_for(addr)
         if cid is None:
             # Resolve asynchronously so callers may attach callbacks first.
             self.sched.call_soon(fut.resolve, None)
-            return fut
+            return
         req_id = next(self._req_ids)
         with self._lock:
             self._pending[req_id] = (cid, fut)
@@ -168,7 +197,6 @@ class RpcNode:
                 if self._conns.get(addr) == cid:
                     del self._conns[addr]
             self.sched.call_soon(fut.resolve, None)
-        return fut
 
     def _on_event(self, ev: Tuple[int, int, bytes]) -> None:
         # Runs on the scheduler loop (the IO reactor thread).
@@ -190,21 +218,41 @@ class RpcNode:
                         print(f"[rpc] {head}"[:220], file=sys.stderr, flush=True)
                     except Exception:
                         pass
-                if msg[0] == "req":
-                    _, req_id, svc_meth, args = msg
-                    self._dispatch(conn, req_id, svc_meth, args)
-                elif msg[0] == "rep":
-                    _, req_id, value = msg
-                    with self._lock:
-                        entry = self._pending.pop(req_id, None)
-                    if entry is not None:
-                        entry[1].resolve(value)
+                chaos = self.chaos
+                if chaos is not None and not (
+                    msg[0] == "req" and msg[2].startswith("Chaos.")
+                ):
+                    # Control frames are exempt: a chaos layer that can
+                    # partition away its own antidote wedges the run.
+                    act = chaos.decide_in()
+                    if act == "drop":
+                        return
+                    if act != "pass":  # delayed delivery (may reorder)
+                        self.sched.call_after(
+                            act, self._handle_msg, conn, msg
+                        )
+                        return
+                self._handle_msg(conn, msg)
             except Exception as exc:
                 if self._dbg:
                     print(f"[rpc] bad frame dropped: {exc!r}",
                           file=sys.stderr, flush=True)
+        elif typ == EV_ACCEPT:
+            self._accepted.add(conn)
         elif typ == EV_CLOSED:
+            self._accepted.discard(conn)
             self._on_closed(conn)
+
+    def _handle_msg(self, conn: int, msg: Any) -> None:
+        if msg[0] == "req":
+            _, req_id, svc_meth, args = msg
+            self._dispatch(conn, req_id, svc_meth, args)
+        elif msg[0] == "rep":
+            _, req_id, value = msg
+            with self._lock:
+                entry = self._pending.pop(req_id, None)
+            if entry is not None:
+                entry[1].resolve(value)
 
     def _on_closed(self, conn: int) -> None:
         with self._lock:
@@ -222,7 +270,12 @@ class RpcNode:
             fut.resolve(None)
 
     def _dispatch(self, conn: int, req_id: int, svc_meth: str, args: Any) -> None:
-        # Runs on the scheduler loop.
+        # Runs on the scheduler loop.  Control replies bypass reply
+        # chaos (same exemption as the inbound path).
+        reply = (
+            self._reply if svc_meth.startswith("Chaos.")
+            else self._reply_chaos
+        )
         if self.tracer is not None:
             import time as _time
 
@@ -233,9 +286,9 @@ class RpcNode:
                 self.tracer.span(
                     svc_meth, t0 * 1e6, (now - t0) * 1e6, track="rpc"
                 )
-                self._reply(conn_, req_id_, value)
+                reply(conn_, req_id_, value)
         else:
-            _done = self._reply
+            _done = reply
         try:
             handler = self._handlers.get(svc_meth)
             if handler is None:
@@ -243,6 +296,10 @@ class RpcNode:
                 obj = self._services[svc_name]
                 handler = getattr(obj, _snake(meth))
                 self._handlers[svc_meth] = handler
+            # Loop-thread-only breadcrumb: lets a handler exempt the
+            # connection its own request rode in on (Chaos.sever must
+            # not cut the control channel out from under its reply).
+            self._cur_conn = conn
             result = handler(args)
         except Exception:
             result = None
@@ -257,11 +314,55 @@ class RpcNode:
         else:
             _done(conn, req_id, result)
 
+    def _reply_chaos(self, conn: int, req_id: int, value: Any) -> None:
+        """Reply path with fault injection: labrpc's dropped-reply case
+        — the handler RAN (the op may have applied), the caller never
+        learns.  Only session dedup keeps the ensuing retry
+        exactly-once, which is exactly the bug class this exercises."""
+        chaos = self.chaos
+        if chaos is not None:
+            act = chaos.decide_reply()
+            if act == "drop":
+                return
+            if act != "pass":
+                self.sched.call_after(act, self._reply, conn, req_id, value)
+                return
+        self._reply(conn, req_id, value)
+
     def _reply(self, conn: int, req_id: int, value: Any) -> None:
         try:
             self._tr.send(conn, codec.encode(("rep", req_id, value)))
         except Exception:
             pass
+
+    def sever(
+        self,
+        addr: Optional[Tuple[str, int]] = None,
+        exclude: Optional[int] = None,
+    ) -> int:
+        """Forcibly close live connections (chaos: mid-stream
+        connection loss).  ``addr`` limits the cut to that outbound
+        edge; ``None`` cuts every connection this node knows about —
+        outbound and accepted, except ``exclude`` (the control
+        connection a Chaos.sever request arrived on — cutting it would
+        strand the reply).  Local pending calls on the cut connections
+        fail immediately (resolve ``None``); the peer sees EV_CLOSED
+        and fails its own side.  Returns the number cut."""
+        with self._lock:
+            if addr is not None:
+                cid = self._conns.get(addr)
+                cids = [cid] if cid is not None else []
+            else:
+                cids = list(self._conns.values()) + list(self._accepted)
+        cids = [c for c in cids if c != exclude]
+        for cid in cids:
+            self._tr.close_conn(cid)
+            self._accepted.discard(cid)
+            # close_conn is locally silent (no EV_CLOSED to ourselves):
+            # fail the pending calls and drop the addr cache now, the
+            # way a remote reset would.
+            self._on_closed(cid)
+        return len(cids)
 
     def close(self) -> None:
         """Stop the scheduler loop (joining the reactor thread), then

@@ -91,6 +91,15 @@ def pack_request(
     """Pack columns into one request blob.  ``keys``/``values`` are
     per-row byte strings (empty for ops without one)."""
     n = len(ops)
+    for r, k in enumerate(keys):
+        if len(k) >= 2 ** 16:
+            # The wire key-length column is u16; packing a longer key
+            # would silently truncate the length and desync every
+            # later row's key offset.
+            raise ValueError(
+                f"firehose key at row {r} is {len(k)} bytes; the wire "
+                f"format caps keys below {2 ** 16} bytes"
+            )
     key_blob = b"".join(keys)
     val_blob = b"".join(values)
     parts = [

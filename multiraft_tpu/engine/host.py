@@ -431,6 +431,14 @@ class EngineDriver:
         The eviction scan only fires on a REBIND — an accept starting
         at or below the group's bind high-water mark (leader-churn
         truncation); steady-state accepts pay one dict probe."""
+        # One accept batch can never exceed the kernel's ingest lane
+        # width; a larger k means the accept-count column was
+        # corrupted, and binding it would smear payloads across slots
+        # the kernel never accepted.
+        assert k <= self.cfg.INGEST, (
+            f"accept batch k={k} exceeds cfg.INGEST={self.cfg.INGEST} "
+            f"for group {g}"
+        )
         lo, hi = s0 + 1, s0 + k
         mb = self._max_bound.get(g, 0)
         if self.payloads and lo <= mb:
@@ -657,11 +665,18 @@ class EngineDriver:
             )
         d = object.__new__(cls)  # skip __init__: no throwaway device state
         d._init_host(blob["cfg"], seed=0)
+        # jnp.array(..., copy=True), NOT jnp.asarray: the CPU backend
+        # may zero-copy a numpy array, leaving the device buffer
+        # aliased to the unpickled blob — and the tick DONATES its
+        # state/inbox inputs, so the first step after restore would
+        # write through into non-jax-owned memory (observed as a
+        # SIGSEGV inside the first post-restore dispatch when the
+        # executable comes from the persistent compilation cache).
         d.state = EngineState(
-            **{k: jnp.asarray(v) for k, v in blob["state"].items()}
+            **{k: jnp.array(v, copy=True) for k, v in blob["state"].items()}
         )
         d.inbox = Mailbox(
-            **{k: jnp.asarray(v) for k, v in blob["inbox"].items()}
+            **{k: jnp.array(v, copy=True) for k, v in blob["inbox"].items()}
         )
         if mesh is not None:
             from .mesh import make_sharded_tick, shard_arrays
@@ -671,7 +686,7 @@ class EngineDriver:
             d.inbox = shard_arrays(d.cfg, mesh, d.inbox)
             d._mesh_tick = make_sharded_tick(d.cfg, mesh)
         d.tick = blob["tick"]
-        d.key = jnp.asarray(blob["key"])
+        d.key = jnp.array(blob["key"], copy=True)
         d.backlog = blob["backlog"]
         d.payloads = blob["payloads"]
         d._pending_payloads = defaultdict(list, blob["pending_payloads"])

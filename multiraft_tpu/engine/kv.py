@@ -106,6 +106,14 @@ class BatchedKV(FrontierService):
         # — the WAL must be a commit-ordered redo log or replay can
         # disagree with reads the old incarnation acknowledged.
         self.on_write = None  # (group, KVOp)
+        # Optional route validator (key: str, G: int) -> group.  When
+        # set (the plain-KV server installs route_group), submit_frame
+        # rejects frames whose group column disagrees with the
+        # canonical hash — a misrouted write would land in the wrong
+        # group's sessions and break dedup silently.  Left None for
+        # the sharded service, whose group column carries
+        # config-assigned gids re-checked at apply time instead.
+        self.route_check = None
 
     # -- submission (DeferredConsensus.submit) ---------------------------
 
@@ -194,6 +202,15 @@ class BatchedKV(FrontierService):
                 f"frame routes to group {int(f.groups.max())} >= G="
                 f"{self.driver.cfg.G}"
             )
+        if self.route_check is not None:
+            G = self.driver.cfg.G
+            for r, key in enumerate(f.keys):
+                want = self.route_check(key, G)
+                if int(f.groups[r]) != want:
+                    raise ValueError(
+                        f"frame row {r} key {key!r} routed to group "
+                        f"{int(f.groups[r])}, expected {want}"
+                    )
         wr = f.write_rows
         if len(wr):
             g = f.groups[wr]

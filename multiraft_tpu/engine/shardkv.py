@@ -322,7 +322,9 @@ class BatchedShardKV(FrontierService):
             rep.pending_insert.clear()
             rep.pending_delete.clear()
             rep.pending_confirm.clear()
-        self._route = jnp.asarray(blob["route"])
+        # copy=True: never alias the unpickled buffer (host.py restore
+        # explains the donation hazard).
+        self._route = jnp.array(blob["route"], copy=True)
         self._ctrl_cmd = blob["ctrl_cmd"]
         self._orchestrate_enabled = blob["orchestrate"]
         # gid → engine-group mapping travels with the checkpoint (older

@@ -1,0 +1,6 @@
+"""Test harnesses: sim-backend drivers (cluster.py, kv_harness.py,
+ctrler_harness.py) and the real-socket nemesis (nemesis.py)."""
+
+from .nemesis import ChaosClient, Nemesis, make_schedule, run_clerk_load
+
+__all__ = ["ChaosClient", "Nemesis", "make_schedule", "run_clerk_load"]

@@ -1,0 +1,398 @@
+"""Nemesis: seeded fault schedules against live engine process
+clusters.
+
+The sim backend is routinely tested under labrpc-style faults; this
+module brings the same discipline to the deployment path.  A
+:func:`make_schedule` call turns ``(seed, n_procs)`` into a
+deterministic timeline of fault windows — delay storms, drop storms
+(requests AND replies), pair partitions, full isolation, mid-stream
+connection severs, crash + restart-from-WAL/checkpoint — and
+:class:`Nemesis` executes it against a running cluster through the
+servers' ``"Chaos"`` control RPC (distributed/chaos.py), while
+:func:`run_clerk_load` applies concurrent blocking-clerk traffic and
+collects the porcupine history that proves the fleet stayed
+linearizable through it all.
+
+Determinism: the schedule is a pure function of its arguments — the
+acceptance bar "the same seed reproduces the same fault schedule" is
+``make_schedule(s, n) == make_schedule(s, n)``, and the runner's
+``applied`` log records what was actually executed.  (Per-frame coin
+flips inside each server draw from the server's own seeded RNG and
+depend on traffic order; the *windows* — what faults, where, when —
+are exactly reproducible.)
+
+Usage::
+
+    cluster = EngineProcessCluster(..., chaos_seed=7)
+    cluster.start()
+    sched = make_schedule(seed=7, n_procs=1, duration_s=6.0,
+                          include=("delay", "drop", "sever"))
+    nem = Nemesis([(cluster.host, cluster.port)])
+    t = nem.run_async(sched)
+    history = run_clerk_load(cluster.clerk, keys=["a", "b"])
+    t.join(); nem.close()
+    assert_linearizable(kv_model, history, ...)
+
+Fault windows heal themselves (every storm has a bounded ``dur`` and
+the schedule ends with a global heal), so clerk retry loops always
+converge — pick per-op timeouts longer than the longest window.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..distributed.chaos import ChaosRule
+from ..distributed.tcp import RpcNode
+from ..sim.scheduler import TIMEOUT
+
+__all__ = [
+    "make_schedule",
+    "ChaosClient",
+    "Nemesis",
+    "run_clerk_load",
+]
+
+Addr = Tuple[str, int]
+# One schedule entry: (at_seconds, kind, params) — plain data so tests
+# can compare whole schedules across runs.
+Event = Tuple[float, str, Dict[str, Any]]
+
+
+def make_schedule(
+    seed: int,
+    n_procs: int,
+    duration_s: float = 8.0,
+    include: Sequence[str] = ("delay", "drop", "partition", "sever"),
+    crash_procs: Sequence[int] = (),
+    crash_down_s: float = 1.0,
+    fault_s: Tuple[float, float] = (0.6, 1.8),
+    quiet_s: Tuple[float, float] = (0.2, 0.8),
+) -> List[Event]:
+    """Deterministic fault timeline: alternating fault windows and
+    quiet gaps until ``duration_s``, plus one crash+restart per entry
+    of ``crash_procs`` (placed in the middle of the run, where traffic
+    and chaos overlap it).  Same arguments ⇒ identical schedule.
+
+    ``include`` picks the window kinds: ``delay`` (labrpc's
+    unreliable/long-delay mix on a process's inbound frames), ``drop``
+    (inbound drops + reply drops — the dedup-exercising case),
+    ``partition`` (symmetric pair block, n_procs ≥ 2), ``isolate``
+    (one process's inbound fully blocked — the minority case), and
+    ``sever`` (cut every live connection once, mid-stream)."""
+    rng = random.Random(seed)
+    kinds = [k for k in include if k != "partition" or n_procs > 1]
+    events: List[Event] = []
+    t = rng.uniform(*quiet_s)
+    while t < duration_s and kinds:
+        kind = rng.choice(kinds)
+        dur = round(rng.uniform(*fault_s), 3)
+        i = rng.randrange(n_procs)
+        at = round(t, 3)
+        if kind == "partition":
+            j = rng.choice([x for x in range(n_procs) if x != i])
+            events.append((at, "partition", {"a": i, "b": j, "dur": dur}))
+        elif kind == "delay":
+            events.append((at, "delay_storm", {
+                "proc": i, "dur": dur,
+                "prob": round(rng.uniform(0.3, 0.9), 3),
+                "delay_min": 0.0,
+                "delay_max": round(rng.uniform(0.05, 0.4), 3),
+            }))
+        elif kind == "drop":
+            events.append((at, "drop_storm", {
+                "proc": i, "dur": dur,
+                "prob": round(rng.uniform(0.2, 0.6), 3),
+            }))
+        elif kind == "isolate":
+            events.append((at, "isolate", {"proc": i, "dur": dur}))
+        elif kind == "sever":
+            events.append((at, "sever", {"proc": i}))
+        else:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        t += dur + rng.uniform(*quiet_s)
+    for k, proc in enumerate(crash_procs):
+        # Mid-run, staggered so two crashes never overlap.
+        at = round(duration_s * (0.35 + 0.25 * k / max(1, len(crash_procs))), 3)
+        events.append((at, "crash", {"proc": int(proc),
+                                     "down": float(crash_down_s)}))
+    # The global heal comes strictly after every window has closed —
+    # it must be the schedule's last executed action.
+    end = max(
+        [duration_s]
+        + [at + p.get("dur", p.get("down", 0.0)) for at, _, p in events]
+    )
+    events.append((round(end + 0.05, 3), "heal", {}))
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+class ChaosClient:
+    """Control-plane client: one chaos-free :class:`RpcNode` driving
+    every target's ``"Chaos"`` service.  Control frames are exempt
+    from the targets' inbound/reply chaos (chaos.py), so this client
+    can always reach — and heal — a faulted fleet; a CRASHED target is
+    simply unreachable, and calls to it return ``None``."""
+
+    def __init__(self, addrs: Sequence[Addr]) -> None:
+        self.node = RpcNode()
+        self.sched = self.node.sched
+        self.addrs = [tuple(a) for a in addrs]
+        self.ends = {a: self.node.client_end(*a) for a in self.addrs}
+
+    def call(
+        self, addr: Addr, meth: str, args: Any = None,
+        timeout: float = 2.0, retries: int = 5,
+    ) -> Any:
+        for attempt in range(retries):
+            reply = self.sched.wait(
+                self.ends[addr].call(f"Chaos.{meth}", args), timeout
+            )
+            if reply is not None and reply is not TIMEOUT:
+                return reply
+            time.sleep(0.05 * (attempt + 1))
+        return None
+
+    def set_rules(self, addr: Addr, wire: Dict[str, Any]) -> Any:
+        return self.call(addr, "set_rules", wire)
+
+    def clear(self, addr: Addr) -> Any:
+        return self.call(addr, "clear")
+
+    def clear_all(self) -> None:
+        for a in self.addrs:
+            self.clear(a)
+
+    def sever(self, addr: Addr, target: Optional[Addr] = None) -> Any:
+        return self.call(
+            addr, "sever", list(target) if target else None
+        )
+
+    def ping(self, addr: Addr) -> bool:
+        return self.call(addr, "ping") == "pong"
+
+    def stats(self, addr: Addr) -> Any:
+        return self.call(addr, "stats")
+
+    def close(self) -> None:
+        self.node.close()
+
+
+def _rule(**kw) -> Dict[str, Any]:
+    return ChaosRule(**kw).to_wire()
+
+
+class Nemesis:
+    """Executes a :func:`make_schedule` timeline against live servers.
+
+    ``addrs[i]`` is process ``i``'s ``(host, port)``; ``kill`` /
+    ``restart`` are callables taking the process index (the cluster's
+    own ``kill``/``start`` methods) and are required only when the
+    schedule contains crash events.
+
+    The runner keeps a local model of each target's full rule set and
+    pushes complete snapshots on every change — overlapping fault
+    windows compose, and a restarted process (which comes back with
+    clean rules) is re-pushed its active set.  ``applied`` logs every
+    executed action in order, for reproducibility assertions and
+    postmortems."""
+
+    def __init__(
+        self,
+        addrs: Sequence[Addr],
+        kill: Optional[Callable[[int], None]] = None,
+        restart: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.addrs = [tuple(a) for a in addrs]
+        self.ctl = ChaosClient(self.addrs)
+        self._kill = kill
+        self._restart = restart
+        self.applied: List[Tuple[str, str, Dict[str, Any]]] = []
+        self._model: Dict[Addr, Dict[str, Any]] = {
+            a: {"peers": {}, "all_out": None, "all_in": None, "reply": None}
+            for a in self.addrs
+        }
+
+    # -- model push --------------------------------------------------------
+
+    def _push(self, addr: Addr) -> None:
+        self.ctl.set_rules(addr, self._model[addr])
+
+    def _log(self, phase: str, kind: str, p: Dict[str, Any]) -> None:
+        self.applied.append((phase, kind, dict(p)))
+
+    # -- actions -----------------------------------------------------------
+
+    def _start(self, kind: str, p: Dict[str, Any]) -> None:
+        self._log("start", kind, p)
+        if kind == "delay_storm":
+            a = self.addrs[p["proc"]]
+            self._model[a]["all_in"] = _rule(
+                delay=p["prob"], delay_min=p["delay_min"],
+                delay_max=p["delay_max"],
+            )
+            self._push(a)
+        elif kind == "drop_storm":
+            a = self.addrs[p["proc"]]
+            self._model[a]["all_in"] = _rule(drop=p["prob"])
+            # Reply drops: the op APPLIED but the ack is lost — only
+            # session dedup keeps the client's retry exactly-once.
+            self._model[a]["reply"] = _rule(drop=p["prob"] / 2.0)
+            self._push(a)
+        elif kind == "isolate":
+            a = self.addrs[p["proc"]]
+            self._model[a]["all_in"] = _rule(block=True)
+            self._push(a)
+        elif kind == "partition":
+            aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+            self._model[aa]["peers"][f"{ab[0]}:{ab[1]}"] = _rule(block=True)
+            self._model[ab]["peers"][f"{aa[0]}:{aa[1]}"] = _rule(block=True)
+            self._push(aa)
+            self._push(ab)
+        elif kind == "sever":
+            self.ctl.sever(self.addrs[p["proc"]])
+        elif kind == "crash":
+            if self._kill is None:
+                raise ValueError("crash event but no kill callback")
+            self._kill(p["proc"])
+        elif kind == "heal":
+            self.heal_all()
+        else:
+            raise ValueError(f"unknown nemesis action {kind!r}")
+
+    def _stop(self, kind: str, p: Dict[str, Any]) -> None:
+        self._log("stop", kind, p)
+        if kind in ("delay_storm", "drop_storm", "isolate"):
+            a = self.addrs[p["proc"]]
+            self._model[a]["all_in"] = None
+            if kind == "drop_storm":
+                self._model[a]["reply"] = None
+            self._push(a)
+        elif kind == "partition":
+            aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+            self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
+            self._model[ab]["peers"].pop(f"{aa[0]}:{aa[1]}", None)
+            self._push(aa)
+            self._push(ab)
+        elif kind == "crash":
+            if self._restart is None:
+                raise ValueError("crash event but no restart callback")
+            self._restart(p["proc"])
+            # The reborn process has clean rules; re-push its active
+            # set so a crash inside another fault window composes.
+            self._push(self.addrs[p["proc"]])
+
+    def heal_all(self) -> None:
+        for a in self.addrs:
+            self._model[a] = {
+                "peers": {}, "all_out": None, "all_in": None, "reply": None,
+            }
+        self.ctl.clear_all()
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, schedule: Sequence[Event]) -> None:
+        """Execute the timeline in this thread.  Blocking actions
+        (restart-from-WAL waits for the readiness line) push later
+        actions back; the log records intent order, which is the
+        deterministic part."""
+        actions: List[Tuple[float, int, str, str, Dict[str, Any]]] = []
+        for n, (at, kind, p) in enumerate(schedule):
+            if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
+                actions.append((at, n, "start", kind, p))
+                actions.append((at + p["dur"], n, "stop", kind, p))
+            elif kind == "crash":
+                actions.append((at, n, "start", kind, p))
+                actions.append((at + p["down"], n, "stop", kind, p))
+            else:  # sever / heal: instantaneous
+                actions.append((at, n, "start", kind, p))
+        actions.sort(key=lambda a: (a[0], a[1], a[2] == "start"))
+        t0 = time.monotonic()
+        for at, _, phase, kind, p in actions:
+            delay = at - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            if phase == "start":
+                self._start(kind, p)
+            else:
+                self._stop(kind, p)
+
+    def run_async(self, schedule: Sequence[Event]) -> threading.Thread:
+        """Run the schedule on a daemon thread (the usual shape: the
+        nemesis runs WHILE the caller applies clerk load).  Join the
+        returned thread before asserting on ``applied``."""
+        t = threading.Thread(
+            target=self.run, args=(list(schedule),),
+            name="nemesis", daemon=True,
+        )
+        t.start()
+        return t
+
+    def close(self) -> None:
+        self.ctl.close()
+
+
+def run_clerk_load(
+    make_clerk: Callable[[], Any],
+    keys: Sequence[str],
+    n_workers: int = 3,
+    ops_per_worker: int = 9,
+    op_timeout: float = 90.0,
+) -> list:
+    """Concurrent blocking-clerk load returning a porcupine history.
+
+    Each worker owns one clerk and alternates appends (unique
+    ``(worker.op)`` tags — exactly-once is checkable afterwards from
+    any Get) with gets.  ``op_timeout`` must exceed the schedule's
+    longest fault window: every fault heals itself, so a retrying
+    clerk always converges and the history contains no ambiguous
+    (timed-out) operations — porcupine then checks completed ops only.
+
+    Worker exceptions propagate after all threads join (a hung clerk
+    is a test failure, not a deadlock)."""
+    from ..porcupine.kv import OP_APPEND, OP_GET, KvInput, KvOutput
+    from ..porcupine.model import Operation
+
+    history: list = []
+    lock = threading.Lock()
+    failures: list = []
+
+    def worker(wid: int) -> None:
+        ck = make_clerk()
+        try:
+            for j in range(ops_per_worker):
+                key = keys[(wid + j) % len(keys)]
+                t0 = time.monotonic()
+                if j % 3 == 2:
+                    v = ck.get(key, timeout=op_timeout)
+                    inp = KvInput(op=OP_GET, key=key)
+                    out = KvOutput(value=v)
+                else:
+                    tag = f"({wid}.{j})"
+                    ck.append(key, tag, timeout=op_timeout)
+                    inp = KvInput(op=OP_APPEND, key=key, value=tag)
+                    out = KvOutput(value="")
+                with lock:
+                    history.append(Operation(
+                        client_id=ck.client_id, input=inp, call=t0,
+                        output=out, ret=time.monotonic(),
+                    ))
+        except Exception as exc:  # noqa: BLE001 - reported after join
+            failures.append((wid, exc))
+        finally:
+            ck.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"clerk-{i}")
+        for i in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if failures:
+        raise failures[0][1]
+    return history

@@ -1,0 +1,87 @@
+"""Crash-safe persistent-compilation-cache shim.
+
+jax 0.4.x's file-system cache writes entries IN PLACE
+(``LRUCache.put`` → ``Path.write_bytes``): a process SIGKILLed
+mid-write leaves a truncated serialized executable under the final
+name, and a concurrent reader can observe the same torn state while a
+sibling writes.  Deserializing a truncated executable does not fail
+cleanly — it SEGFAULTS the process (observed: a chaos-restarted engine
+server dying with SIGSEGV inside its first cached tick dispatch,
+tests/test_chaos.py).  Multi-process engine fleets hit both windows:
+several servers share one cache dir, and the nemesis kills them at
+arbitrary points.
+
+:func:`harden_persistent_cache` swaps the write for the standard
+crash-safe idiom — temp file in the same directory, then an atomic
+``os.replace`` — so the final name only ever points at a complete
+entry.  Call it before the first jit in any process that shares a
+cache dir with processes that may die (server children do, via
+cluster._server_main; the test parent does, via conftest)."""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+
+__all__ = ["harden_persistent_cache"]
+
+
+def harden_persistent_cache() -> bool:
+    """Make jax's on-disk compilation-cache writes atomic.  Returns
+    True when the patch is in place (or already was), False when this
+    jax build has no file-system LRU cache to patch (nothing to do —
+    the cache, and therefore the hazard, is absent)."""
+    try:
+        from jax._src import lru_cache as _m
+    except Exception:  # pragma: no cover - jax layout changed
+        return False
+    cls = getattr(_m, "LRUCache", None)
+    if cls is None or not hasattr(cls, "put"):  # pragma: no cover
+        return False
+    if getattr(cls, "_mrt_atomic_put", False):
+        return True
+
+    cache_sfx = getattr(_m, "_CACHE_SUFFIX", "-cache")
+    atime_sfx = getattr(_m, "_ATIME_SUFFIX", "-atime")
+
+    def put(self, key: str, val: bytes) -> None:
+        if not key:
+            raise ValueError("key cannot be empty")
+        if self.eviction_enabled and len(val) > self.max_size:
+            warnings.warn(
+                f"Cache value for key {key!r} of size {len(val)} bytes "
+                f"exceeds the maximum cache size of {self.max_size} bytes"
+            )
+            return
+        cache_path = self.path / f"{key}{cache_sfx}"
+        atime_path = self.path / f"{key}{atime_sfx}"
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            # The one behavioral change vs upstream: write to a
+            # pid-unique temp name, publish with an atomic rename.  A
+            # crash mid-write strands a temp file; it never produces a
+            # truncated entry under the final name.
+            tmp = cache_path.with_name(f"{cache_path.name}.tmp{os.getpid()}")
+            try:
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            timestamp = time.time_ns().to_bytes(8, "little")
+            atime_path.write_bytes(timestamp)
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    cls.put = put
+    cls._mrt_atomic_put = True
+    return True

@@ -44,7 +44,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# The cache dir above is shared with every server child, several of
+# which run concurrently and get SIGKILLed by crash/chaos tests —
+# upstream's in-place cache write lets a torn entry segfault the next
+# process that loads it (utils/jaxcache.py).  Atomic writes close the
+# window for the parent; cluster._server_main does the same in
+# children.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from multiraft_tpu.utils.jaxcache import harden_persistent_cache
+
+harden_persistent_cache()
 
 import signal
 

@@ -1,0 +1,416 @@
+"""Chaos transport + nemesis harness tests: the deployment path under
+labrpc's fault model (distributed/chaos.py, harness/nemesis.py) plus
+the wire/host validation hardening that rode along — key-length and
+route-group checks on the firehose path, the accept-batch bound, and
+the plain-KV handler's demote-before-Get-gate ordering."""
+
+from __future__ import annotations
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+from multiraft_tpu.distributed.chaos import (
+    ChaosRule,
+    ChaosState,
+    install_chaos,
+)
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.harness.nemesis import (
+    ChaosClient,
+    Nemesis,
+    make_schedule,
+    run_clerk_load,
+)
+from multiraft_tpu.sim.scheduler import TIMEOUT
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+# ---------------------------------------------------------------------------
+# ChaosState / ChaosRule (no sockets)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosState:
+    def test_rule_wire_roundtrip(self):
+        r = ChaosRule(drop=0.3, delay=0.5, delay_min=0.01, delay_max=0.2,
+                      block=False)
+        q = ChaosRule.from_wire(r.to_wire())
+        assert q.to_wire() == r.to_wire()
+
+    def test_seeded_decisions_reproducible(self):
+        rule = ChaosRule(drop=0.4, delay=0.4, delay_min=0.0, delay_max=0.1)
+        runs = []
+        for _ in range(2):
+            st = ChaosState(seed=42)
+            st.all_in = rule
+            runs.append([st.decide_in() for _ in range(64)])
+        assert runs[0] == runs[1]
+        assert "drop" in runs[0]  # the mix actually drops sometimes
+        assert any(isinstance(d, float) for d in runs[0])  # ...and delays
+
+    def test_block_always_drops_and_counts(self):
+        st = ChaosState(seed=1)
+        st.all_out = ChaosRule(block=True)
+        assert all(
+            st.decide_out(("h", 1)) == "drop" for _ in range(10)
+        )
+        assert st.dropped == 10
+
+    def test_peer_rule_overrides_catch_all(self):
+        st = ChaosState(seed=1)
+        st.all_out = ChaosRule(block=True)
+        st.peer_out[("ok", 5)] = ChaosRule()  # clean edge
+        assert st.decide_out(("ok", 5)) == "pass"
+        assert st.decide_out(("other", 6)) == "drop"
+
+    def test_configure_replaces_and_clear_empties(self):
+        st = ChaosState(seed=0)
+        st.configure({
+            "peers": {"10.0.0.1:700": {"block": True}},
+            "all_in": {"drop": 0.5},
+            "reply": None,
+        })
+        assert st.peer_out[("10.0.0.1", 700)].block
+        assert st.all_in is not None and st.all_in.drop == 0.5
+        # Full-state replace: a second configure drops the old peer.
+        st.configure({"all_out": {"delay": 1.0, "delay_max": 0.1}})
+        assert st.peer_out == {} and st.all_in is None
+        assert st.all_out is not None
+        st.clear()
+        assert st.all_out is None and st.decide_in() == "pass"
+
+
+def test_make_schedule_same_seed_same_schedule():
+    kw = dict(duration_s=9.0, crash_procs=[1], crash_down_s=0.5)
+    s1 = make_schedule(7, 3, **kw)
+    s2 = make_schedule(7, 3, **kw)
+    assert s1 == s2
+    assert make_schedule(8, 3, **kw) != s1  # seed actually matters
+    kinds = [k for _, k, _ in s1]
+    assert kinds[-1] == "heal" and kinds.count("crash") == 1
+    assert all(at <= s1[-1][0] for at, _, _ in s1)
+
+
+def test_make_schedule_partition_needs_two_procs():
+    sched = make_schedule(3, 1, duration_s=5.0, include=("partition",))
+    assert [k for _, k, _ in sched] == ["heal"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos over real sockets (RpcNode level)
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    def ping(self, args):
+        return ("pong", args)
+
+
+@needs_native
+def test_chaos_block_heals_and_control_plane_exempt():
+    """An isolated node times out data RPCs but still answers its
+    "Chaos" control service — the harness can always heal."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = RpcNode(listen=True)
+    server.add_service("Echo", _Echo())
+    install_chaos(server, seed=3)
+    client = RpcNode()
+    try:
+        addr = (server.host, server.port)
+        end = client.client_end(*addr)
+        assert client.sched.wait(end.call("Echo.ping", 1), 5.0) == ("pong", 1)
+
+        ctl = ChaosClient([addr])
+        try:
+            ctl.set_rules(addr, {"all_in": {"block": True}})
+            # Data path dark...
+            assert client.sched.wait(end.call("Echo.ping", 2), 0.5) is TIMEOUT
+            # ...control path alive (the exemption under test).
+            assert ctl.ping(addr)
+            stats = ctl.stats(addr)
+            assert stats["dropped"] >= 1
+            ctl.clear(addr)
+            assert client.sched.wait(
+                end.call("Echo.ping", 3), 5.0
+            ) == ("pong", 3)
+        finally:
+            ctl.close()
+    finally:
+        client.close()
+        server.close()
+
+
+@needs_native
+def test_sever_cuts_connections_then_reconnects():
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = RpcNode(listen=True)
+    server.add_service("Echo", _Echo())
+    install_chaos(server, seed=0)
+    client = RpcNode()
+    try:
+        addr = (server.host, server.port)
+        end = client.client_end(*addr)
+        assert client.sched.wait(end.call("Echo.ping", 1), 5.0) == ("pong", 1)
+        ctl = ChaosClient([addr])
+        try:
+            assert ctl.sever(addr) >= 1
+        finally:
+            ctl.close()
+        # The client's cached conn died; the next call redials.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if client.sched.wait(
+                end.call("Echo.ping", 2), 2.0
+            ) == ("pong", 2):
+                break
+        else:
+            pytest.fail("client never reconnected after sever")
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos smoke vs a live engine process (tier-1)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.timeout_s(240)
+def test_chaos_smoke_engine_cluster_linearizable():
+    """A seeded drop/delay/sever schedule against one engine server
+    process under concurrent clerk load: every op completes (faults
+    heal, clerks retry) and the client-observed history stays
+    linearizable.  The schedule itself is reproducible from its seed."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    schedule = make_schedule(
+        seed=5, n_procs=1, duration_s=5.0,
+        include=("delay", "drop", "sever"),
+        fault_s=(0.4, 1.2), quiet_s=(0.2, 0.5),
+    )
+    assert schedule == make_schedule(
+        seed=5, n_procs=1, duration_s=5.0,
+        include=("delay", "drop", "sever"),
+        fault_s=(0.4, 1.2), quiet_s=(0.2, 0.5),
+    )
+    assert len(schedule) > 2  # heal + at least two fault windows
+
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=16, seed=3, chaos_seed=7
+    )
+    try:
+        cluster.start()
+        nem = Nemesis([(cluster.host, cluster.port)])
+        try:
+            runner = nem.run_async(schedule)
+            history = run_clerk_load(
+                cluster.clerk, keys=["ca", "cb"],
+                n_workers=3, ops_per_worker=9, op_timeout=60.0,
+            )
+            runner.join(timeout=60.0)
+            assert not runner.is_alive()
+            # Ran to the final heal, and the server is reachable clean.
+            assert nem.applied[-1][1] == "heal"
+            assert nem.ctl.ping((cluster.host, cluster.port))
+        finally:
+            nem.close()
+        assert len(history) == 27
+        assert_linearizable(
+            kv_model, history, timeout=30.0, name="chaos-smoke"
+        )
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Full nemesis: partitions + delays + crash/restart-from-WAL (slow)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_nemesis_fleet_partition_delay_crash_restart(tmp_path):
+    """The acceptance scenario end to end: a seeded schedule of
+    partitions, delay/drop storms, severs, and one crash+restart-from-
+    WAL runs against a two-process durable engine fleet over real
+    sockets while clerks apply load; everything completes and the
+    history passes porcupine."""
+    from multiraft_tpu.distributed.engine_cluster import EngineFleetCluster
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    kw = dict(
+        duration_s=12.0,
+        include=("delay", "drop", "partition", "sever"),
+        crash_procs=[0], crash_down_s=1.0,
+        fault_s=(0.5, 1.5), quiet_s=(0.3, 0.8),
+    )
+    schedule = make_schedule(11, 2, **kw)
+    assert schedule == make_schedule(11, 2, **kw)
+    assert any(k == "crash" for _, k, _ in schedule)
+
+    fleet = EngineFleetCluster(
+        [[1], [2]], seed=9, data_dir=str(tmp_path / "fleet"),
+        checkpoint_every_s=3600.0,  # recovery must come from the WAL
+        chaos_seed=11,
+    )
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        fleet.admin("join", [2])
+        addrs = [(fleet.host, p) for p in fleet.ports]
+        nem = Nemesis(addrs, kill=fleet.kill, restart=fleet.start)
+        try:
+            runner = nem.run_async(schedule)
+            history = run_clerk_load(
+                fleet.clerk, keys=["na", "nb", "nc"],
+                n_workers=3, ops_per_worker=9, op_timeout=240.0,
+            )
+            runner.join(timeout=400.0)
+            assert not runner.is_alive()
+            kinds = [(ph, k) for ph, k, _ in nem.applied]
+            assert ("start", "crash") in kinds  # SIGKILL happened
+            assert ("stop", "crash") in kinds   # ...and WAL recovery
+            assert nem.applied[-1][1] == "heal"
+            for a in addrs:
+                assert nem.ctl.ping(a)
+        finally:
+            nem.close()
+        assert len(history) == 27
+        assert_linearizable(
+            kv_model, history, timeout=60.0, name="nemesis-fleet"
+        )
+    finally:
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite hardening: firehose wire/route validation + ack ordering
+# ---------------------------------------------------------------------------
+
+
+def _frame_blob(ops, groups, clients, commands, keys, vals):
+    from multiraft_tpu.engine.firehose import pack_request
+
+    n = len(ops)
+    return pack_request(
+        np.asarray(ops, np.uint8), np.asarray(groups, np.uint32),
+        np.asarray(clients, np.uint64), np.asarray(commands, np.uint64),
+        keys, vals,
+    )
+
+
+def test_pack_request_rejects_oversized_key():
+    with pytest.raises(ValueError, match="row 1 .* caps keys"):
+        _frame_blob(
+            [1, 1], [0, 0], [7, 7], [1, 2],
+            [b"ok", b"x" * 2 ** 16], [b"v", b"v"],
+        )
+    # One byte under the cap still packs.
+    _frame_blob([1], [0], [7], [1], [b"x" * (2 ** 16 - 1)], [b"v"])
+
+
+def test_submit_frame_validates_route_group():
+    """With route_check installed (the plain-KV service does), a frame
+    whose group column disagrees with the canonical key hash is
+    rejected before any run starts."""
+    from multiraft_tpu.distributed.engine_wire import route_group
+    from multiraft_tpu.engine.kv import BatchedKV
+
+    G = 8
+    runs = []
+    stub = types.SimpleNamespace(
+        driver=types.SimpleNamespace(
+            cfg=types.SimpleNamespace(G=G),
+            start_run=lambda g, f, rows: runs.append((g, len(rows))),
+        ),
+        route_check=route_group,
+        _now=lambda: 0,
+    )
+    g = route_group("a", G)
+    ok = _frame_blob([1], [g], [7], [1], [b"a"], [b"v"])
+    BatchedKV.submit_frame(stub, ok)
+    assert runs == [(g, 1)]
+    bad = _frame_blob([1], [(g + 1) % G], [7], [2], [b"a"], [b"v"])
+    with pytest.raises(ValueError, match="row 0 .* expected"):
+        BatchedKV.submit_frame(stub, bad)
+    assert len(runs) == 1  # nothing started for the rejected frame
+
+
+def test_bind_accepted_rejects_oversized_batch():
+    from multiraft_tpu.engine.host import EngineDriver
+
+    stub = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(INGEST=8),
+        _max_bound={}, payloads={}, _pending_payloads={},
+    )
+    EngineDriver._bind_accepted(stub, 0, 1, 0, None)  # in-bounds: fine
+    with pytest.raises(AssertionError, match="exceeds cfg.INGEST"):
+        EngineDriver._bind_accepted(stub, 0, 9, 0, None)
+
+
+def _drive(gen, sched, step_s=1.0):
+    """Run a handler generator to completion, advancing the stub clock
+    at every yield, and return its StopIteration value."""
+    while True:
+        try:
+            next(gen)
+        except StopIteration as stop:
+            return stop.value
+        sched.now += step_s
+
+
+def _firehose_reply(synced: bool):
+    """Drive the plain-KV firehose handler with a stub durability layer
+    whose fsync either lands or never does."""
+    from multiraft_tpu.distributed.engine_server import EngineKVService
+    from multiraft_tpu.engine.firehose import FirehoseFrame, unpack_reply
+
+    blob = _frame_blob(
+        [1, 0], [0, 0], [7, 7], [1, 0], [b"k", b"k"], [b"v", b""],
+    )
+
+    def submit_frame(raw):
+        f = FirehoseFrame(raw, 0)
+        f.rows_applied(f.write_rows)  # the write applied in memory...
+        return f
+
+    svc = EngineKVService.__new__(EngineKVService)
+    svc.sched = types.SimpleNamespace(now=0.0)
+    svc.kv = types.SimpleNamespace(
+        submit_frame=submit_frame,
+        get=lambda g, key: types.SimpleNamespace(value="applied-v"),
+    )
+    svc._dur = types.SimpleNamespace(synced=lambda seq: synced)
+    svc._write_seqs = {(7, 1): 42}
+    out = _drive(svc.firehose(blob), svc.sched)
+    return unpack_reply(out)
+
+
+def test_firehose_get_gated_behind_unsynced_write():
+    """Crash-before-fsync regression (the plain handler must demote
+    BEFORE the Get gate, as the sharded one does): when a frame's write
+    applied but its WAL record never fsyncs, the write demotes to RETRY
+    — and the frame's own Get must NOT answer from the applied state a
+    crash could still un-happen."""
+    from multiraft_tpu.engine.firehose import FH_OK, FH_RETRY
+
+    err, values = _firehose_reply(synced=False)
+    assert err.tolist() == [FH_RETRY, FH_RETRY]
+    assert values[1] == ""  # no read past the durability gate
+    # Control: once the fsync lands, both rows ack and the Get answers.
+    err, values = _firehose_reply(synced=True)
+    assert err.tolist() == [FH_OK, FH_OK]
+    assert values[1] == "applied-v"
