@@ -51,6 +51,37 @@ def log(msg: str) -> None:
 _NO_KILLS = (np.zeros(0, np.int64), np.zeros(0, np.int64))
 
 
+def write_trace_artifacts(trace_dir, chunk_trace, metrics_snapshot):
+    """Emit the observability artifacts for this bench invocation into
+    ``trace_dir`` (``MULTIRAFT_BENCH_TRACE_DIR``): ``trace_bench.json.gz``
+    — a Chrome-trace timeline with one span per timed chunk and a
+    commit-rate counter track, openable in Perfetto next to any fleet
+    trace — and ``metrics_bench.json``, the bench's metrics-registry
+    snapshot (chunk-rate percentiles, commit totals).  Returns the two
+    paths."""
+    from multiraft_tpu.utils.trace import Tracer
+
+    os.makedirs(trace_dir, exist_ok=True)
+    tr = Tracer(max_events=2 * len(chunk_trace) + 16)
+    tr.process_name(0, "bench")
+    for rec in chunk_trace:
+        tr.span(
+            "chunk", rec["ts_us"], rec["dur_us"], track="bench", pid=0,
+            run=rec["run"], chunk=rec["chunk"], commits=rec["commits"],
+            ms_per_tick=rec["ms_per_tick"],
+        )
+        tr.counter(
+            "commit_rate", rec["ts_us"] + rec["dur_us"],
+            {"commits_per_sec": rec["rate"]}, pid=0,
+        )
+    trace_path = tr.save(os.path.join(trace_dir, "trace_bench.json.gz"))
+    metrics_path = os.path.join(trace_dir, "metrics_bench.json")
+    with open(metrics_path, "w") as f:
+        json.dump(metrics_snapshot, f, indent=2, sort_keys=True)
+    log(f"bench: wrote {trace_path} and {metrics_path}")
+    return trace_path, metrics_path
+
+
 def apply_leader_kills(st, mb, kill_groups, prev_killed):
     """The ONE fault model both capture legs drive (headline and
     config5): revive the previous round's victims (crash-restart
@@ -287,6 +318,7 @@ def main() -> None:
 
     t_begin = time.perf_counter()
     run_rates = []
+    chunk_trace = []
     for run in range(RUNS):
         rates_this_run = []
         for c in range(N_CHUNKS):
@@ -318,6 +350,11 @@ def main() -> None:
             m.inc("commits", chunk_commits)
             rates_this_run.append(rate)
             tick_times.append(dt / CHUNK)
+            chunk_trace.append({
+                "ts_us": t0 * 1e6, "dur_us": dt * 1e6, "run": run,
+                "chunk": c, "commits": chunk_commits, "rate": rate,
+                "ms_per_tick": dt / CHUNK * 1e3,
+            })
             log(
                 f"bench: run {run+1}/{RUNS} chunk {c+1}/{N_CHUNKS}: "
                 f"{dt:.3f}s ({dt/CHUNK*1e3:.3f} ms/tick, "
@@ -450,6 +487,13 @@ def main() -> None:
         except Exception as e:  # never lose the headline JSON
             log(f"bench: config5 leg failed: {type(e).__name__}: {e}")
             config5 = {"error": f"{type(e).__name__}: {e}"}
+
+    trace_dir = os.environ.get("MULTIRAFT_BENCH_TRACE_DIR", "")
+    if trace_dir:
+        try:  # artifacts must never cost the headline JSON
+            write_trace_artifacts(trace_dir, chunk_trace, m.snapshot())
+        except Exception as e:
+            log(f"bench: trace artifacts failed: {type(e).__name__}: {e}")
 
     baseline = 1_000_000.0  # BASELINE.md north star
     print(

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import random
 import threading
+from collections import defaultdict
 from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["ChaosRule", "ChaosState", "ChaosControl", "install_chaos"]
@@ -125,33 +126,55 @@ class ChaosState:
         # lock beyond the RNG's — increments race benignly).
         self.dropped = 0
         self.delayed = 0
+        # Per-path hit ledger: path → {"block"/"drop"/"delay": count} of
+        # faults ACTUALLY APPLIED there, where path is "all_in",
+        # "all_out", "reply", or "peer:<host>:<port>".  This is how the
+        # nemesis verifies each scheduled fault window fired at least
+        # once — a schedule that silently misses is a false green.
+        self.hits: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int)
+        )
+        # Optional mirror into the node's scrapeable registry (wired by
+        # install_chaos when the node carries an obs plane).
+        self.metrics = None
 
     # -- decisions ---------------------------------------------------------
 
-    def _decide(self, rule: Optional[ChaosRule]):
+    def _hit(self, path: str, kind: str) -> None:
+        self.hits[path][kind] += 1
+        if self.metrics is not None:
+            self.metrics.inc(f"chaos.{kind}.{path}")
+
+    def _decide(self, rule: Optional[ChaosRule], path: str = "?"):
         if rule is None:
             return PASS
         if rule.block:
             self.dropped += 1
+            self._hit(path, "block")
             return DROP
         with self._lock:
             if rule.drop > 0.0 and self._rng.random() < rule.drop:
                 self.dropped += 1
+                self._hit(path, "drop")
                 return DROP
             if rule.delay > 0.0 and self._rng.random() < rule.delay:
                 t = self._rng.uniform(rule.delay_min, rule.delay_max)
                 self.delayed += 1
+                self._hit(path, "delay")
                 return t
         return PASS
 
     def decide_out(self, addr: Tuple[str, int]):
-        return self._decide(self.peer_out.get(addr, self.all_out))
+        rule = self.peer_out.get(addr)
+        if rule is not None:
+            return self._decide(rule, f"peer:{addr[0]}:{addr[1]}")
+        return self._decide(self.all_out, "all_out")
 
     def decide_in(self):
-        return self._decide(self.all_in)
+        return self._decide(self.all_in, "all_in")
 
     def decide_reply(self):
-        return self._decide(self.reply)
+        return self._decide(self.reply, "reply")
 
     # -- reconfiguration (full-state, idempotent) --------------------------
 
@@ -192,6 +215,7 @@ class ChaosState:
             "reply": self.reply.to_wire() if self.reply else None,
             "dropped": self.dropped,
             "delayed": self.delayed,
+            "hits": {p: dict(k) for p, k in self.hits.items()},
         }
 
 
@@ -238,6 +262,11 @@ def install_chaos(node, seed: int = 0) -> ChaosState:
     ``"Chaos"`` control service on it.  Idempotent per node (the last
     install wins)."""
     state = ChaosState(seed)
+    obs = getattr(node, "obs", None)
+    if obs is not None:
+        # Applied faults surface in Obs.snapshot alongside the RPC
+        # counters (chaos.<kind>.<path> — the per-peer hit export).
+        state.metrics = obs.metrics
     node.add_service("Chaos", ChaosControl(node, state))
     node.chaos = state
     return state

@@ -8,11 +8,23 @@ kvraft/client.go:47-71, shardkv/client.go:68-129).
 from __future__ import annotations
 
 import itertools
+import time
 
 from ..sim.scheduler import TIMEOUT, Future
 from ..utils.ids import unique_client_id
 from .engine_wire import OK, EngineCmdArgs
 from .realtime import Backoff
+
+
+def _end_obs(end):
+    """The observability plane of the node behind a TcpClientEnd (the
+    clerk's own process), or a private stand-in for exotic ends."""
+    node = getattr(end, "_node", None)
+    if node is not None and getattr(node, "obs", None) is not None:
+        return node.obs
+    from .observe import Observability
+
+    return Observability()
 
 __all__ = [
     "EngineClerk",
@@ -45,6 +57,15 @@ class EngineClerk:
         # server restarts, a partitioned minority) must not turn the
         # retry loop into a hot spin against the recovering process.
         self._backoff = Backoff()
+        # Observability: per-call latency/retry counters + a span per
+        # logical command, all tagged with a compact request id that
+        # also rides the wire (every retry reuses it, so the clerk span
+        # here and the server's dispatch spans correlate by one id).
+        self.obs = _end_obs(end)
+        self._rid_seq = itertools.count(1)
+
+    def _rid(self) -> str:
+        return f"{self.client_id & 0xFFFFFF:06x}.{next(self._rid_seq)}"
 
     def _command(self, op: str, key: str, value: str = ""):
         if op != "Get":
@@ -53,8 +74,16 @@ class EngineClerk:
             op=op, key=key, value=value,
             client_id=self.client_id, command_id=self.command_id,
         )
+        rid = self._rid()
+        m = self.obs.metrics
+        m.inc("clerk.calls")
+        t0 = time.perf_counter()
+        attempts = 0
         while True:
-            fut: Future = self.end.call(f"{self.service}.command", args)
+            attempts += 1
+            fut: Future = self.end.call(
+                f"{self.service}.command", args, trace=rid
+            )
             reply = yield self.sched.with_timeout(fut, 3.5)
             if (
                 reply is None
@@ -62,9 +91,18 @@ class EngineClerk:
                 or reply.err != OK
             ):
                 # lost/timed out/old leader: retry (dedup-safe)
-                yield self._backoff.next_delay()
+                m.inc("clerk.retries")
+                delay = self._backoff.next_delay()
+                m.observe("clerk.backoff_s", delay)
+                yield delay
                 continue
             self._backoff.reset()
+            dur = time.perf_counter() - t0
+            m.observe("clerk.call_s", dur)
+            self.obs.tracer.span(
+                f"clerk.{op}", t0 * 1e6, dur * 1e6, track="clerk",
+                req=rid, attempts=attempts,
+            )
             return reply.value
 
     def get(self, key: str):
@@ -110,8 +148,12 @@ class PipelinedClerk(EngineClerk):
                     command_id=self.command_id,
                 )
             )
+        rid = self._rid()
+        self.obs.metrics.inc("clerk.batch_frames")
         while True:
-            fut: Future = self.end.call(f"{self.service}.batch", frame)
+            fut: Future = self.end.call(
+                f"{self.service}.batch", frame, trace=rid
+            )
             reply = yield self.sched.with_timeout(fut, 10.0)
             if reply is not None and reply is not TIMEOUT and any(
                 r.err.startswith("ErrBatchTooLarge") for r in reply
@@ -417,6 +459,13 @@ class EngineFleetClerk:
         self.command_id = 0
         self._cfg = None  # cached (num, shards, groups)
         self._backoff = Backoff()
+        # Observability (see EngineClerk): every end shares the
+        # process's one node, so any end's plane is THE plane.
+        self.obs = _end_obs(self._all[0]) if self._all else _end_obs(None)
+        self._rid_seq = itertools.count(1)
+
+    def _rid(self) -> str:
+        return f"{self.client_id & 0xFFFFFF:06x}.{next(self._rid_seq)}"
 
     def _refresh_config(self, deadline=None):
         if deadline is None:
@@ -443,6 +492,11 @@ class EngineFleetClerk:
             op=op, key=key, value=value,
             client_id=self.client_id, command_id=self.command_id,
         )
+        rid = self._rid()
+        m = self.obs.metrics
+        m.inc("clerk.calls")
+        t0 = time.perf_counter()
+        attempts = 0
         while True:
             cfg = self._cfg
             if cfg is None:
@@ -452,6 +506,7 @@ class EngineFleetClerk:
                     # Whole fleet unreachable for a full fetch budget:
                     # back off and re-enter (the blocking facade's own
                     # deadline bounds the caller).
+                    m.inc("clerk.retries")
                     yield self._backoff.next_delay()
                     continue
             gid = cfg[1][key2shard(key)]
@@ -460,17 +515,28 @@ class EngineFleetClerk:
                 yield self._backoff.next_delay()
                 self._cfg = None
                 continue
-            fut = end.call("EngineShardKV.command", args)
+            attempts += 1
+            fut = end.call("EngineShardKV.command", args, trace=rid)
             reply = yield self.sched.with_timeout(fut, 3.5)
             if reply is None or reply is TIMEOUT:
                 self._cfg = None
-                yield self._backoff.next_delay()
+                m.inc("clerk.retries")
+                delay = self._backoff.next_delay()
+                m.observe("clerk.backoff_s", delay)
+                yield delay
                 continue  # dropped / wedged: re-route and retry
             if reply.err == OK:
                 self._backoff.reset()
+                dur = time.perf_counter() - t0
+                m.observe("clerk.call_s", dur)
+                self.obs.tracer.span(
+                    f"clerk.{op}", t0 * 1e6, dur * 1e6, track="clerk",
+                    req=rid, attempts=attempts,
+                )
                 return reply.value
             if reply.err == ERR_WRONG_GROUP:
                 self._cfg = None  # stale routing: re-query the config
+            m.inc("clerk.retries")
             yield self._backoff.next_delay()
 
     def get(self, key: str):
@@ -522,6 +588,8 @@ class PipelinedFleetClerk(EngineFleetClerk):
                     command_id=self.command_id,
                 )
             )
+        rid = self._rid()
+        self.obs.metrics.inc("clerk.batch_frames")
         results = [None] * len(ops)
         todo = list(range(len(ops)))
         while todo:
@@ -550,6 +618,7 @@ class PipelinedFleetClerk(EngineFleetClerk):
                 (idxs, end.call(
                     "EngineShardKV.batch",
                     [frame_args[i] for i in idxs],
+                    trace=rid,
                 ))
                 for end, idxs in by_end.items()
             ]

@@ -45,13 +45,18 @@ class EngineDurability:
         state_owner,  # has state_dict() (BatchedKV / BatchedShardKV)
         checkpoint_every_s: float = 30.0,
         fsync: bool = True,
+        metrics=None,
     ) -> None:
+        from ..utils.metrics import Metrics
         from .wal import WriteAheadLog
 
         os.makedirs(data_dir, exist_ok=True)
         self.ckpt_path = os.path.join(data_dir, "engine.ckpt")
+        # The server passes its per-process registry so WAL fsync
+        # latency / rotate counts surface in Obs.snapshot scrapes.
+        self.metrics = metrics if metrics is not None else Metrics()
         self.wal = WriteAheadLog(os.path.join(data_dir, "ops.wal"),
-                                 fsync=fsync)
+                                 fsync=fsync, metrics=self.metrics)
         self.driver = driver
         self.state_owner = state_owner
         self.every = checkpoint_every_s
@@ -79,11 +84,14 @@ class EngineDurability:
     def checkpoint(self) -> None:
         """Atomic engine+service snapshot, then WAL rotation.  A crash
         between the two merely makes the next replay redundant."""
+        t0 = time.perf_counter()
         self.driver.save(
             self.ckpt_path,
             extra={"service": self.state_owner.state_dict()},
         )
         self.wal.rotate()
+        self.metrics.inc("ckpt.saves")
+        self.metrics.observe("ckpt.save_s", time.perf_counter() - t0)
         self._last_ckpt = time.monotonic()
 
 

@@ -28,6 +28,7 @@ Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
@@ -96,6 +97,7 @@ class EngineKVService:
         pump_interval: float = 0.002,
         ticks_per_pump: int = 2,
         durability: Optional[EngineDurability] = None,
+        obs=None,
     ) -> None:
         self.sched = sched
         self.kv = kv
@@ -104,6 +106,11 @@ class EngineKVService:
         self._ticks = ticks_per_pump
         self._stopped = False
         self._dur = durability
+        # The owning node's observability plane (tick/pump latency,
+        # frame sizes, commit instants tagged with the caller's request
+        # id).  Lazily defaulted via the `obs` property, so partially
+        # constructed stubs (tests build handlers via __new__) work too.
+        self._obs = obs
         # (client_id, command_id) -> WAL seq of the op's apply-time
         # record; handlers gate their ack on it being fsynced.  Pruned
         # once synced (absence = already durable).
@@ -118,6 +125,19 @@ class EngineKVService:
                                 op.client_id, op.command_id)),
             )
         sched.call_soon(self._pump_loop)
+
+    @property
+    def obs(self):
+        o = getattr(self, "_obs", None)
+        if o is None:
+            from .observe import Observability
+
+            o = self._obs = Observability()
+        return o
+
+    @property
+    def m(self):
+        return self.obs.metrics
 
     def stop(self) -> None:
         self._stopped = True
@@ -134,7 +154,10 @@ class EngineKVService:
     def _pump_loop(self) -> None:
         if self._stopped:
             return
+        t0 = time.perf_counter()
         self.kv.pump(self._ticks)
+        self.m.inc("pump.count")
+        self.m.observe("pump.wall_s", time.perf_counter() - t0)
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
             if self._write_seqs:
@@ -152,7 +175,10 @@ class EngineKVService:
         :func:`~.engine_durability.replay_kv_wal` (strictly one record
         in flight per group; see its docstring for the full
         contract)."""
-        return replay_kv_wal(self.kv, self._dur, self.G)
+        n = replay_kv_wal(self.kv, self._dur, self.G)
+        self.m.inc("wal.replays")
+        self.m.inc("wal.replayed_records", n)
+        return n
 
     # Largest multi-op frame one RPC may carry (bounds the per-pump
     # submit burst a single frame can impose).
@@ -173,6 +199,8 @@ class EngineKVService:
             return [
                 EngineCmdReply(err=f"ErrBatchTooLarge:{self.MAX_BATCH}")
             ] * len(args_list)
+        self.m.inc("batch.frames")
+        self.m.observe("batch.ops", float(len(args_list)))
 
         def run():
             deadline = self.sched.now + self.DEADLINE_S
@@ -287,9 +315,14 @@ class EngineKVService:
                 f = self.kv.submit_frame(raw)
             except ValueError as e:
                 return ("err", str(e))
-            deadline = self.sched.now + self.DEADLINE_S
+            self.m.inc("firehose.frames")
+            self.m.inc("firehose.rows", n)
+            t0 = self.sched.now
+            deadline = t0 + self.DEADLINE_S
             while not f.done and self.sched.now < deadline:
                 yield 0.002
+            # Firehose lag: submit → frame resolution (device-side wait).
+            self.m.observe("firehose.lag_s", self.sched.now - t0)
             err = f.err.copy()
             # Durable mode FIRST: the shared firehose ack gate (never
             # a false durable ack; unsynced rows demote to RETRY).
@@ -326,12 +359,20 @@ class EngineKVService:
         if args.op == "Get":
             # ReadIndex fast read: linearizable at the applied
             # frontier, no log entry, immediate reply.
+            self.m.inc("kv.gets")
             t = self.kv.get(g, args.key)
             return EngineCmdReply(err=OK, value=t.value)
 
+        # The caller's request id, captured NOW (handler entry runs on
+        # the dispatch breadcrumb; the generator body runs later, when
+        # _cur_trace belongs to someone else).
+        rid = self.obs.current_trace()
+        self.m.inc("kv.writes")
+
         # Write path: generator handler — yields let the pump advance.
         def run():
-            deadline = self.sched.now + self.DEADLINE_S
+            t_start = self.sched.now
+            deadline = t_start + self.DEADLINE_S
             while self.sched.now < deadline:
                 t = self.kv.submit(
                     g,
@@ -359,9 +400,24 @@ class EngineKVService:
                         if seq is None or self._dur.synced(seq):
                             break
                         yield 0.002
+                    self.m.observe(
+                        "kv.command_s", self.sched.now - t_start
+                    )
+                    if rid is not None:
+                        # The engine-side leg of the request's journey:
+                        # commit instant under the same id the clerk
+                        # and RPC spans carry.
+                        self.obs.tracer.instant(
+                            "commit",
+                            time.perf_counter() * 1e6,
+                            track="engine",
+                            req=rid,
+                            group=g,
+                        )
                     return EngineCmdReply(err=OK, value=t.value)
                 # failed (evicted/orphaned) or wedged: resubmit under
                 # the same (client_id, command_id) — dedup-safe.
+                self.m.inc("kv.resubmits")
             return EngineCmdReply(err=ERR_TIMEOUT)
 
         return run()
@@ -402,6 +458,7 @@ def serve_engine_kv(
             if os.path.exists(ckpt):
                 driver = EngineDriver.restore(ckpt, mesh=mesh)
         if driver is not None:
+            node.obs.metrics.inc("engine.restores")
             kv = BatchedKV(driver, record_groups=list(record_groups or []))
             blob = driver.restored_extra.get("service")
             if blob:
@@ -433,13 +490,18 @@ def serve_engine_kv(
         kv.route_check = route_group
         dur = (
             EngineDurability(data_dir, driver, kv,
-                             checkpoint_every_s=checkpoint_every_s)
+                             checkpoint_every_s=checkpoint_every_s,
+                             metrics=node.obs.metrics)
             if data_dir else None
         )
+        # Fold the driver's tick counter into the scrapeable registry
+        # (tick SPANS stay gated on the diagnostic tracer below — they
+        # force a device sync per tick).
+        driver.metrics = node.obs.metrics
         if node.tracer is not None:
             driver.tracer = node.tracer  # ticks + RPCs on one timeline
         svc = EngineKVService(
-            sched, kv, durability=dur,
+            sched, kv, durability=dur, obs=node.obs,
             ticks_per_pump=int(
                 os.environ.get("MULTIRAFT_SERVE_TICKS_PER_PUMP", "2")
             ),

@@ -15,6 +15,7 @@ processes), and durable serving (checkpoint + WAL + recovery via
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional, Sequence
 
 from ..engine.core import EngineConfig
@@ -73,6 +74,7 @@ class EngineShardKVService:
         ticks_per_pump: int = 2,
         peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
         durability: Optional[EngineDurability] = None,
+        obs=None,
     ) -> None:
         self.sched = sched
         self.skv = skv
@@ -82,6 +84,9 @@ class EngineShardKVService:
         self.peers = dict(peers or {})
         self._fleet = bool(self.peers)
         self._dur = durability
+        # Observability plane (see EngineKVService): the owning node's,
+        # lazily defaulted via the `obs` property for stub construction.
+        self._obs = obs
         # seq of the WAL record covering each applied insert — the GC
         # gate below refuses to ask the old owner to delete until the
         # inserted blob (possibly the last copy) is fsynced here.
@@ -121,6 +126,19 @@ class EngineShardKVService:
             skv.remote_fetch = self._remote_fetch
             skv.remote_delete = self._remote_delete
         sched.call_soon(self._pump_loop)
+
+    @property
+    def obs(self):
+        o = getattr(self, "_obs", None)
+        if o is None:
+            from .observe import Observability
+
+            o = self._obs = Observability()
+        return o
+
+    @property
+    def m(self):
+        return self.obs.metrics
 
     # -- durability hooks (apply-time, loop thread) -----------------------
 
@@ -213,6 +231,7 @@ class EngineShardKVService:
         from ..engine.shardkv import OK as SK_OK
 
         src_gid, shard, num = args
+        self.m.inc("migrate.pulls_served")
         if src_gid not in self.skv.reps:
             return (ERR_WRONG_GROUP,)
 
@@ -236,6 +255,7 @@ class EngineShardKVService:
         from ..engine.shardkv import OK as SK_OK
 
         src_gid, shard, num = args
+        self.m.inc("migrate.deletes_served")
         if src_gid not in self.skv.reps:
             return (ERR_WRONG_GROUP,)
 
@@ -362,7 +382,10 @@ class EngineShardKVService:
     def _pump_loop(self) -> None:
         if self._stopped:
             return
+        t0 = time.perf_counter()
         self.skv.pump(self._ticks)
+        self.m.inc("pump.count")
+        self.m.observe("pump.wall_s", time.perf_counter() - t0)
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
             for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs",
@@ -382,7 +405,10 @@ class EngineShardKVService:
         """Recovery replay — delegated to
         :class:`~.engine_durability.ShardWalReplay` (two-pass redo with
         migration paused; see its docstring for the full contract)."""
-        return ShardWalReplay(self.skv, self._dur).run()
+        n = ShardWalReplay(self.skv, self._dur).run()
+        self.m.inc("wal.replays")
+        self.m.inc("wal.replayed_records", n)
+        return n
 
     # Largest multi-op frame one RPC may carry (see EngineKVService).
     MAX_BATCH = 1024
@@ -500,6 +526,8 @@ class EngineShardKVService:
         from ..services.shardkv import key2shard
 
         if args.op == "Get":
+            self.m.inc("kv.gets")
+
             # ReadIndex fast read (BatchedShardKV.get_fast): no log
             # entry, gated on serving-shard ownership exactly like the
             # logged path; ErrWrongGroup during migration pumps and
@@ -521,8 +549,14 @@ class EngineShardKVService:
 
             return run_get()
 
+        # Request id captured at handler entry (dispatch breadcrumb —
+        # see EngineKVService.command).
+        rid = self.obs.current_trace()
+        self.m.inc("kv.writes")
+
         def run():
-            deadline = self.sched.now + self.DEADLINE_S
+            t_start = self.sched.now
+            deadline = t_start + self.DEADLINE_S
             while self.sched.now < deadline:
                 cfg = self.skv.query_latest()
                 gid = cfg.shards[key2shard(args.key)]
@@ -552,6 +586,15 @@ class EngineShardKVService:
                     if seq is None or self._dur.synced(seq):
                         break
                     yield 0.002
+                self.m.observe("kv.command_s", self.sched.now - t_start)
+                if rid is not None:
+                    self.obs.tracer.instant(
+                        "commit",
+                        time.perf_counter() * 1e6,
+                        track="engine",
+                        req=rid,
+                        group=gid,
+                    )
                 return EngineCmdReply(err=OK, value=t.value)
             return EngineCmdReply(err=ERR_TIMEOUT)
 
@@ -643,6 +686,8 @@ def serve_engine_shardkv(
             if os.path.exists(ckpt):
                 driver = EngineDriver.restore(ckpt, mesh=mesh)
         restored = driver is not None
+        if restored:
+            node.obs.metrics.inc("engine.restores")
         if not restored:
             cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
             driver = EngineDriver(cfg, seed=seed, mesh=mesh)
@@ -673,12 +718,15 @@ def serve_engine_shardkv(
                 skv.admin_sync("join", [gid])
         dur = (
             EngineDurability(data_dir, driver, skv,
-                             checkpoint_every_s=checkpoint_every_s)
+                             checkpoint_every_s=checkpoint_every_s,
+                             metrics=node.obs.metrics)
             if data_dir else None
         )
+        driver.metrics = node.obs.metrics  # scrapeable tick counter
         if node.tracer is not None:
             driver.tracer = node.tracer  # ticks + RPCs on one timeline
-        svc = EngineShardKVService(sched, skv, peers=peers, durability=dur)
+        svc = EngineShardKVService(sched, skv, peers=peers, durability=dur,
+                                   obs=node.obs)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
             dur.checkpoint()  # fold replay into a fresh checkpoint

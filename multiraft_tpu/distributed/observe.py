@@ -1,0 +1,131 @@
+"""Per-process observability plane for the real-socket stack.
+
+Every :class:`~multiraft_tpu.distributed.tcp.RpcNode` owns an
+:class:`Observability` — one :class:`~multiraft_tpu.utils.metrics.Metrics`
+registry plus one bounded :class:`~multiraft_tpu.utils.trace.Tracer` —
+and auto-registers the ``"Obs"`` control service on it, mirroring the
+``"Chaos"`` pattern (chaos.py).  Like chaos control frames, ``Obs.*``
+frames are exempt from fault injection (see
+:func:`is_control`): an observability plane that a nemesis can
+partition away goes dark exactly when you need it.
+
+The service verbs:
+
+* ``Obs.ping``     — liveness probe.
+* ``Obs.clock``    — this process's ``perf_counter`` in µs.  The
+  scraper estimates per-process clock offset from the round trip
+  (offset = remote_now − local_midpoint, taken at minimum RTT), which
+  is what lets :mod:`multiraft_tpu.harness.observe` merge trace
+  buffers from many processes onto one timeline.
+* ``Obs.snapshot`` — metrics registry snapshot (+ chaos-rule hit
+  counters when chaos is installed).
+* ``Obs.trace``    — drain the trace buffer.  Drain, not read: repeated
+  scrapes never duplicate events, and the server's memory stays bounded
+  by ``max_events`` between scrapes (drops are counted and reported).
+
+Timestamps everywhere are ``time.perf_counter() * 1e6`` — the same
+clock the RPC spans and engine tick spans already use, so one process's
+events need only a constant offset to land on the scraper's timeline.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.metrics import Metrics
+from ..utils.trace import Tracer
+
+__all__ = [
+    "Observability",
+    "ObsControl",
+    "install_obs",
+    "is_control",
+    "now_us",
+    "CONTROL_PREFIXES",
+]
+
+# Control-plane RPC prefixes exempt from fault injection everywhere
+# (outbound decide, inbound decide, reply decide — see tcp.py).
+CONTROL_PREFIXES = ("Chaos.", "Obs.")
+
+
+def is_control(svc_meth: str) -> bool:
+    return svc_meth.startswith(CONTROL_PREFIXES)
+
+
+def now_us() -> float:
+    """This process's trace clock (µs, arbitrary epoch, monotonic)."""
+    return time.perf_counter() * 1e6
+
+
+class Observability:
+    """One process's metrics registry + trace buffer.
+
+    ``max_events`` defaults from ``MRT_OBS_MAX_EVENTS`` (50k ≈ 10 MB
+    worst case) — the buffer self-truncates under load and ``dropped``
+    reports how much, so an unscrapped long run costs bounded memory.
+    """
+
+    def __init__(
+        self, name: Optional[str] = None, max_events: Optional[int] = None
+    ) -> None:
+        if max_events is None:
+            max_events = int(os.environ.get("MRT_OBS_MAX_EVENTS", "50000"))
+        self.name = name or f"pid{os.getpid()}"
+        self.metrics = Metrics()
+        self.tracer = Tracer(max_events=max_events)
+        self.node: Any = None  # back-ref set by the owning RpcNode
+
+    def current_trace(self) -> Optional[str]:
+        """The request id of the RPC being dispatched right now, if any
+        (loop-thread breadcrumb — lets service code deep in a handler
+        tag its own spans/instants with the caller's id)."""
+        n = self.node
+        return getattr(n, "_cur_trace", None) if n is not None else None
+
+
+class ObsControl:
+    """The ``"Obs"`` service: scrape verbs over the node's own plane."""
+
+    def __init__(self, node: Any) -> None:
+        self._node = node
+
+    def ping(self, args: Any = None) -> str:
+        return "pong"
+
+    def clock(self, args: Any = None) -> float:
+        return now_us()
+
+    def snapshot(self, args: Any = None) -> Dict[str, Any]:
+        obs = self._node.obs
+        out: Dict[str, Any] = {
+            "name": obs.name,
+            "pid": os.getpid(),
+            "now_us": now_us(),
+            "metrics": obs.metrics.snapshot(),
+        }
+        chaos = getattr(self._node, "chaos", None)
+        if chaos is not None:
+            out["chaos"] = chaos.snapshot()
+        return out
+
+    def trace(self, args: Any = None) -> Dict[str, Any]:
+        obs = self._node.obs
+        events, dropped = obs.tracer.drain()
+        return {
+            "name": obs.name,
+            "pid": os.getpid(),
+            "now_us": now_us(),
+            "events": events,
+            "dropped": dropped,
+        }
+
+
+def install_obs(node: Any) -> ObsControl:
+    """Register the ``"Obs"`` service on ``node`` (idempotent in effect;
+    mirrors chaos.install_chaos)."""
+    ctl = ObsControl(node)
+    node.add_service("Obs", ctl)
+    return ctl

@@ -16,8 +16,16 @@ costs two socket wakeups total.
 
 Frames are codec-encoded tuples:
 
-    ("req", req_id, svc_meth, args)   caller → callee
-    ("rep", req_id, value)            callee → caller
+    ("req", req_id, svc_meth, args)             caller → callee
+    ("req", req_id, svc_meth, args, trace_id)   …with a request id
+    ("rep", req_id, value)                      callee → caller
+
+The optional fifth element is a compact trace/request id (Dapper-style)
+appended only when the caller supplies one, so untagged traffic and old
+peers keep the 4-tuple wire shape.  The dispatcher stows it in
+``_cur_trace`` (loop-thread breadcrumb) and tags the handler span with
+it — one clerk request is followable clerk → server → engine commit
+across processes by grepping one id.
 
 Handlers returning generator coroutines (the wait-channel pattern,
 reference: kvraft/server.go:56-96) are spawned; the reply ships when
@@ -39,11 +47,13 @@ import itertools
 import os
 import sys
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..sim.scheduler import Future
 from ..transport import codec
 from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
+from .observe import Observability, install_obs, is_control
 from .realtime import IoScheduler
 
 __all__ = ["RpcNode", "TcpClientEnd"]
@@ -56,8 +66,8 @@ class TcpClientEnd:
         self._node = node
         self.addr = (host, port)
 
-    def call(self, svc_meth: str, args: Any) -> Future:
-        return self._node._call(self.addr, svc_meth, args)
+    def call(self, svc_meth: str, args: Any, trace: Optional[str] = None) -> Future:
+        return self._node._call(self.addr, svc_meth, args, trace)
 
 
 class RpcNode:
@@ -79,7 +89,8 @@ class RpcNode:
         self._handlers: Dict[str, Any] = {}  # "Svc.Meth" → bound method
         self._req_ids = itertools.count(1)
         self._lock = threading.Lock()
-        self._pending: Dict[int, Tuple[int, Future]] = {}  # req_id → (conn, fut)
+        # req_id → (conn, fut, svc_meth, t0, trace_id)
+        self._pending: Dict[int, Tuple] = {}
         self._conns: Dict[Tuple[str, int], int] = {}  # addr → conn id
         self._accepted: set = set()  # inbound conn ids (for sever)
         self._closed = False
@@ -87,20 +98,26 @@ class RpcNode:
         self.chaos = None
         # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
         self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
-        # MRT_TRACE_DIR=<dir>: record a Chrome-trace span per handled
-        # RPC (dispatch → reply), saved on close().  Engine servers
-        # additionally point their driver's tick spans at the same
-        # tracer, so one timeline shows RPC handling interleaved with
-        # device ticks.  Listening nodes only — pure clients handle no
-        # RPCs and would litter the dir with empty files.
+        # The per-process observability plane: counters + bounded span
+        # buffer, always on (a dict bump and one dict append per RPC),
+        # scrapeable over the node's own socket via the "Obs" service.
+        name = f"pid{os.getpid()}:{self.port}" if listen else None
+        self.obs = Observability(name=name)
+        self.obs.node = self
+        self._cur_trace: Optional[str] = None
+        install_obs(self)
+        # MRT_TRACE_DIR=<dir>: save the span buffer on close().  Engine
+        # servers additionally point their driver's tick spans at the
+        # same tracer (via ``self.tracer``), so one timeline shows RPC
+        # handling interleaved with device ticks.  Listening nodes only
+        # — pure clients handle no RPCs and would litter the dir with
+        # empty files.
         self.tracer = None
         self._trace_path = None
         trace_dir = os.environ.get("MRT_TRACE_DIR")
         if trace_dir and listen:
-            from ..utils.trace import Tracer
-
             os.makedirs(trace_dir, exist_ok=True)
-            self.tracer = Tracer()
+            self.tracer = self.obs.tracer
             # Process-local counter, not id(self): CPython recycles ids,
             # and a recycled id would overwrite an earlier node's trace.
             seq = next(RpcNode._trace_seq)
@@ -158,36 +175,60 @@ class RpcNode:
             self._conns[addr] = cid
         return cid
 
-    def _call(self, addr: Tuple[str, int], svc_meth: str, args: Any) -> Future:
+    def _call(
+        self,
+        addr: Tuple[str, int],
+        svc_meth: str,
+        args: Any,
+        trace_id: Optional[str] = None,
+    ) -> Future:
         fut = Future()
+        m = self.obs.metrics
+        m.inc("rpc.calls")
         chaos = self.chaos
-        if chaos is not None and not svc_meth.startswith("Chaos."):
+        if chaos is not None and not is_control(svc_meth):
             act = chaos.decide_out(addr)
             if act == "drop":
                 # Lost request: the future never resolves — the
                 # caller's with_timeout fires and its retry loop takes
                 # over (labrpc's "server never heard it").
+                m.inc("rpc.chaos_out_dropped")
                 return fut
             if act != "pass":  # a delay in seconds
+                m.inc("rpc.chaos_out_delayed")
                 self.sched.call_after(
-                    act, self._send_request, addr, svc_meth, args, fut
+                    act, self._send_request, addr, svc_meth, args, fut, trace_id
                 )
                 return fut
-        self._send_request(addr, svc_meth, args, fut)
+        self._send_request(addr, svc_meth, args, fut, trace_id)
         return fut
 
     def _send_request(
-        self, addr: Tuple[str, int], svc_meth: str, args: Any, fut: Future
+        self,
+        addr: Tuple[str, int],
+        svc_meth: str,
+        args: Any,
+        fut: Future,
+        trace_id: Optional[str] = None,
     ) -> None:
+        m = self.obs.metrics
         cid = self._conn_for(addr)
         if cid is None:
             # Resolve asynchronously so callers may attach callbacks first.
+            m.inc("rpc.conn_fail")
             self.sched.call_soon(fut.resolve, None)
             return
         req_id = next(self._req_ids)
         with self._lock:
-            self._pending[req_id] = (cid, fut)
-        ok = self._tr.send(cid, codec.encode(("req", req_id, svc_meth, args)))
+            self._pending[req_id] = (
+                cid, fut, svc_meth, time.perf_counter(), trace_id
+            )
+        if trace_id is None:
+            frame = ("req", req_id, svc_meth, args)
+        else:
+            frame = ("req", req_id, svc_meth, args, trace_id)
+        buf = codec.encode(frame)
+        ok = self._tr.send(cid, buf)
         if not ok:
             # The transport no longer knows this conn (torn down between
             # our lookup and the send) — drop the stale cache entry so the
@@ -196,7 +237,11 @@ class RpcNode:
                 self._pending.pop(req_id, None)
                 if self._conns.get(addr) == cid:
                     del self._conns[addr]
+            m.inc("rpc.conn_fail")
             self.sched.call_soon(fut.resolve, None)
+            return
+        m.inc("rpc.frames_out")
+        m.inc("rpc.bytes_out", len(buf))
 
     def _on_event(self, ev: Tuple[int, int, bytes]) -> None:
         # Runs on the scheduler loop (the IO reactor thread).
@@ -205,6 +250,9 @@ class RpcNode:
             # One malformed frame must never kill the loop — the node
             # would go permanently dark.  Shape errors (IndexError on
             # msg[...]) are as fatal as decode errors.
+            m = self.obs.metrics
+            m.inc("rpc.frames_in")
+            m.inc("rpc.bytes_in", len(payload))
             try:
                 msg = codec.decode(payload)
                 if self._dbg:
@@ -220,39 +268,54 @@ class RpcNode:
                         pass
                 chaos = self.chaos
                 if chaos is not None and not (
-                    msg[0] == "req" and msg[2].startswith("Chaos.")
+                    msg[0] == "req" and is_control(msg[2])
                 ):
-                    # Control frames are exempt: a chaos layer that can
-                    # partition away its own antidote wedges the run.
+                    # Control frames (Chaos./Obs.) are exempt: a chaos
+                    # layer that can partition away its own antidote —
+                    # or blind the observer watching it — wedges the run.
                     act = chaos.decide_in()
                     if act == "drop":
+                        m.inc("rpc.chaos_in_dropped")
                         return
                     if act != "pass":  # delayed delivery (may reorder)
+                        m.inc("rpc.chaos_in_delayed")
                         self.sched.call_after(
                             act, self._handle_msg, conn, msg
                         )
                         return
                 self._handle_msg(conn, msg)
             except Exception as exc:
+                m.inc("rpc.bad_frames")
                 if self._dbg:
                     print(f"[rpc] bad frame dropped: {exc!r}",
                           file=sys.stderr, flush=True)
         elif typ == EV_ACCEPT:
             self._accepted.add(conn)
         elif typ == EV_CLOSED:
+            self.obs.metrics.inc("rpc.conns_closed")
             self._accepted.discard(conn)
             self._on_closed(conn)
 
     def _handle_msg(self, conn: int, msg: Any) -> None:
         if msg[0] == "req":
-            _, req_id, svc_meth, args = msg
-            self._dispatch(conn, req_id, svc_meth, args)
+            # 4-tuple = untagged (old wire shape); 5th element = trace id.
+            trace_id = msg[4] if len(msg) > 4 else None
+            self._dispatch(conn, msg[1], msg[2], msg[3], trace_id)
         elif msg[0] == "rep":
             _, req_id, value = msg
             with self._lock:
                 entry = self._pending.pop(req_id, None)
             if entry is not None:
-                entry[1].resolve(value)
+                _, fut, svc_meth, t0, trace_id = entry
+                dt = time.perf_counter() - t0
+                self.obs.metrics.observe("rpc.client.call_s", dt)
+                if trace_id is not None:
+                    # Caller-side leg of the cross-process span pair.
+                    self.obs.tracer.span(
+                        svc_meth, t0 * 1e6, dt * 1e6, track="rpc-out",
+                        req=trace_id,
+                    )
+                fut.resolve(value)
 
     def _on_closed(self, conn: int) -> None:
         with self._lock:
@@ -260,35 +323,45 @@ class RpcNode:
                 if cid == conn:
                     del self._conns[addr]
             dead = [
-                (rid, fut)
-                for rid, (cid, fut) in self._pending.items()
-                if cid == conn
+                (rid, entry[1])
+                for rid, entry in self._pending.items()
+                if entry[0] == conn
             ]
             for rid, _ in dead:
                 del self._pending[rid]
+        if dead:
+            self.obs.metrics.inc("rpc.pending_failed", len(dead))
         for _, fut in dead:
             fut.resolve(None)
 
-    def _dispatch(self, conn: int, req_id: int, svc_meth: str, args: Any) -> None:
+    def _dispatch(
+        self,
+        conn: int,
+        req_id: int,
+        svc_meth: str,
+        args: Any,
+        trace_id: Optional[str] = None,
+    ) -> None:
         # Runs on the scheduler loop.  Control replies bypass reply
         # chaos (same exemption as the inbound path).
-        reply = (
-            self._reply if svc_meth.startswith("Chaos.")
-            else self._reply_chaos
-        )
-        if self.tracer is not None:
-            import time as _time
+        reply = self._reply if is_control(svc_meth) else self._reply_chaos
+        obs = self.obs
+        obs.metrics.inc("rpc.handled")
+        t0 = time.perf_counter()
 
-            t0 = _time.perf_counter()
+        def _done(conn_, req_id_, value):
+            dt = time.perf_counter() - t0
+            obs.metrics.observe("rpc.handle_s", dt)
+            sargs: Dict[str, Any] = {
+                "outcome": "ok" if value is not None else "none"
+            }
+            if trace_id is not None:
+                sargs["req"] = trace_id
+            obs.tracer.span(
+                svc_meth, t0 * 1e6, dt * 1e6, track="rpc", **sargs
+            )
+            reply(conn_, req_id_, value)
 
-            def _done(conn_, req_id_, value):
-                now = _time.perf_counter()
-                self.tracer.span(
-                    svc_meth, t0 * 1e6, (now - t0) * 1e6, track="rpc"
-                )
-                reply(conn_, req_id_, value)
-        else:
-            _done = reply
         try:
             handler = self._handlers.get(svc_meth)
             if handler is None:
@@ -296,12 +369,16 @@ class RpcNode:
                 obj = self._services[svc_name]
                 handler = getattr(obj, _snake(meth))
                 self._handlers[svc_meth] = handler
-            # Loop-thread-only breadcrumb: lets a handler exempt the
-            # connection its own request rode in on (Chaos.sever must
-            # not cut the control channel out from under its reply).
+            # Loop-thread-only breadcrumbs: _cur_conn lets a handler
+            # exempt the connection its own request rode in on
+            # (Chaos.sever must not cut the control channel out from
+            # under its reply); _cur_trace carries the request id so
+            # service code can tag downstream spans with it.
             self._cur_conn = conn
+            self._cur_trace = trace_id
             result = handler(args)
         except Exception:
+            obs.metrics.inc("rpc.handler_errors")
             result = None
         if _is_gen(result):
             # Guard the coroutine body too: a handler that raises mid-wait
@@ -323,17 +400,23 @@ class RpcNode:
         if chaos is not None:
             act = chaos.decide_reply()
             if act == "drop":
+                self.obs.metrics.inc("rpc.replies_dropped")
                 return
             if act != "pass":
+                self.obs.metrics.inc("rpc.replies_delayed")
                 self.sched.call_after(act, self._reply, conn, req_id, value)
                 return
         self._reply(conn, req_id, value)
 
     def _reply(self, conn: int, req_id: int, value: Any) -> None:
         try:
-            self._tr.send(conn, codec.encode(("rep", req_id, value)))
+            buf = codec.encode(("rep", req_id, value))
+            self._tr.send(conn, buf)
+            m = self.obs.metrics
+            m.inc("rpc.frames_out")
+            m.inc("rpc.bytes_out", len(buf))
         except Exception:
-            pass
+            self.obs.metrics.inc("rpc.reply_send_fail")
 
     def sever(
         self,

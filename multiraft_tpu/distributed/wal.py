@@ -28,8 +28,11 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
-from typing import Iterator
+from typing import Iterator, Optional
+
+from ..utils.metrics import Metrics
 
 __all__ = ["WriteAheadLog"]
 
@@ -46,9 +49,18 @@ class WriteAheadLog:
     they are not stored).
     """
 
-    def __init__(self, path: str, fsync: bool = True) -> None:
+    def __init__(
+        self,
+        path: str,
+        fsync: bool = True,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
         self.path = path
         self._fsync = fsync
+        # Observability: appends/fsyncs/rotates as counters, fsync
+        # latency as samples.  A private registry when the owner passes
+        # none — the instrumentation below never branches on None.
+        self.metrics = metrics if metrics is not None else Metrics()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # Truncate any torn tail BEFORE appending: records written
         # after leftover garbage would be unreachable to every future
@@ -119,15 +131,22 @@ class WriteAheadLog:
         self._f.write(_HEADER.pack(_MAGIC, crc, len(body)))
         self._f.write(body)
         self.appended += 1
+        m = self.metrics
+        m.inc("wal.appends")
+        m.inc("wal.bytes", _HEADER.size + len(body))
         return self.appended
 
     def sync(self) -> None:
         """Group commit: make everything appended so far durable."""
         if self.synced >= self.appended:
             return
+        t0 = time.perf_counter()
         self._f.flush()
         if self._fsync:
             os.fsync(self._f.fileno())
+        m = self.metrics
+        m.inc("wal.fsyncs")
+        m.observe("wal.fsync_s", time.perf_counter() - t0)
         self.synced = self.appended
 
     # -- rotation (after a successful checkpoint) -------------------------
@@ -150,6 +169,7 @@ class WriteAheadLog:
             finally:
                 os.close(dfd)
         self._f = open(self.path, "ab")
+        self.metrics.inc("wal.rotates")
         # appended/synced deliberately NOT reset — see __init__.
 
     def close(self) -> None:

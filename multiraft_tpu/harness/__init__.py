@@ -1,6 +1,21 @@
 """Test harnesses: sim-backend drivers (cluster.py, kv_harness.py,
-ctrler_harness.py) and the real-socket nemesis (nemesis.py)."""
+ctrler_harness.py), the real-socket nemesis (nemesis.py), and the
+fleet observability scraper (observe.py)."""
 
-from .nemesis import ChaosClient, Nemesis, make_schedule, run_clerk_load
+from .nemesis import (
+    ChaosClient,
+    Nemesis,
+    NemesisVerificationError,
+    make_schedule,
+    run_clerk_load,
+)
+from .observe import FleetObserver
 
-__all__ = ["ChaosClient", "Nemesis", "make_schedule", "run_clerk_load"]
+__all__ = [
+    "ChaosClient",
+    "FleetObserver",
+    "Nemesis",
+    "NemesisVerificationError",
+    "make_schedule",
+    "run_clerk_load",
+]

@@ -46,6 +46,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..distributed.chaos import ChaosRule
+from ..distributed.observe import now_us
 from ..distributed.tcp import RpcNode
 from ..sim.scheduler import TIMEOUT
 
@@ -53,6 +54,7 @@ __all__ = [
     "make_schedule",
     "ChaosClient",
     "Nemesis",
+    "NemesisVerificationError",
     "run_clerk_load",
 ]
 
@@ -185,6 +187,11 @@ def _rule(**kw) -> Dict[str, Any]:
     return ChaosRule(**kw).to_wire()
 
 
+class NemesisVerificationError(AssertionError):
+    """A scheduled fault window never demonstrably fired — the run was
+    a false green (the fleet was never actually under that fault)."""
+
+
 class Nemesis:
     """Executes a :func:`make_schedule` timeline against live servers.
 
@@ -215,14 +222,64 @@ class Nemesis:
             a: {"peers": {}, "all_out": None, "all_in": None, "reply": None}
             for a in self.addrs
         }
+        # Window verification ledger (see verify_windows): one record
+        # per scheduled fault window, with actual wall times in this
+        # process's perf_counter µs domain (so harness/observe.py can
+        # overlay them on a merged trace without further alignment).
+        self.windows: List[Dict[str, Any]] = []
+        self._open: Dict[int, Dict[str, Any]] = {}
+        self.t0_us: Optional[float] = None
+        self.error: Optional[BaseException] = None
 
     # -- model push --------------------------------------------------------
 
-    def _push(self, addr: Addr) -> None:
-        self.ctl.set_rules(addr, self._model[addr])
+    def _push(self, addr: Addr) -> Optional[Dict[str, Any]]:
+        """Push the full rule snapshot; the ack (the target's own
+        post-configure snapshot, including its chaos hit ledger) is how
+        windows prove they actually landed."""
+        return self.ctl.set_rules(addr, self._model[addr])
 
     def _log(self, phase: str, kind: str, p: Dict[str, Any]) -> None:
         self.applied.append((phase, kind, dict(p)))
+
+    # -- window ledger -----------------------------------------------------
+
+    @staticmethod
+    def _hit_count(snap, paths, kinds) -> int:
+        hits = (snap or {}).get("hits") or {}
+        return sum(
+            int((hits.get(path) or {}).get(k, 0))
+            for path in paths
+            for k in kinds
+        )
+
+    def _window(self, kind: str, p: Dict[str, Any], procs) -> Dict[str, Any]:
+        w = {
+            "kind": kind, "p": dict(p), "procs": list(procs),
+            "t_start_us": now_us(), "t_stop_us": None,
+            "acked": False, "hits": 0, "baseline": 0, "excused": None,
+        }
+        self.windows.append(w)
+        self._open[id(p)] = w
+        return w
+
+    @staticmethod
+    def _hit_spec(kind: str, p, addrs) -> List[Tuple[Addr, list, tuple]]:
+        """Which (target, hit-ledger paths, fault kinds) prove a window
+        of this kind applied at least one fault."""
+        if kind == "delay_storm":
+            return [(addrs[p["proc"]], ["all_in"], ("delay",))]
+        if kind == "drop_storm":
+            return [(addrs[p["proc"]], ["all_in", "reply"], ("drop",))]
+        if kind == "isolate":
+            return [(addrs[p["proc"]], ["all_in"], ("block",))]
+        if kind == "partition":
+            aa, ab = addrs[p["a"]], addrs[p["b"]]
+            return [
+                (aa, [f"peer:{ab[0]}:{ab[1]}"], ("block",)),
+                (ab, [f"peer:{aa[0]}:{aa[1]}"], ("block",)),
+            ]
+        return []
 
     # -- actions -----------------------------------------------------------
 
@@ -230,60 +287,110 @@ class Nemesis:
         self._log("start", kind, p)
         if kind == "delay_storm":
             a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
             self._model[a]["all_in"] = _rule(
                 delay=p["prob"], delay_min=p["delay_min"],
                 delay_max=p["delay_max"],
             )
-            self._push(a)
+            self._ack_start(w, [self._push(a)])
         elif kind == "drop_storm":
             a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
             self._model[a]["all_in"] = _rule(drop=p["prob"])
             # Reply drops: the op APPLIED but the ack is lost — only
             # session dedup keeps the client's retry exactly-once.
             self._model[a]["reply"] = _rule(drop=p["prob"] / 2.0)
-            self._push(a)
+            self._ack_start(w, [self._push(a)])
         elif kind == "isolate":
             a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
             self._model[a]["all_in"] = _rule(block=True)
-            self._push(a)
+            self._ack_start(w, [self._push(a)])
         elif kind == "partition":
             aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+            w = self._window(kind, p, [p["a"], p["b"]])
             self._model[aa]["peers"][f"{ab[0]}:{ab[1]}"] = _rule(block=True)
             self._model[ab]["peers"][f"{aa[0]}:{aa[1]}"] = _rule(block=True)
-            self._push(aa)
-            self._push(ab)
+            self._ack_start(w, [self._push(aa), self._push(ab)])
         elif kind == "sever":
-            self.ctl.sever(self.addrs[p["proc"]])
+            w = self._window(kind, p, [p["proc"]])
+            cut = self.ctl.sever(self.addrs[p["proc"]])
+            w["acked"] = cut is not None
+            w["hits"] = int(cut or 0)
+            w["t_stop_us"] = now_us()
+            self._open.pop(id(p), None)
         elif kind == "crash":
             if self._kill is None:
                 raise ValueError("crash event but no kill callback")
+            w = self._window(kind, p, [p["proc"]])
             self._kill(p["proc"])
+            w["acked"] = True  # the kill callback ran
         elif kind == "heal":
             self.heal_all()
         else:
             raise ValueError(f"unknown nemesis action {kind!r}")
 
+    def _ack_start(self, w: Dict[str, Any], acks) -> None:
+        w["acked"] = all(a is not None for a in acks)
+        spec = self._hit_spec(w["kind"], w["p"], self.addrs)
+        w["baseline"] = sum(
+            self._hit_count(ack, paths, kinds)
+            for ack, (_, paths, kinds) in zip(acks, spec)
+        )
+        if not w["acked"]:
+            # The only reachable-in-theory failure: the target is down
+            # (an overlapping crash window) — the control plane itself
+            # is chaos-exempt, so a live target always acks.
+            w["excused"] = "start push unacknowledged (target down?)"
+
     def _stop(self, kind: str, p: Dict[str, Any]) -> None:
         self._log("stop", kind, p)
-        if kind in ("delay_storm", "drop_storm", "isolate"):
-            a = self.addrs[p["proc"]]
-            self._model[a]["all_in"] = None
-            if kind == "drop_storm":
-                self._model[a]["reply"] = None
-            self._push(a)
-        elif kind == "partition":
-            aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
-            self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
-            self._model[ab]["peers"].pop(f"{aa[0]}:{aa[1]}", None)
-            self._push(aa)
-            self._push(ab)
+        w = self._open.pop(id(p), None)
+        if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
+            if kind == "partition":
+                aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+                self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
+                self._model[ab]["peers"].pop(f"{aa[0]}:{aa[1]}", None)
+                acks = [self._push(aa), self._push(ab)]
+            else:
+                a = self.addrs[p["proc"]]
+                self._model[a]["all_in"] = None
+                if kind == "drop_storm":
+                    self._model[a]["reply"] = None
+                acks = [self._push(a)]
+            if w is not None:
+                w["t_stop_us"] = now_us()
+                spec = self._hit_spec(kind, p, self.addrs)
+                if all(a is not None for a in acks):
+                    total = sum(
+                        self._hit_count(ack, paths, kinds)
+                        for ack, (_, paths, kinds) in zip(acks, spec)
+                    )
+                    w["hits"] = max(0, total - w["baseline"])
+                else:
+                    w["excused"] = (
+                        w["excused"] or "stop push unacknowledged"
+                    )
         elif kind == "crash":
             if self._restart is None:
                 raise ValueError("crash event but no restart callback")
             self._restart(p["proc"])
             # The reborn process has clean rules; re-push its active
             # set so a crash inside another fault window composes.
-            self._push(self.addrs[p["proc"]])
+            ack = self._push(self.addrs[p["proc"]])
+            if w is not None:
+                w["t_stop_us"] = now_us()
+            if ack is not None:
+                # Open windows targeting this proc had their rules
+                # re-installed by that push — they are live after all.
+                for w2 in self.windows:
+                    if (
+                        w2["t_stop_us"] is None
+                        and p["proc"] in w2["procs"]
+                        and not w2["acked"]
+                    ):
+                        w2["acked"] = True
+                        w2["excused"] = "re-acked after crash restart"
 
     def heal_all(self) -> None:
         for a in self.addrs:
@@ -292,13 +399,47 @@ class Nemesis:
             }
         self.ctl.clear_all()
 
+    # -- verification ------------------------------------------------------
+
+    def verify_windows(self, require_hits: Sequence[str] = ()) -> None:
+        """Assert every scheduled fault window demonstrably fired.
+
+        Baseline check (always): each window's rule push was
+        acknowledged by the target (the control plane is chaos-exempt,
+        so an unacked push means the window silently missed), each
+        crash's kill callback ran, each sever got a cut-count reply.
+        A window whose target was down for an overlapping crash is
+        excused only if the restart re-push re-installed its rules.
+
+        ``require_hits`` names window kinds (e.g. ``("drop_storm",)``)
+        that must additionally show ≥ 1 fault actually applied (chaos
+        hit-ledger delta over the window) — stricter, but only sound
+        when the caller guarantees traffic at the faulted process
+        during every window.  Raises :class:`NemesisVerificationError`
+        listing every silent miss."""
+        bad: List[str] = []
+        for n, w in enumerate(self.windows):
+            tag = f"window {n}: {w['kind']} {w['p']}"
+            if not w["acked"]:
+                bad.append(f"{tag} — never acknowledged"
+                           f" ({w['excused'] or 'no excuse recorded'})")
+            elif w["kind"] in require_hits and w["hits"] < 1:
+                bad.append(f"{tag} — acked but zero faults applied")
+        if bad:
+            raise NemesisVerificationError(
+                "scheduled fault windows did not fire:\n  "
+                + "\n  ".join(bad)
+            )
+
     # -- execution ---------------------------------------------------------
 
-    def run(self, schedule: Sequence[Event]) -> None:
+    def run(self, schedule: Sequence[Event], verify: bool = True) -> None:
         """Execute the timeline in this thread.  Blocking actions
         (restart-from-WAL waits for the readiness line) push later
         actions back; the log records intent order, which is the
-        deterministic part."""
+        deterministic part.  With ``verify`` (default), raises
+        :class:`NemesisVerificationError` at the end if any window
+        silently missed (see :meth:`verify_windows`)."""
         actions: List[Tuple[float, int, str, str, Dict[str, Any]]] = []
         for n, (at, kind, p) in enumerate(schedule):
             if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
@@ -311,6 +452,9 @@ class Nemesis:
                 actions.append((at, n, "start", kind, p))
         actions.sort(key=lambda a: (a[0], a[1], a[2] == "start"))
         t0 = time.monotonic()
+        # Anchor for timeline overlays: schedule second ``at`` maps to
+        # perf_counter µs ``self.t0_us + at*1e6`` in this process.
+        self.t0_us = now_us()
         for at, _, phase, kind, p in actions:
             delay = at - (time.monotonic() - t0)
             if delay > 0:
@@ -319,15 +463,26 @@ class Nemesis:
                 self._start(kind, p)
             else:
                 self._stop(kind, p)
+        if verify:
+            self.verify_windows()
 
     def run_async(self, schedule: Sequence[Event]) -> threading.Thread:
         """Run the schedule on a daemon thread (the usual shape: the
         nemesis runs WHILE the caller applies clerk load).  Join the
-        returned thread before asserting on ``applied``."""
-        t = threading.Thread(
-            target=self.run, args=(list(schedule),),
-            name="nemesis", daemon=True,
-        )
+        returned thread, then call :meth:`verify_windows` — a raise
+        inside the daemon thread would vanish, so auto-verify is off
+        here and any execution error is re-raised from ``self.error``
+        by :meth:`verify_windows`'s caller checking it (or just read
+        ``nem.error`` after join)."""
+        self.error: Optional[BaseException] = None
+
+        def _run() -> None:
+            try:
+                self.run(list(schedule), verify=False)
+            except BaseException as exc:  # noqa: BLE001 - surfaced via .error
+                self.error = exc
+
+        t = threading.Thread(target=_run, name="nemesis", daemon=True)
         t.start()
         return t
 
@@ -341,6 +496,7 @@ def run_clerk_load(
     n_workers: int = 3,
     ops_per_worker: int = 9,
     op_timeout: float = 90.0,
+    trace_sink: Optional[list] = None,
 ) -> list:
     """Concurrent blocking-clerk load returning a porcupine history.
 
@@ -350,6 +506,12 @@ def run_clerk_load(
     longest fault window: every fault heals itself, so a retrying
     clerk always converges and the history contains no ambiguous
     (timed-out) operations — porcupine then checks completed ops only.
+
+    ``trace_sink``: a list that collects each clerk node's trace
+    events (drained just before the clerk closes — clerk-side request
+    spans would otherwise die with the node).  Events are already in
+    this process's clock domain; harness/observe.py merges them with
+    the servers' scraped traces.
 
     Worker exceptions propagate after all threads join (a hung clerk
     is a test failure, not a deadlock)."""
@@ -383,6 +545,13 @@ def run_clerk_load(
         except Exception as exc:  # noqa: BLE001 - reported after join
             failures.append((wid, exc))
         finally:
+            if trace_sink is not None:
+                node = getattr(ck, "node", None)
+                obs = getattr(node, "obs", None)
+                if obs is not None:
+                    events, _dropped = obs.tracer.drain()
+                    with lock:
+                        trace_sink.extend(events)
             ck.close()
 
     threads = [

@@ -1,0 +1,225 @@
+"""Fleet scraper: one merged, clock-aligned timeline per run.
+
+Every server process carries an ``"Obs"`` control service
+(distributed/observe.py) exposing its metrics registry and trace
+buffer.  :class:`FleetObserver` is the host side: it scrapes the whole
+fleet over a chaos-free :class:`~multiraft_tpu.distributed.tcp.RpcNode`,
+estimates each process's clock offset from scrape round trips, shifts
+every remote event onto the host clock, and assembles ONE Chrome-trace
+JSON where clerk spans (host process), server dispatch spans, engine
+commit instants, and nemesis fault windows all line up on a shared
+time axis — the "what was the fleet doing while that window was open"
+view that per-process logs cannot give.
+
+Clock alignment: ``Obs.clock`` returns the remote ``perf_counter`` in
+µs.  For each process the observer takes several round trips and keeps
+the offset measured at MINIMUM RTT (the sample least smeared by queue
+delay): ``offset = remote_now − (t_send + t_recv)/2``.  Remote event
+timestamps are then shifted by ``−offset``.  On one machine (the
+process-cluster harness) the clocks share a timebase and offsets are
+dominated by per-process ``perf_counter`` epochs — typically constant
+to well under a millisecond, which is enough to order windows against
+request spans.
+
+Usage (the slow nemesis test is the canonical caller)::
+
+    obs = FleetObserver(addrs)
+    ...run nemesis + clerk load (collecting clerk events)...
+    tracer = obs.merged_timeline(
+        local_events=clerk_events, windows=nem.windows)
+    tracer.save("trace_nemesis.json.gz")
+    snaps = obs.snapshot_all()
+    obs.close()
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..distributed.observe import now_us
+from ..distributed.tcp import RpcNode
+from ..sim.scheduler import TIMEOUT
+from ..utils.trace import Tracer
+
+__all__ = ["FleetObserver"]
+
+Addr = Tuple[str, int]
+
+
+class FleetObserver:
+    """Scrapes ``Obs.*`` across a fleet and merges the results.
+
+    The observer's own node carries no chaos, and ``Obs.*`` frames are
+    control-exempt on the targets, so scrapes work mid-fault — a
+    CRASHED process is simply unreachable and is skipped (recorded in
+    :attr:`unreachable`)."""
+
+    def __init__(self, addrs: Sequence[Addr]) -> None:
+        self.node = RpcNode()
+        self.sched = self.node.sched
+        self.addrs: List[Addr] = [tuple(a) for a in addrs]
+        self.ends = {a: self.node.client_end(*a) for a in self.addrs}
+        # addr -> best (min-RTT) clock offset estimate so far, µs.
+        self.offsets: Dict[Addr, float] = {}
+        self.unreachable: List[Addr] = []
+
+    # -- raw scrape verbs --------------------------------------------------
+
+    def call(
+        self, addr: Addr, meth: str, args: Any = None,
+        timeout: float = 2.0, retries: int = 3,
+    ) -> Any:
+        for attempt in range(retries):
+            reply = self.sched.wait(
+                self.ends[addr].call(f"Obs.{meth}", args), timeout
+            )
+            if reply is not None and reply is not TIMEOUT:
+                return reply
+            time.sleep(0.05 * (attempt + 1))
+        return None
+
+    def ping(self, addr: Addr) -> bool:
+        return self.call(addr, "ping") == "pong"
+
+    def snapshot(self, addr: Addr) -> Optional[Dict[str, Any]]:
+        return self.call(addr, "snapshot")
+
+    def snapshot_all(self) -> Dict[str, Dict[str, Any]]:
+        """Scrape every reachable process: ``{"host:port": snapshot}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for a in self.addrs:
+            snap = self.snapshot(a)
+            if snap is not None:
+                out[f"{a[0]}:{a[1]}"] = snap
+        return out
+
+    def drain_trace(self, addr: Addr) -> Optional[Dict[str, Any]]:
+        return self.call(addr, "trace", timeout=5.0)
+
+    # -- clock alignment ---------------------------------------------------
+
+    def clock_offset_us(
+        self, addr: Addr, samples: int = 7,
+    ) -> Optional[float]:
+        """Min-RTT midpoint estimate of ``remote_clock − local_clock``
+        (µs); ``None`` when the process is unreachable.  The freshest
+        successful estimate is cached in :attr:`offsets` and reused
+        when a later scrape finds the process unreachable."""
+        best_rtt, best_off = None, None
+        for _ in range(samples):
+            t0 = now_us()
+            remote = self.call(addr, "clock", retries=1, timeout=1.0)
+            t1 = now_us()
+            if remote is None:
+                continue
+            rtt = t1 - t0
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt, best_off = rtt, float(remote) - (t0 + t1) / 2.0
+        if best_off is None:
+            return self.offsets.get(addr)
+        self.offsets[addr] = best_off
+        return best_off
+
+    # -- merged timeline ---------------------------------------------------
+
+    def merged_timeline(
+        self,
+        local_events: Sequence[Dict[str, Any]] = (),
+        windows: Sequence[Dict[str, Any]] = (),
+        schedule: Sequence[Tuple[float, str, Dict[str, Any]]] = (),
+        t0_us: Optional[float] = None,
+        local_name: str = "host (clerks + nemesis)",
+    ) -> Tracer:
+        """Drain every reachable process's trace buffer, shift each
+        event onto the host clock, and return one :class:`Tracer`:
+
+        * pid 0 — the host process: ``local_events`` verbatim (clerk
+          request spans from :func:`~.nemesis.run_clerk_load`'s
+          ``trace_sink``) plus one ``nemesis`` track annotating fault
+          ``windows`` (:attr:`~.nemesis.Nemesis.windows` records, in
+          host-clock µs already) and/or a planned ``schedule`` anchored
+          at ``t0_us`` (:attr:`~.nemesis.Nemesis.t0_us`).
+        * pid 1..N — one per fleet process, labelled with the remote
+          ``Observability.name``, events shifted by the min-RTT clock
+          offset.
+
+        Unreachable processes are skipped and listed in
+        :attr:`unreachable` — a merged trace must not silently present
+        a partial fleet as the whole one."""
+        parts: List[Tuple[Addr, float, Dict[str, Any]]] = []
+        self.unreachable = []
+        for a in self.addrs:
+            off = self.clock_offset_us(a)
+            part = self.drain_trace(a) if off is not None else None
+            if part is None or off is None:
+                self.unreachable.append(a)
+                continue
+            parts.append((a, off, part))
+
+        n_events = (
+            len(local_events)
+            + sum(len(p["events"]) for _, _, p in parts)
+            + 2 * (len(windows) + len(schedule))
+            + len(parts)
+            + 64
+        )
+        out = Tracer(max_events=n_events)
+        out.process_name(0, local_name)
+        for ev in local_events:
+            ev = dict(ev)
+            ev["pid"] = 0
+            out._emit(ev)
+
+        for i, (a, off, part) in enumerate(parts):
+            pid = i + 1
+            out.process_name(pid, f"{part.get('name')} @ {a[0]}:{a[1]}")
+            for ev in part["events"]:
+                ev = dict(ev)
+                ev["ts"] = float(ev["ts"]) - off
+                ev["pid"] = pid
+                out._emit(ev)
+            if part.get("dropped"):
+                out.instant(
+                    "trace_buffer_dropped",
+                    float(part["now_us"]) - off,
+                    track="obs", pid=pid, dropped=part["dropped"],
+                )
+
+        self._annotate(out, windows, schedule, t0_us)
+        return out
+
+    @staticmethod
+    def _annotate(
+        out: Tracer,
+        windows: Sequence[Dict[str, Any]],
+        schedule: Sequence[Tuple[float, str, Dict[str, Any]]],
+        t0_us: Optional[float],
+    ) -> None:
+        """Fault windows onto pid 0's ``nemesis`` track: executed
+        windows as spans (actual wall times + outcome args), planned
+        schedule entries as instants (intent times)."""
+        for w in windows:
+            ts = float(w["t_start_us"])
+            stop = w.get("t_stop_us")
+            dur = max(0.0, float(stop) - ts) if stop is not None else 0.0
+            args = {
+                "acked": w.get("acked"), "hits": w.get("hits"),
+                **{k: v for k, v in (w.get("p") or {}).items()},
+            }
+            if w.get("excused"):
+                args["excused"] = w["excused"]
+            if dur > 0:
+                out.span(w["kind"], ts, dur, track="nemesis", pid=0, **args)
+            else:
+                out.instant(w["kind"], ts, track="nemesis", pid=0, **args)
+        if t0_us is not None:
+            for at, kind, p in schedule:
+                out.instant(
+                    f"plan:{kind}", t0_us + float(at) * 1e6,
+                    track="nemesis-plan", pid=0,
+                    **{k: v for k, v in (p or {}).items()},
+                )
+
+    def close(self) -> None:
+        self.node.close()

@@ -22,6 +22,7 @@ transitions.
 from __future__ import annotations
 
 import os
+import random
 import sys
 import time
 from collections import defaultdict
@@ -39,10 +40,24 @@ def trace(fmt: str, *args) -> None:
 
 
 class Metrics:
-    def __init__(self) -> None:
+    """Named counters, gauges, and bounded sample reservoirs.
+
+    Sample lists are capped at ``max_samples`` per name (long nemesis and
+    bench runs observe millions of latencies).  Below the cap every value
+    is kept and percentiles are exact; above it the list becomes a uniform
+    reservoir (Vitter's algorithm R): each new value replaces a random
+    slot with probability ``cap/seen``, so percentiles are unbiased
+    estimates over the whole stream rather than a recency window.  The
+    RNG is seeded per-registry, keeping runs reproducible.
+    """
+
+    def __init__(self, max_samples: int = 4096) -> None:
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}
         self.samples: Dict[str, List[float]] = defaultdict(list)
+        self.max_samples = max_samples
+        self.seen: Dict[str, int] = defaultdict(int)
+        self._rng = random.Random(0x0B5)
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -51,7 +66,14 @@ class Metrics:
         self.gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
-        self.samples[name].append(value)
+        self.seen[name] += 1
+        xs = self.samples[name]
+        if len(xs) < self.max_samples:
+            xs.append(value)
+            return
+        j = self._rng.randrange(self.seen[name])
+        if j < self.max_samples:
+            xs[j] = value
 
     def percentile(self, name: str, q: float) -> Optional[float]:
         xs = sorted(self.samples.get(name, []))
@@ -75,6 +97,7 @@ class Metrics:
         self.counters.clear()
         self.gauges.clear()
         self.samples.clear()
+        self.seen.clear()
 
     class _Timer:
         def __init__(self, m: "Metrics", name: str) -> None:

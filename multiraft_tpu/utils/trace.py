@@ -15,8 +15,9 @@ Timestamps are microseconds. The sim uses virtual-time seconds
 
 from __future__ import annotations
 
+import gzip
 import json
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 __all__ = ["Tracer"]
 
@@ -80,12 +81,47 @@ class Tracer:
         )
 
     def counter(
-        self, name: str, ts_us: float, values: Dict[str, float], pid: int = 0
+        self,
+        name: str,
+        ts_us: float,
+        values: Dict[str, float],
+        pid: int = 0,
+        track: str = "counters",
     ) -> None:
-        """A counter sample (renders as a stacked area in the viewer)."""
+        """A counter sample (renders as a stacked area in the viewer).
+
+        ``track`` becomes the event's ``tid`` — without one, Perfetto
+        lumps every counter onto thread 0 of the process.
+        """
         self._emit(
-            {"ph": "C", "name": name, "ts": ts_us, "pid": pid, "args": values}
+            {
+                "ph": "C",
+                "name": name,
+                "ts": ts_us,
+                "pid": pid,
+                "tid": track,
+                "args": values,
+            }
         )
+
+    def process_name(self, pid: int, name: str) -> None:
+        """Metadata event: labels ``pid``'s row in the viewer."""
+        self._emit(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+
+    def drain(self) -> Tuple[List[Dict[str, Any]], int]:
+        """Hand off the buffered events (and drop count) and reset the
+        buffer — the scrape protocol: repeated drains never duplicate."""
+        evs, dropped = self.events, self.dropped
+        self.events, self.dropped = [], 0
+        return evs, dropped
 
     # -- export -----------------------------------------------------------
 
@@ -96,6 +132,21 @@ class Tracer:
         return {"traceEvents": self.events, **meta}
 
     def save(self, path: str) -> str:
-        with open(path, "w") as f:
-            json.dump(self.to_json(), f)
+        """Write catapult JSON; a ``.gz`` suffix selects gzip transport
+        (Perfetto opens either, and fleet traces compress ~20x)."""
+        if path.endswith(".gz"):
+            with gzip.open(path, "wt", encoding="utf-8") as f:
+                json.dump(self.to_json(), f)
+        else:
+            with open(path, "w") as f:
+                json.dump(self.to_json(), f)
         return path
+
+    @staticmethod
+    def load(path: str) -> Dict[str, Any]:
+        """Round-trip loader for :meth:`save` output (either transport)."""
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8") as f:
+                return json.load(f)
+        with open(path, "r") as f:
+            return json.load(f)

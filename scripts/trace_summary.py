@@ -1,0 +1,140 @@
+"""Summarize a Chrome-trace JSON artifact from the observability plane.
+
+    python scripts/trace_summary.py TRACE.json[.gz] [--top N]
+
+Prints, for a trace produced by ``Tracer.save`` / the fleet scraper
+(harness/observe.py) / ``bench.py``:
+
+* per-process, per-track span totals (count + summed duration);
+* the top-N span names by total duration — the "where did the time
+  go" view without opening Perfetto;
+* instant/counter event counts and any recorded drop counts.
+
+Exit code 0 when the trace parses and contains at least one event,
+2 on a malformed/empty trace — tests use this as a smoke check that
+emitted artifacts are actually loadable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multiraft_tpu.utils.trace import Tracer  # noqa: E402
+
+
+def summarize(path: str, top: int = 10) -> Dict[str, Any]:
+    """Load ``path`` (plain or ``.gz`` catapult JSON) and aggregate it.
+
+    Returns a plain dict so tests can assert on it directly::
+
+        {"events": int, "spans": int, "instants": int, "counters": int,
+         "dropped": int,
+         "process_names": {pid: name},
+         "tracks": {"pid/tid": {"spans": n, "dur_us": total}},
+         "top_spans": [(name, total_dur_us, count), ...]}
+    """
+    doc = Tracer.load(path)
+    events = doc.get("traceEvents", [])
+    names: Dict[Any, str] = {}
+    tracks: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"spans": 0, "dur_us": 0.0}
+    )
+    by_name: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"dur_us": 0.0, "count": 0}
+    )
+    spans = instants = counters = 0
+    dropped = int(
+        (doc.get("otherData") or {}).get("dropped_events", 0)
+    )
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "M":
+            if ev.get("name") == "process_name":
+                names[ev.get("pid")] = (ev.get("args") or {}).get("name")
+            continue
+        if ph == "X":
+            spans += 1
+            dur = float(ev.get("dur", 0.0))
+            t = tracks[f"{ev.get('pid')}/{ev.get('tid')}"]
+            t["spans"] += 1
+            t["dur_us"] += dur
+            n = by_name[ev.get("name", "?")]
+            n["dur_us"] += dur
+            n["count"] += 1
+        elif ph == "i":
+            instants += 1
+            if ev.get("name") == "trace_buffer_dropped":
+                dropped += int((ev.get("args") or {}).get("dropped", 0))
+        elif ph == "C":
+            counters += 1
+    top_spans = sorted(
+        ((k, v["dur_us"], int(v["count"])) for k, v in by_name.items()),
+        key=lambda x: -x[1],
+    )[:top]
+    return {
+        "events": len(events),
+        "spans": spans,
+        "instants": instants,
+        "counters": counters,
+        "dropped": dropped,
+        "process_names": names,
+        "tracks": dict(tracks),
+        "top_spans": top_spans,
+    }
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    top = 10
+    if "--top" in argv:
+        i = argv.index("--top")
+        if i + 1 >= len(argv):
+            print("--top requires a value", file=sys.stderr)
+            return 2
+        top = int(argv[i + 1])
+        del argv[i : i + 2]
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    path = argv[0]
+    try:
+        s = summarize(path, top=top)
+    except Exception as exc:  # noqa: BLE001 - CLI boundary
+        print(f"error: could not read trace {path!r}: {exc}", file=sys.stderr)
+        return 2
+    if not s["events"]:
+        print(f"error: trace {path!r} contains no events", file=sys.stderr)
+        return 2
+
+    print(f"trace {path}")
+    print(
+        f"  {s['events']} events: {s['spans']} spans, "
+        f"{s['instants']} instants, {s['counters']} counter samples, "
+        f"{s['dropped']} dropped"
+    )
+    if s["process_names"]:
+        print("  processes:")
+        for pid in sorted(s["process_names"]):
+            print(f"    pid {pid}: {s['process_names'][pid]}")
+    if s["tracks"]:
+        print("  tracks (spans / total ms):")
+        for key in sorted(s["tracks"]):
+            t = s["tracks"][key]
+            print(
+                f"    {key:30s} {int(t['spans']):7d}  "
+                f"{t['dur_us'] / 1e3:10.2f}"
+            )
+    if s["top_spans"]:
+        print(f"  top {len(s['top_spans'])} spans by total duration (ms):")
+        for name, dur, count in s["top_spans"]:
+            print(f"    {name:30s} {dur / 1e3:10.2f}  (x{count})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

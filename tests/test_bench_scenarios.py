@@ -27,13 +27,17 @@ def _run(name, capsys):
     return rec
 
 
-def test_bench_main_json_smoke(monkeypatch):
+def test_bench_main_json_smoke(monkeypatch, tmp_path):
     """bench.py end-to-end at tiny CPU shapes: the driver-facing JSON
     must carry the cross-run statistics, the measured + failover
-    latency fields, the porcupine summary, and the config5 block."""
+    latency fields, the porcupine summary, and the config5 block —
+    and the observability artifacts (chunk-span trace + metrics
+    snapshot) must land in MULTIRAFT_BENCH_TRACE_DIR and be loadable
+    by scripts/trace_summary.py."""
     import subprocess
     import sys
 
+    trace_dir = tmp_path / "bench-trace"
     env = dict(os.environ)
     env.update(
         MULTIRAFT_BENCH_PLATFORM="cpu",
@@ -47,6 +51,7 @@ def test_bench_main_json_smoke(monkeypatch):
         MULTIRAFT_BENCH_CONFIG5_P="5",
         MULTIRAFT_BENCH_CONFIG5_CHUNK="40",
         MULTIRAFT_BENCH_CONFIG5_CHUNKS="2",
+        MULTIRAFT_BENCH_TRACE_DIR=str(trace_dir),
     )
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
@@ -67,6 +72,22 @@ def test_bench_main_json_smoke(monkeypatch):
     assert c5["leader_kills"] > 0
     assert c5["hot_commits_per_sec"] > c5["cold_commits_per_sec"]
     assert c5["latency_unaccounted"] == 0
+
+    # Observability artifacts: one span per timed chunk, a commit-rate
+    # counter track, and the bench metrics snapshot.
+    trace_path = trace_dir / "trace_bench.json.gz"
+    assert trace_path.exists()
+    from scripts.trace_summary import summarize
+
+    s = summarize(str(trace_path))
+    assert s["spans"] == 4  # RUNS * CHUNKS timed chunks
+    assert s["counters"] == 4
+    assert s["process_names"].get(0) == "bench"
+    assert s["top_spans"] and s["top_spans"][0][0] == "chunk"
+    with open(trace_dir / "metrics_bench.json") as f:
+        snap = json.load(f)
+    assert snap["commits"] > 0
+    assert "chunk_rate_p50" in snap
 
 
 def test_churn_scenario_commits_under_churn(capsys):

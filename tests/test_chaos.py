@@ -189,8 +189,13 @@ def test_chaos_smoke_engine_cluster_linearizable():
     """A seeded drop/delay/sever schedule against one engine server
     process under concurrent clerk load: every op completes (faults
     heal, clerks retry) and the client-observed history stays
-    linearizable.  The schedule itself is reproducible from its seed."""
+    linearizable.  The schedule itself is reproducible from its seed —
+    and the observability plane sees the run: Obs.snapshot returns the
+    server's RPC/engine counters, every window verifies as fired, and
+    the merged trace carries one clerk request's id in BOTH the clerk
+    process's span and the server process's dispatch span."""
     from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.harness.observe import FleetObserver
     from multiraft_tpu.porcupine.kv import kv_model
     from multiraft_tpu.porcupine.visualization import assert_linearizable
 
@@ -211,19 +216,63 @@ def test_chaos_smoke_engine_cluster_linearizable():
     )
     try:
         cluster.start()
-        nem = Nemesis([(cluster.host, cluster.port)])
+        addr = (cluster.host, cluster.port)
+        nem = Nemesis([addr])
+        obs = FleetObserver([addr])
+        clerk_events: list = []
         try:
             runner = nem.run_async(schedule)
             history = run_clerk_load(
                 cluster.clerk, keys=["ca", "cb"],
                 n_workers=3, ops_per_worker=9, op_timeout=60.0,
+                trace_sink=clerk_events,
             )
             runner.join(timeout=60.0)
             assert not runner.is_alive()
+            assert nem.error is None
             # Ran to the final heal, and the server is reachable clean.
             assert nem.applied[-1][1] == "heal"
-            assert nem.ctl.ping((cluster.host, cluster.port))
+            assert nem.ctl.ping(addr)
+            # Every scheduled window demonstrably fired.
+            assert len(nem.windows) == len(schedule) - 1  # all but heal
+            nem.verify_windows()
+
+            # Scrapeable per-process counters, live over the socket.
+            snap = obs.snapshot(addr)
+            assert snap is not None
+            m = snap["metrics"]
+            assert m["rpc.handled"] > 0 and m["rpc.frames_in"] > 0
+            assert m["kv.writes"] >= 18  # the appends (plus retries)
+            assert "rpc.handle_s_p50" in m
+            # The hit ledger rides along (may be empty if the short
+            # load drained before a storm window saw traffic).
+            assert "hits" in snap["chaos"]
+
+            # One merged, clock-aligned timeline: the same request id
+            # in the clerk's span (pid 0) and the server's (pid 1).
+            merged = obs.merged_timeline(
+                local_events=clerk_events, windows=nem.windows,
+            )
+            assert obs.unreachable == []
+            rids = {
+                e["args"]["req"]
+                for e in merged.events
+                if e["ph"] == "X" and e["pid"] == 0
+                and e["tid"] == "clerk"
+            }
+            assert rids
+            server_rids = {
+                e["args"].get("req")
+                for e in merged.events
+                if e["ph"] == "X" and e["pid"] == 1
+            }
+            assert rids & server_rids, (rids, server_rids)
+            # Window annotations ride the nemesis track.
+            assert sum(
+                1 for e in merged.events if e.get("tid") == "nemesis"
+            ) == len(nem.windows)
         finally:
+            obs.close()
             nem.close()
         assert len(history) == 27
         assert_linearizable(
@@ -246,8 +295,20 @@ def test_nemesis_fleet_partition_delay_crash_restart(tmp_path):
     partitions, delay/drop storms, severs, and one crash+restart-from-
     WAL runs against a two-process durable engine fleet over real
     sockets while clerks apply load; everything completes and the
-    history passes porcupine."""
+    history passes porcupine.
+
+    The observability acceptance rides the same run: Obs.snapshot
+    scraped MID-RUN returns non-empty per-process counters (RPC totals
+    + WAL fsync latency percentiles), every scheduled window verifies
+    as fired, and the run emits ONE merged clock-aligned trace JSON in
+    which a single clerk request's spans appear in both the clerk and
+    a server process under the same request id and every window is
+    annotated — smoke-validated through scripts/trace_summary.py."""
+    import json
+    import threading
+
     from multiraft_tpu.distributed.engine_cluster import EngineFleetCluster
+    from multiraft_tpu.harness.observe import FleetObserver
     from multiraft_tpu.porcupine.kv import kv_model
     from multiraft_tpu.porcupine.visualization import assert_linearizable
 
@@ -272,21 +333,102 @@ def test_nemesis_fleet_partition_delay_crash_restart(tmp_path):
         fleet.admin("join", [2])
         addrs = [(fleet.host, p) for p in fleet.ports]
         nem = Nemesis(addrs, kill=fleet.kill, restart=fleet.start)
+        obs = FleetObserver(addrs)
+        clerk_events: list = []
+        mid_snaps: dict = {}
+
+        def scrape_mid_run(stop: threading.Event) -> None:
+            # Accumulate every successful snapshot per process while
+            # faults are live (a crashed process skips a round, and a
+            # restarted one comes back with reset counters).
+            while not stop.wait(1.5):
+                for key, snap in obs.snapshot_all().items():
+                    mid_snaps.setdefault(key, []).append(snap)
+
         try:
             runner = nem.run_async(schedule)
+            stop_scrape = threading.Event()
+            scraper = threading.Thread(
+                target=scrape_mid_run, args=(stop_scrape,), daemon=True
+            )
+            scraper.start()
             history = run_clerk_load(
                 fleet.clerk, keys=["na", "nb", "nc"],
                 n_workers=3, ops_per_worker=9, op_timeout=240.0,
+                trace_sink=clerk_events,
             )
             runner.join(timeout=400.0)
+            stop_scrape.set()
+            scraper.join(timeout=10.0)
             assert not runner.is_alive()
+            assert nem.error is None
             kinds = [(ph, k) for ph, k, _ in nem.applied]
             assert ("start", "crash") in kinds  # SIGKILL happened
             assert ("stop", "crash") in kinds   # ...and WAL recovery
             assert nem.applied[-1][1] == "heal"
             for a in addrs:
                 assert nem.ctl.ping(a)
+
+            # Every scheduled fault window demonstrably fired.
+            assert len(nem.windows) == len(schedule) - 1  # all but heal
+            nem.verify_windows()
+
+            # Mid-run scrapes saw every process, with RPC totals and
+            # WAL fsync percentiles (the fleet is durable).
+            assert len(mid_snaps) == len(addrs), mid_snaps.keys()
+            for key, snaps in mid_snaps.items():
+                assert any(
+                    s["metrics"]["rpc.handled"] > 0
+                    and s["metrics"]["rpc.frames_in"] > 0
+                    and s["metrics"]["rpc.bytes_in"] > 0
+                    and "wal.fsync_s_p50" in s["metrics"]
+                    and "wal.fsync_s_p99" in s["metrics"]
+                    for s in snaps
+                ), (key, snaps[-1]["metrics"])
+
+            # ONE merged clock-aligned trace, nemesis-annotated.
+            merged = obs.merged_timeline(
+                local_events=clerk_events, windows=nem.windows,
+                schedule=schedule, t0_us=nem.t0_us,
+            )
+            trace_path = str(tmp_path / "trace_nemesis.json.gz")
+            merged.save(trace_path)
+            snap_path = str(tmp_path / "metrics_nemesis.json")
+            with open(snap_path, "w") as f:
+                json.dump(obs.snapshot_all(), f, indent=2, sort_keys=True)
+
+            # (a) one clerk request's spans in clerk AND server
+            # processes under the same request id.
+            clerk_rids = {
+                e["args"]["req"] for e in merged.events
+                if e["ph"] == "X" and e["pid"] == 0 and e["tid"] == "clerk"
+            }
+            server_rids = {
+                e["args"].get("req") for e in merged.events
+                if e["ph"] == "X" and e["pid"] >= 1
+            }
+            assert clerk_rids & server_rids, (clerk_rids, server_rids)
+            # (b) every scheduled fault window annotated on the
+            # nemesis track, plus the planned-schedule instants.
+            annotated = [
+                e for e in merged.events if e.get("tid") == "nemesis"
+            ]
+            assert len(annotated) == len(nem.windows)
+            assert sorted(e["name"] for e in annotated) == sorted(
+                k for _, k, _ in schedule if k != "heal"
+            )
+            assert sum(
+                1 for e in merged.events if e.get("tid") == "nemesis-plan"
+            ) == len(schedule)
+
+            # The artifact is loadable and summarizable.
+            from scripts.trace_summary import summarize
+
+            s = summarize(trace_path)
+            assert s["spans"] > 0 and s["events"] == len(merged.events)
+            assert 0 in s["process_names"]
         finally:
+            obs.close()
             nem.close()
         assert len(history) == 27
         assert_linearizable(

@@ -1,0 +1,370 @@
+"""Observability-plane tests: bounded metrics reservoirs, trace-event
+schema + gzip round trip, drain/drop accounting, the ``Obs.*`` control
+service over live sockets (chaos-exempt, scrapeable mid-fault), clock
+alignment + merged timelines (harness/observe.py), nemesis window
+verification, and the trace_summary CLI."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from multiraft_tpu.distributed.chaos import ChaosRule, ChaosState
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.distributed.observe import is_control, now_us
+from multiraft_tpu.harness.nemesis import Nemesis, NemesisVerificationError
+from multiraft_tpu.utils.metrics import Metrics
+from multiraft_tpu.utils.trace import Tracer
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Metrics: bounded sample reservoirs
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsReservoir:
+    def test_exact_below_cap(self):
+        m = Metrics(max_samples=100)
+        for v in range(50):
+            m.observe("lat", float(v))
+        assert m.samples["lat"] == [float(v) for v in range(50)]
+        assert m.seen["lat"] == 50
+        assert m.percentile("lat", 0.5) == 25.0  # exact, not estimated
+
+    def test_bounded_memory_above_cap(self):
+        m = Metrics(max_samples=64)
+        for v in range(10_000):
+            m.observe("lat", float(v))
+        assert len(m.samples["lat"]) == 64  # the memory bound
+        assert m.seen["lat"] == 10_000
+
+    def test_reservoir_estimates_whole_stream(self):
+        # Uniform stream 0..9999: the reservoir's p50 must estimate the
+        # stream median (~5000), NOT the tail a recency window would
+        # keep.  Seeded RNG makes the draw deterministic.
+        m = Metrics(max_samples=256)
+        for v in range(10_000):
+            m.observe("lat", float(v))
+        p50 = m.percentile("lat", 0.5)
+        assert 3500.0 < p50 < 6500.0
+
+    def test_reset_clears_seen(self):
+        m = Metrics(max_samples=4)
+        for v in range(10):
+            m.observe("x", float(v))
+        m.reset()
+        assert not m.samples and not m.seen
+        m.observe("x", 1.0)
+        assert m.samples["x"] == [1.0]  # exact again after reset
+
+
+# ---------------------------------------------------------------------------
+# Tracer: schema, gzip transport, drain semantics, drop accounting
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_event_schema(self):
+        tr = Tracer()
+        tr.span("work", 10.0, 5.0, track="rpc", pid=2, req="ab.1")
+        tr.instant("commit", 20.0, track="engine", req="ab.1")
+        tr.counter("rate", 30.0, {"ops": 7.0}, track="counters")
+        tr.process_name(2, "server-a")
+        x, i, c, m = tr.events
+        assert x == {
+            "ph": "X", "name": "work", "ts": 10.0, "dur": 5.0,
+            "pid": 2, "tid": "rpc", "args": {"req": "ab.1"},
+        }
+        assert i["ph"] == "i" and i["s"] == "t"
+        assert i["args"] == {"req": "ab.1"}
+        # The counter must carry its track as tid — without one the
+        # viewer lumps every counter onto thread 0.
+        assert c["ph"] == "C" and c["tid"] == "counters"
+        assert m == {
+            "ph": "M", "name": "process_name", "pid": 2, "tid": 0,
+            "args": {"name": "server-a"},
+        }
+
+    def test_save_load_roundtrip_plain_and_gzip(self, tmp_path):
+        tr = Tracer()
+        tr.span("s", 1.0, 2.0, outcome="ok")
+        tr.counter("c", 3.0, {"v": 1.0})
+        for name in ("t.json", "t.json.gz"):
+            path = str(tmp_path / name)
+            assert tr.save(path) == path
+            doc = Tracer.load(path)
+            assert doc["traceEvents"] == tr.events
+        # The .gz artifact really is gzip on disk, not misnamed JSON.
+        with gzip.open(str(tmp_path / "t.json.gz"), "rt") as f:
+            assert json.load(f)["traceEvents"] == tr.events
+
+    def test_drop_accounting_at_max_events(self, tmp_path):
+        tr = Tracer(max_events=3)
+        for k in range(8):
+            tr.instant(f"e{k}", float(k))
+        assert len(tr.events) == 3 and tr.dropped == 5
+        path = tr.save(str(tmp_path / "d.json"))
+        doc = Tracer.load(path)
+        assert doc["otherData"]["dropped_events"] == 5
+
+    def test_drain_hands_off_and_resets(self):
+        tr = Tracer(max_events=2)
+        tr.instant("a", 1.0)
+        tr.instant("b", 2.0)
+        tr.instant("c", 3.0)  # dropped
+        events, dropped = tr.drain()
+        assert [e["name"] for e in events] == ["a", "b"] and dropped == 1
+        # Reset: a second drain yields nothing, and capacity is back.
+        assert tr.drain() == ([], 0)
+        tr.instant("d", 4.0)
+        assert [e["name"] for e in tr.events] == ["d"] and tr.dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# Control-plane exemption predicate + chaos hit ledger
+# ---------------------------------------------------------------------------
+
+
+def test_is_control_covers_chaos_and_obs():
+    assert is_control("Chaos.set_rules")
+    assert is_control("Obs.snapshot")
+    assert not is_control("Echo.ping")
+    assert not is_control("KV.command")
+
+
+def test_chaos_hit_ledger_per_path_and_metrics_mirror():
+    st = ChaosState(seed=1)
+    st.metrics = Metrics()
+    st.all_in = ChaosRule(block=True)
+    st.peer_out[("h", 9)] = ChaosRule(block=True)
+    st.reply = ChaosRule(drop=1.0)
+    for _ in range(3):
+        st.decide_in()
+    st.decide_out(("h", 9))
+    st.decide_out(("other", 1))  # no rule → pass, no hit
+    st.decide_reply()
+    assert st.hits["all_in"]["block"] == 3
+    assert st.hits["peer:h:9"]["block"] == 1
+    assert st.hits["reply"]["drop"] == 1
+    assert "all_out" not in st.hits
+    snap = st.snapshot()
+    assert snap["hits"]["all_in"] == {"block": 3}
+    # Mirrored into the scrapeable registry under chaos.<kind>.<path>.
+    assert st.metrics.counters["chaos.block.all_in"] == 3
+    assert st.metrics.counters["chaos.drop.reply"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Nemesis window verification (no sockets: ledger logic only)
+# ---------------------------------------------------------------------------
+
+
+def _bare_nemesis(windows):
+    nem = Nemesis.__new__(Nemesis)
+    nem.windows = windows
+    return nem
+
+
+def test_verify_windows_passes_on_acked_windows():
+    _bare_nemesis([
+        {"kind": "drop_storm", "p": {"proc": 0}, "procs": [0],
+         "t_start_us": 0.0, "t_stop_us": 1.0, "acked": True,
+         "hits": 4, "baseline": 0, "excused": None},
+        {"kind": "crash", "p": {"proc": 1}, "procs": [1],
+         "t_start_us": 2.0, "t_stop_us": 3.0, "acked": True,
+         "hits": 0, "baseline": 0, "excused": None},
+    ]).verify_windows()
+
+
+def test_verify_windows_raises_on_unacked_window():
+    nem = _bare_nemesis([
+        {"kind": "isolate", "p": {"proc": 0}, "procs": [0],
+         "t_start_us": 0.0, "t_stop_us": 1.0, "acked": False,
+         "hits": 0, "baseline": 0, "excused": None},
+    ])
+    with pytest.raises(NemesisVerificationError, match="never acknowledged"):
+        nem.verify_windows()
+
+
+def test_verify_windows_require_hits_catches_zero_fault_window():
+    nem = _bare_nemesis([
+        {"kind": "drop_storm", "p": {"proc": 0}, "procs": [0],
+         "t_start_us": 0.0, "t_stop_us": 1.0, "acked": True,
+         "hits": 0, "baseline": 0, "excused": None},
+    ])
+    nem.verify_windows()  # ack-level passes...
+    with pytest.raises(NemesisVerificationError, match="zero faults"):
+        nem.verify_windows(require_hits=("drop_storm",))
+
+
+# ---------------------------------------------------------------------------
+# Obs.* over live sockets + merged timeline (needs the native transport)
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    def ping(self, args):
+        return ("pong", args)
+
+
+@needs_native
+@pytest.mark.timeout_s(60)
+def test_obs_scrape_and_merged_timeline_over_live_fleet(tmp_path):
+    """Two live server processes' worth of RpcNodes: tagged calls leave
+    the same request id in the caller's and the server's spans;
+    Obs.snapshot returns non-empty RPC counters; Obs.trace drains;
+    clock offsets merge both buffers onto one monotone host timeline —
+    and all of it keeps working while the server is under a full
+    inbound block (the control-plane exemption)."""
+    from multiraft_tpu.distributed.chaos import install_chaos
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    servers = [RpcNode(listen=True) for _ in range(2)]
+    for s in servers:
+        s.add_service("Echo", _Echo())
+        install_chaos(s, seed=4)
+    client = RpcNode()
+    obs = None
+    try:
+        addrs = [(s.host, s.port) for s in servers]
+        ends = [client.client_end(*a) for a in addrs]
+        # Tagged traffic: the wire grows the optional 5th element.
+        for k, end in enumerate(ends):
+            got = client.sched.wait(
+                end.call("Echo.ping", k, trace=f"rid.{k}"), 5.0
+            )
+            assert got == ("pong", k)
+        # Untagged traffic keeps the 4-tuple shape and still works.
+        assert client.sched.wait(ends[0].call("Echo.ping", 9), 5.0) == \
+            ("pong", 9)
+
+        obs = FleetObserver(addrs)
+        baseline = obs.snapshot(addrs[0])
+        # Scrape under a full inbound block: Obs.* must be exempt.
+        servers[0].chaos.all_in = ChaosRule(block=True)
+        snap = obs.snapshot(addrs[0])
+        servers[0].chaos.all_in = None
+        assert baseline is not None and snap is not None
+        assert snap["metrics"]["rpc.handled"] >= 2
+        assert snap["metrics"]["rpc.frames_in"] >= 2
+        assert snap["metrics"]["rpc.bytes_in"] > 0
+        assert "chaos" in snap  # hit ledger rides along
+
+        off = obs.clock_offset_us(addrs[0])
+        assert off is not None and abs(off) < 120e6  # same machine
+
+        merged = obs.merged_timeline(
+            local_events=client.obs.tracer.events,
+            windows=[{
+                "kind": "drop_storm", "p": {"proc": 0},
+                "t_start_us": now_us() - 1e6, "t_stop_us": now_us(),
+                "acked": True, "hits": 1,
+            }],
+        )
+        assert obs.unreachable == []
+        evs = merged.events
+        # Host + 2 fleet processes, each labelled.
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in evs if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert set(names) == {0, 1, 2}
+        # The same request id appears in the caller-side span (pid 0)
+        # and the server-side dispatch span (pid 1 or 2) — the
+        # cross-process follow-the-id property.
+        for rid in ("rid.0", "rid.1"):
+            pids = {
+                e["pid"] for e in evs
+                if e["ph"] == "X" and e.get("args", {}).get("req") == rid
+            }
+            assert 0 in pids and pids & {1, 2}, (rid, pids)
+        # The nemesis window rides on pid 0's nemesis track.
+        nem_spans = [
+            e for e in evs if e["ph"] == "X" and e["tid"] == "nemesis"
+        ]
+        assert len(nem_spans) == 1 and nem_spans[0]["pid"] == 0
+        # Clock-aligned: every aligned timestamp lands within a sane
+        # window of the host clock (the run is seconds old at most).
+        now = now_us()
+        for e in evs:
+            if e["ph"] in ("X", "i"):
+                assert now - 300e6 < e["ts"] <= now + 1e6, e
+        # Drain semantics: a second scrape never replays drained
+        # events (the scrape's OWN dispatch spans are all it can see).
+        again = obs.drain_trace(addrs[0])
+        assert again is not None
+        assert all(
+            e["name"].startswith("Obs.") for e in again["events"]
+        ), again["events"]
+
+        # The merged artifact round-trips through gzip + summarizer.
+        path = str(tmp_path / "merged.json.gz")
+        merged.save(path)
+        from scripts.trace_summary import summarize
+
+        s = summarize(path)
+        assert s["events"] == len(evs)
+        assert s["process_names"][1].startswith("pid")
+    finally:
+        if obs is not None:
+            obs.close()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# trace_summary CLI
+# ---------------------------------------------------------------------------
+
+
+def test_trace_summary_cli_smoke(tmp_path):
+    tr = Tracer()
+    tr.process_name(0, "demo")
+    tr.span("alpha", 0.0, 5000.0, track="rpc")
+    tr.span("alpha", 6000.0, 1000.0, track="rpc")
+    tr.span("beta", 0.0, 2000.0, track="clerk")
+    tr.counter("rate", 100.0, {"v": 1.0})
+    path = str(tmp_path / "t.json.gz")
+    tr.save(path)
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_summary.py"),
+         path, "--top", "2"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "alpha" in out.stdout and "demo" in out.stdout
+
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_summary.py"),
+         str(tmp_path / "missing.json")],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert bad.returncode == 2
+
+
+def test_trace_summary_summarize_structure(tmp_path):
+    from scripts.trace_summary import summarize
+
+    tr = Tracer()
+    tr.span("alpha", 0.0, 5000.0, track="rpc", pid=1)
+    tr.span("beta", 0.0, 9000.0, track="rpc", pid=1)
+    tr.instant("commit", 1.0, track="engine")
+    path = tr.save(str(tmp_path / "t.json"))
+    s = summarize(path, top=1)
+    assert s["spans"] == 2 and s["instants"] == 1
+    assert s["top_spans"] == [("beta", 9000.0, 1)]
+    assert s["tracks"]["1/rpc"]["spans"] == 2
