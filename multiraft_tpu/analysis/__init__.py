@@ -1,0 +1,30 @@
+"""graftlint: project-native static analysis for the multiraft-tpu
+codebase.
+
+``python -m multiraft_tpu.analysis multiraft_tpu/`` lints the package
+with every registered rule; ``scripts/check.py`` wraps it together
+with ruff/mypy into the one-shot gate, and ``tests/test_analysis.py``
+enforces zero unsuppressed findings in tier-1.
+
+See :mod:`.core` for the framework, :mod:`.rules` for the per-bug-class
+rules, :mod:`.lockgraph` for the static lock audit and
+:mod:`.lockorder` for the dynamic recorder used by the chaos tests.
+"""
+
+from .core import ALL_RULES, Finding, ModuleInfo, Project, Rule, run
+from . import rules as _rules  # noqa: F401  (registration side effect)
+from . import lockgraph as _lockgraph  # noqa: F401
+from .lockgraph import LockGraph
+from .lockorder import LockOrderRecorder, RecordingLock
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "ModuleInfo",
+    "Project",
+    "Rule",
+    "run",
+    "LockGraph",
+    "LockOrderRecorder",
+    "RecordingLock",
+]

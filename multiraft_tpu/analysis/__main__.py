@@ -1,0 +1,71 @@
+"""CLI: ``python -m multiraft_tpu.analysis [paths...]``.
+
+Exit status 1 on any unsuppressed finding, 0 otherwise.  Suppressed
+findings (``# graftlint: disable=<rule>``) are listed with ``-v`` so
+the suppression inventory stays reviewable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import ALL_RULES, run
+from . import rules as _rules  # noqa: F401
+from . import lockgraph as _lockgraph  # noqa: F401
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint")
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["multiraft_tpu"],
+        help="files or directories to lint (default: multiraft_tpu)",
+    )
+    ap.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="also list suppressed findings",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        help="run only the named rule(s)",
+    )
+    ns = ap.parse_args(argv)
+    rules = ALL_RULES
+    if ns.rule:
+        rules = [r for r in ALL_RULES if r.name in ns.rule]
+        if not rules:
+            known = ", ".join(sorted(r.name for r in ALL_RULES))
+            print(f"graftlint: no such rule(s); known: {known}",
+                  file=sys.stderr)
+            return 2
+    active, suppressed = run([Path(p) for p in ns.paths], rules)
+    for f in active:
+        print(f)
+    if ns.verbose and suppressed:
+        print(f"-- {len(suppressed)} suppressed --")
+        for f in suppressed:
+            print(f"  {f}")
+    if active:
+        print(
+            f"graftlint: {len(active)} finding(s) "
+            f"({len(suppressed)} suppressed)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"graftlint: clean ({len(ALL_RULES) if rules is ALL_RULES else len(rules)}"
+        f" rules, {len(suppressed)} suppressed finding(s))",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

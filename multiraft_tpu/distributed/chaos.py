@@ -122,8 +122,9 @@ class ChaosState:
         self.all_out: Optional[ChaosRule] = None
         self.all_in: Optional[ChaosRule] = None
         self.reply: Optional[ChaosRule] = None
-        # Counters for test assertions / postmortems (best-effort, no
-        # lock beyond the RNG's — increments race benignly).
+        # Counters for test assertions / postmortems; every increment
+        # happens under self._lock (outbound decisions run on arbitrary
+        # caller threads, so unlocked increments would race).
         self.dropped = 0
         self.delayed = 0
         # Per-path hit ledger: path → {"block"/"drop"/"delay": count} of
@@ -136,7 +137,7 @@ class ChaosState:
         )
         # Optional mirror into the node's scrapeable registry (wired by
         # install_chaos when the node carries an obs plane).
-        self.metrics = None
+        self.metrics: Optional[Any] = None
 
     # -- decisions ---------------------------------------------------------
 
@@ -145,12 +146,17 @@ class ChaosState:
         if self.metrics is not None:
             self.metrics.inc(f"chaos.{kind}.{path}")
 
-    def _decide(self, rule: Optional[ChaosRule], path: str = "?"):
+    def _decide(self, rule: Optional[ChaosRule], path: str = "?") -> Any:
         if rule is None:
             return PASS
         if rule.block:
-            self.dropped += 1
-            self._hit(path, "block")
+            # Under the lock like the drop/delay branches: outbound
+            # calls hit this from arbitrary caller threads, and an
+            # unlocked `dropped += 1` / hits-ledger store races them
+            # (graftlint: unlocked-write).
+            with self._lock:
+                self.dropped += 1
+                self._hit(path, "block")
             return DROP
         with self._lock:
             if rule.drop > 0.0 and self._rng.random() < rule.drop:
@@ -164,16 +170,16 @@ class ChaosState:
                 return t
         return PASS
 
-    def decide_out(self, addr: Tuple[str, int]):
+    def decide_out(self, addr: Tuple[str, int]) -> Any:
         rule = self.peer_out.get(addr)
         if rule is not None:
             return self._decide(rule, f"peer:{addr[0]}:{addr[1]}")
         return self._decide(self.all_out, "all_out")
 
-    def decide_in(self):
+    def decide_in(self) -> Any:
         return self._decide(self.all_in, "all_in")
 
-    def decide_reply(self):
+    def decide_reply(self) -> Any:
         return self._decide(self.reply, "reply")
 
     # -- reconfiguration (full-state, idempotent) --------------------------
@@ -226,22 +232,22 @@ class ChaosControl:
     swaps are ordered against frame decisions without extra locking.
     All payloads are plain dicts/tuples — codec-safe unregistered."""
 
-    def __init__(self, node, state: ChaosState) -> None:
+    def __init__(self, node: Any, state: ChaosState) -> None:
         self._node = node
         self._state = state
 
-    def ping(self, _args=None) -> str:
+    def ping(self, _args: Any = None) -> str:
         return "pong"
 
-    def set_rules(self, wire) -> dict:
+    def set_rules(self, wire: Any) -> dict:
         self._state.configure(dict(wire or {}))
         return self._state.snapshot()
 
-    def clear(self, _args=None) -> dict:
+    def clear(self, _args: Any = None) -> dict:
         self._state.clear()
         return self._state.snapshot()
 
-    def sever(self, args=None) -> int:
+    def sever(self, args: Any = None) -> int:
         """Close live connections mid-stream (both directions see a
         reset; in-flight calls on them fail).  ``args`` may be
         ``[host, port]`` to sever one outbound edge, else every
@@ -253,11 +259,11 @@ class ChaosControl:
             addr, exclude=getattr(self._node, "_cur_conn", None)
         )
 
-    def stats(self, _args=None) -> dict:
+    def stats(self, _args: Any = None) -> dict:
         return self._state.snapshot()
 
 
-def install_chaos(node, seed: int = 0) -> ChaosState:
+def install_chaos(node: Any, seed: int = 0) -> ChaosState:
     """Attach a seeded :class:`ChaosState` to ``node`` and register the
     ``"Chaos"`` control service on it.  Idempotent per node (the last
     install wins)."""

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import zlib
+from typing import Any
 
 from ..porcupine.kv import OP_APPEND, OP_GET, OP_PUT
 from ..transport import codec
@@ -52,7 +53,7 @@ def route_group(key: str, G: int) -> int:
     return zlib.crc32(key.encode()) % G
 
 
-def make_mesh(n_devices: int):
+def make_mesh(n_devices: int) -> Any:  # jax.sharding.Mesh (jax imported lazily)
     """A 1-D ``groups`` mesh over the first ``n_devices`` local devices
     — the production entry to the shard_map tick (engine/mesh.py): the
     server's state lives sharded across its chips, consensus stays

@@ -252,8 +252,12 @@ class SplitPersistence:
                 host["applied"][g, p] = raft["base"][gi, p]
         import jax.numpy as jnp
 
+        # copy=True: ``host`` columns mix device-copied rows with rows
+        # assigned from the unpickled WAL snapshot; on the CPU backend a
+        # zero-copy asarray would alias that host memory into state the
+        # donated tick writes through (the PR 1 restore segfault).
         drv.state = drv.state._replace(
-            **{f: jnp.asarray(v) for f, v in host.items()}
+            **{f: jnp.array(v, copy=True) for f, v in host.items()}
         )
         # 2. Service state from the snapshot (service adapter).
         if blob:

@@ -77,7 +77,7 @@ class WriteAheadLog:
         self.appended = 0  # records appended by this incarnation
         self.synced = 0    # records known durable
 
-    def _valid_prefix_len(self):
+    def _valid_prefix_len(self) -> Optional[int]:
         """Byte length of the intact record prefix, or None if the file
         is missing or already fully valid."""
         try:

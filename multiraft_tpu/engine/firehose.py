@@ -91,6 +91,14 @@ def pack_request(
     """Pack columns into one request blob.  ``keys``/``values`` are
     per-row byte strings (empty for ops without one)."""
     n = len(ops)
+    if n > MAX_FIREHOSE_ROWS:
+        # The row count travels as u32, but the server rejects frames
+        # above MAX_FIREHOSE_ROWS anyway — fail on the clerk side
+        # before paying the pack + network round trip.
+        raise ValueError(
+            f"firehose frame has {n} rows; the server caps frames at "
+            f"{MAX_FIREHOSE_ROWS}"
+        )
     for r, k in enumerate(keys):
         if len(k) >= 2 ** 16:
             # The wire key-length column is u16; packing a longer key
@@ -99,6 +107,13 @@ def pack_request(
             raise ValueError(
                 f"firehose key at row {r} is {len(k)} bytes; the wire "
                 f"format caps keys below {2 ** 16} bytes"
+            )
+        if len(values[r]) >= 2 ** 32:
+            # Value lengths are u32: a longer value wraps the length
+            # column and desyncs every later row's value offset.
+            raise ValueError(
+                f"firehose value at row {r} is {len(values[r])} bytes; "
+                f"the wire format caps values below {2 ** 32} bytes"
             )
     key_blob = b"".join(keys)
     val_blob = b"".join(values)
@@ -146,8 +161,25 @@ def unpack_request(
 
 
 def pack_reply(err: np.ndarray, values: Sequence[bytes]) -> bytes:
+    n = len(err)
+    if n > MAX_FIREHOSE_ROWS:
+        # Replies mirror request frames row-for-row, so a validated
+        # request can never get here; guard anyway — the u32 row count
+        # would wrap silently.
+        raise ValueError(
+            f"firehose reply has {n} rows; frames cap at "
+            f"{MAX_FIREHOSE_ROWS}"
+        )
+    for r, v in enumerate(values):
+        if len(v) >= 2 ** 32:
+            # u32 value-length column: a longer value wraps the length
+            # and desyncs every later row's value offset.
+            raise ValueError(
+                f"firehose reply value at row {r} is {len(v)} bytes; "
+                f"the wire format caps values below {2 ** 32} bytes"
+            )
     return b"".join([
-        np.uint32(len(err)).tobytes(),
+        np.uint32(n).tobytes(),
         np.asarray(err, np.uint8).tobytes(),
         np.asarray([len(v) for v in values], _U32).tobytes(),
         b"".join(values),

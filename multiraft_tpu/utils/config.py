@@ -28,7 +28,10 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import Tuple
+from typing import TYPE_CHECKING, Any, Tuple
+
+if TYPE_CHECKING:  # circular at runtime: engine.core reads settings()
+    from ..engine.core import EngineConfig
 
 __all__ = ["RaftTiming", "ServiceTiming", "FaultModel", "Settings", "settings"]
 
@@ -116,7 +119,7 @@ class Settings:
             nshards=int(f("NSHARDS", s.nshards)),
         )
 
-    def engine_config(self, tick_s: float = 0.01, **overrides):
+    def engine_config(self, tick_s: float = 0.01, **overrides: Any) -> "EngineConfig":
         """Derive the batched engine's tick-domain timing from these
         wall-clock knobs (SURVEY §2.2's 10 ms/tick mapping), keeping
         the two backends' timing in one place.  ``overrides`` pass

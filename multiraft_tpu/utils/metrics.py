@@ -33,7 +33,7 @@ __all__ = ["Metrics", "global_metrics", "trace", "DEBUG"]
 DEBUG = os.environ.get("MULTIRAFT_DEBUG", "") not in ("", "0")
 
 
-def trace(fmt: str, *args) -> None:
+def trace(fmt: str, *args: object) -> None:
     """Gated debug printf (reference: DPrintf, raft/utility.go:55-72)."""
     if DEBUG:
         print(fmt % args if args else fmt, file=sys.stderr)
@@ -103,11 +103,11 @@ class Metrics:
         def __init__(self, m: "Metrics", name: str) -> None:
             self.m, self.name = m, name
 
-        def __enter__(self):
+        def __enter__(self) -> "Metrics._Timer":
             self.t0 = time.perf_counter()
             return self
 
-        def __exit__(self, *exc):
+        def __exit__(self, *exc: object) -> None:
             self.m.observe(self.name, time.perf_counter() - self.t0)
 
     def timer(self, name: str) -> "_Timer":

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""One-shot static gate: graftlint + ruff + mypy-on-core.
+
+``python scripts/check.py`` from the repo root.  Exit 0 iff every
+available check passes.  ruff and mypy are optional dependencies —
+when absent (the pinned accelerator image does not carry them) they
+are reported as SKIPPED and do not fail the gate; CI installs both so
+the full gate runs there.  graftlint has no dependencies beyond the
+stdlib and always runs.
+
+The mypy step checks only the typed core (the modules listed in
+``MYPY_CORE``, matching the strict overrides in pyproject.toml):
+wire/WAL/chaos/observe/utils are the modules whose type drift has
+historically produced wire bugs, so they are held to
+``disallow_untyped_defs``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+MYPY_CORE = [
+    "multiraft_tpu/distributed/engine_wire.py",
+    "multiraft_tpu/distributed/wal.py",
+    "multiraft_tpu/distributed/chaos.py",
+    "multiraft_tpu/distributed/observe.py",
+    "multiraft_tpu/utils",
+]
+
+
+def _have(module: str) -> bool:
+    return importlib.util.find_spec(module) is not None
+
+
+def _run(label: str, cmd: list[str]) -> bool:
+    print(f"== {label}: {' '.join(cmd)}", flush=True)
+    proc = subprocess.run(cmd, cwd=REPO)
+    ok = proc.returncode == 0
+    print(f"== {label}: {'ok' if ok else f'FAILED (exit {proc.returncode})'}",
+          flush=True)
+    return ok
+
+
+def main() -> int:
+    failed: list[str] = []
+    skipped: list[str] = []
+
+    if not _run(
+        "graftlint",
+        [sys.executable, "-m", "multiraft_tpu.analysis", "multiraft_tpu",
+         "-v"],
+    ):
+        failed.append("graftlint")
+
+    if _have("ruff"):
+        if not _run(
+            "ruff",
+            [sys.executable, "-m", "ruff", "check", "multiraft_tpu",
+             "tests", "scripts"],
+        ):
+            failed.append("ruff")
+    else:
+        skipped.append("ruff (not installed)")
+
+    if _have("mypy"):
+        if not _run(
+            "mypy",
+            [sys.executable, "-m", "mypy", *MYPY_CORE],
+        ):
+            failed.append("mypy")
+    else:
+        skipped.append("mypy (not installed)")
+
+    for s in skipped:
+        print(f"== SKIPPED: {s}")
+    if failed:
+        print(f"check.py: FAILED ({', '.join(failed)})")
+        return 1
+    print("check.py: ok" + (f" ({len(skipped)} tool(s) skipped)" if skipped
+                            else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

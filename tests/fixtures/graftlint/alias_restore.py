@@ -1,0 +1,22 @@
+"""Fixture: the PR 1 checkpoint-restore segfault, as shipped.
+
+``pickle.load`` hands back numpy arrays backed by the pickle buffer;
+``jnp.asarray`` on the CPU backend zero-copies them into EngineState;
+the donated tick then writes through the alias.  graftlint must flag
+the ``jnp.asarray`` call (donated-alias).
+"""
+
+import pickle
+
+import jax.numpy as jnp
+
+from somewhere import EngineState  # noqa: F401  (never executed)
+
+
+def restore(driver, path):
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    driver.state = EngineState(
+        **{k: jnp.asarray(v) for k, v in blob["state"].items()}
+    )
+    driver.seq = blob["seq"]

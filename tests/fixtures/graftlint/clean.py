@@ -1,0 +1,100 @@
+"""Fixture: the safe idiom for every rule — graftlint must stay silent.
+
+Mirrors each positive fixture with the project's documented fix:
+defensive copy before engine state, bounds checks before fixed-width
+packs, matching frame arities with a ``len()`` guard for the optional
+field, an exempted control prefix, a pure jitted tick, consistent
+lock nesting, and counters that always take the lock.
+"""
+
+import pickle
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from somewhere import EngineState, codec  # noqa: F401  (never executed)
+
+CONTROL_PREFIXES = ("Chaos.", "Admin.")
+
+MAX_ROWS = 65536
+
+_U16 = np.dtype("<u2")
+
+
+def restore(driver, path):
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    driver.state = EngineState(
+        **{k: jnp.array(v, copy=True) for k, v in blob["state"].items()}
+    )
+
+
+def pack_request(keys):
+    n = len(keys)
+    if n > MAX_ROWS:
+        raise ValueError("too many rows")
+    for k in keys:
+        if len(k) >= 2 ** 16:
+            raise ValueError("key too long for u16 length column")
+    key_lens = np.asarray([len(k) for k in keys], _U16)
+    return np.uint32(n).tobytes() + key_lens.tobytes() + b"".join(keys)
+
+
+def send_req(tr, cid, req_id, svc_meth, args, trace_id=None):
+    if trace_id is None:
+        frame = ("req", req_id, svc_meth, args)
+    else:
+        frame = ("req", req_id, svc_meth, args, trace_id)
+    tr.send(cid, codec.encode(frame))
+
+
+def handle(msg, dispatch):
+    if msg[0] == "req":
+        trace_id = msg[4] if len(msg) > 4 else None
+        dispatch(msg[1], msg[2], msg[3], trace_id)
+
+
+class AdminControl:
+    def ping(self, _args=None):
+        return "pong"
+
+
+def install_admin(node):
+    node.add_service("Admin", AdminControl())
+
+
+def tick(cfg, state, inbox):
+    return state, inbox
+
+
+tick_fn = jax.jit(tick, static_argnums=0, donate_argnums=(1,))
+
+
+class Transport:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bufs = []
+
+    def push(self, buf):
+        with self._lock:
+            self._bufs.append(buf)
+
+
+class Node:
+    """Locks nest strictly Node → Transport, counters always locked."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tr = Transport()
+        self.sent = 0
+
+    def send(self, buf):
+        with self._lock:
+            self._tr.push(buf)
+            self.sent += 1
+
+    def stats(self):
+        with self._lock:
+            return self.sent

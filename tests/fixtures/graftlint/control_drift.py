@@ -1,0 +1,22 @@
+"""Fixture: control-plane exemption drift.
+
+An "Admin" control service is registered, but only "Chaos." is in the
+chaos exemption set — chaos can drop the very RPCs that would heal
+the fleet.  graftlint must flag the registration (control-exempt).
+"""
+
+CONTROL_PREFIXES = ("Chaos.",)
+
+
+class AdminControl:
+    def __init__(self, node):
+        self._node = node
+
+    def drain(self, _args=None):
+        return self._node.drain()
+
+
+def install_admin(node):
+    ctl = AdminControl(node)
+    node.add_service("Admin", ctl)  # "Admin." missing from CONTROL_PREFIXES
+    return ctl

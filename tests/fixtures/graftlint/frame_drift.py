@@ -1,0 +1,25 @@
+"""Fixture: wire-frame arity drift between encoder and decoder.
+
+The encoder ships 4-tuples for "req" but the decoder grew a fifth
+field without a ``len()`` guard, and unpacks "rep" into 4 names while
+the encoder only ever produces 3.  graftlint must flag both
+(frame-arity).
+"""
+
+from somewhere import codec  # noqa: F401  (never executed)
+
+
+def send_req(tr, cid, req_id, svc_meth, args):
+    tr.send(cid, codec.encode(("req", req_id, svc_meth, args)))
+
+
+def send_rep(tr, cid, req_id, value):
+    tr.send(cid, codec.encode(("rep", req_id, value)))
+
+
+def handle(msg, dispatch, resolve):
+    if msg[0] == "req":
+        dispatch(msg[1], msg[2], msg[3], msg[4])  # 5th field, no guard
+    elif msg[0] == "rep":
+        _, req_id, value, trace = msg  # decoder expects 4, encoder packs 3
+        resolve(req_id, value, trace)

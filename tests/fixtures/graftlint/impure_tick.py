@@ -1,0 +1,32 @@
+"""Fixture: host-side effects inside a jitted tick.
+
+``time.time()`` / ``random.random()`` / ``print`` execute once at
+trace time and constant-fold into the compiled graph; the global
+write mutates host state from inside the trace.  graftlint must flag
+all four (jit-purity).
+"""
+
+import functools
+import random
+import time
+
+import jax
+
+_TICKS = 0
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def tick(cfg, state, inbox):
+    global _TICKS
+    _TICKS += 1
+    started = time.time()
+    jitter = random.random()
+    print("tick", started, jitter)
+    return state, inbox
+
+
+def paced(cfg, state, inbox):
+    return state, inbox
+
+
+paced_fn = jax.jit(paced, donate_argnums=(1,))

@@ -1,0 +1,39 @@
+"""Fixture: ABBA lock-order cycle between two classes.
+
+``Node.send`` holds the node lock and calls into the transport, which
+takes its own lock; ``Transport.deliver`` holds the transport lock
+and calls back into the node, which takes the node lock.  Two threads
+running one each deadlock.  graftlint must report the cycle
+(lock-order).
+"""
+
+import threading
+
+
+class Transport:
+    def __init__(self, node):
+        self._lock = threading.Lock()
+        self._node = node
+
+    def push(self, buf):
+        with self._lock:
+            self._bufs.append(buf)
+
+    def deliver(self):
+        with self._lock:
+            buf = self._bufs.pop()
+            self._node.on_frame(buf)
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tr = Transport(self)
+
+    def send(self, buf):
+        with self._lock:
+            self._tr.push(buf)
+
+    def on_frame(self, buf):
+        with self._lock:
+            self._last = buf

@@ -1,0 +1,25 @@
+"""Fixture: the chaos-counter race, as shipped.
+
+``dropped`` is incremented under the lock on the drop branch but
+without it on the block branch; outbound decisions run on arbitrary
+caller threads, so the unlocked increment races the locked one.
+graftlint must flag the block-branch store (unlocked-write).
+"""
+
+import threading
+
+
+class ChaosState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def decide(self, rule, coin):
+        if rule.block:
+            self.dropped += 1  # no lock: races the locked increment
+            return "drop"
+        with self._lock:
+            if rule.drop > 0.0 and coin < rule.drop:
+                self.dropped += 1
+                return "drop"
+        return "pass"

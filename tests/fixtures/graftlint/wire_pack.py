@@ -1,0 +1,22 @@
+"""Fixture: the PR 1 u16 key-length wire bug, as shipped.
+
+Key lengths go into a u16 column with no bounds check; a >=64KiB key
+wraps the length and desyncs every later row's offset.  graftlint
+must flag both fixed-width casts (wire-width).
+"""
+
+import struct
+
+import numpy as np
+
+_U16 = np.dtype("<u2")
+
+
+def pack_request(keys, values):
+    key_lens = np.asarray([len(k) for k in keys], _U16)  # u16, unchecked
+    count = np.uint32(len(keys))  # u32, unchecked
+    return count.tobytes() + key_lens.tobytes() + b"".join(keys)
+
+
+def pack_header(n_rows):
+    return struct.pack("<HI", n_rows, 0)  # u16 row count, unchecked

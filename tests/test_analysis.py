@@ -1,0 +1,198 @@
+"""Tier-1 gate for graftlint: the package must lint clean, every rule
+must reproduce its motivating historical bug on its fixture, the
+suppression pragma must work, and the static lock audit must see the
+real transport stack's nesting without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+import pytest
+
+from multiraft_tpu.analysis import (
+    ALL_RULES,
+    LockGraph,
+    LockOrderRecorder,
+    Project,
+    run,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "multiraft_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "graftlint"
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_package_lints_clean():
+    """Zero unsuppressed findings over the whole package (the tier-1
+    acceptance criterion; scripts/check.py enforces the same)."""
+    active, _suppressed = run([PACKAGE])
+    assert active == [], "\n".join(str(f) for f in active)
+
+
+def test_rule_registry_complete():
+    names = {r.name for r in ALL_RULES}
+    assert names >= {
+        "donated-alias",
+        "wire-width",
+        "frame-arity",
+        "control-exempt",
+        "jit-purity",
+        "lock-order",
+        "unlocked-write",
+    }
+
+
+# -- per-rule fixtures: each reproduces the historical bug it encodes ------
+
+_FIXTURE_CASES = [
+    # (fixture, rule, minimum number of findings)
+    ("alias_restore.py", "donated-alias", 1),  # PR 1 restore segfault
+    ("wire_pack.py", "wire-width", 3),  # PR 1 u16 key-length wrap
+    ("frame_drift.py", "frame-arity", 2),  # trace-id wire drift class
+    ("control_drift.py", "control-exempt", 1),  # PR 2 exemption drift
+    ("impure_tick.py", "jit-purity", 4),  # trace-time effects
+    ("lock_cycle.py", "lock-order", 1),  # ABBA across node/transport
+    ("unlocked_counter.py", "unlocked-write", 1),  # chaos counter race
+]
+
+
+@pytest.mark.parametrize("fixture,rule,at_least", _FIXTURE_CASES)
+def test_rule_fires_on_fixture(fixture, rule, at_least):
+    active, _ = run([FIXTURES / fixture])
+    hits = [f for f in active if f.rule == rule]
+    assert len(hits) >= at_least, (
+        f"{rule} found {len(hits)} finding(s) on {fixture}, "
+        f"expected >= {at_least}: {[str(f) for f in active]}"
+    )
+    # and no *other* rule misfires on the fixture
+    others = [f for f in active if f.rule != rule]
+    assert others == [], [str(f) for f in others]
+
+
+def test_clean_fixture_has_no_findings():
+    active, _ = run([FIXTURES / "clean.py"])
+    assert active == [], [str(f) for f in active]
+
+
+# -- suppression pragma -----------------------------------------------------
+
+
+def test_line_pragma_suppresses(tmp_path):
+    src = (FIXTURES / "unlocked_counter.py").read_text()
+    patched = src.replace(
+        "self.dropped += 1  # no lock: races the locked increment",
+        "self.dropped += 1  # graftlint: disable=unlocked-write",
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(patched)
+    active, suppressed = run([p])
+    assert active == [], [str(f) for f in active]
+    assert [f.rule for f in suppressed] == ["unlocked-write"]
+
+
+def test_file_pragma_suppresses(tmp_path):
+    src = (FIXTURES / "impure_tick.py").read_text()
+    p = tmp_path / "suppressed.py"
+    p.write_text("# graftlint: disable-file=jit-purity\n" + src)
+    active, suppressed = run([p])
+    assert active == [], [str(f) for f in active]
+    assert len(suppressed) == 4
+
+
+def test_unsuppressed_rules_still_fire(tmp_path):
+    """A pragma for rule A must not hide rule B on the same line."""
+    src = (FIXTURES / "unlocked_counter.py").read_text()
+    patched = src.replace(
+        "self.dropped += 1  # no lock: races the locked increment",
+        "self.dropped += 1  # graftlint: disable=wire-width",
+    )
+    p = tmp_path / "other_rule.py"
+    p.write_text(patched)
+    active, _ = run([p])
+    assert [f.rule for f in active] == ["unlocked-write"]
+
+
+# -- static lock audit over the real tree -----------------------------------
+
+
+def test_lock_graph_extracts_transport_nesting():
+    g = LockGraph(Project.load([PACKAGE]))
+    edge_names = {
+        (f"{a[0]}.{a[1]}", f"{b[0]}.{b[1]}") for (a, b) in g.edges
+    }
+    # the one blessed nesting: RpcNode holds its conn-cache lock while
+    # opening a transport connection
+    assert ("RpcNode._lock", "NativeTransport._lock") in edge_names
+    assert g.cycles() == [], g.cycles()
+
+
+def test_lock_graph_sees_threaded_classes():
+    g = LockGraph(Project.load([PACKAGE]))
+    locked = {c.name for c in g.classes.values() if c.lock_attrs}
+    assert {"RpcNode", "NativeTransport", "ChaosState",
+            "RealtimeScheduler"} <= locked
+
+
+# -- dynamic lock-order recorder -------------------------------------------
+
+
+class _Box:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+
+def test_recorder_clean_on_consistent_order():
+    box = _Box()
+    rec = LockOrderRecorder()
+    rec.wrap(box, "a", "A")
+    rec.wrap(box, "b", "B")
+    for _ in range(3):
+        with box.a:
+            with box.b:
+                pass
+    assert ("A", "B") in rec.edges
+    rec.assert_acyclic()
+
+
+def test_recorder_detects_abba():
+    box = _Box()
+    rec = LockOrderRecorder()
+    rec.wrap(box, "a", "A")
+    rec.wrap(box, "b", "B")
+    with box.a:
+        with box.b:
+            pass
+    with box.b:
+        with box.a:
+            pass
+    with pytest.raises(AssertionError, match="cycle"):
+        rec.assert_acyclic()
+
+
+def test_recorder_handles_non_lifo_release():
+    box = _Box()
+    rec = LockOrderRecorder()
+    rec.wrap(box, "a", "A")
+    rec.wrap(box, "b", "B")
+    box.a.acquire()
+    box.b.acquire()
+    box.a.release()  # out of LIFO order
+    box.b.release()
+    assert rec.edges == {("A", "B"): threading.current_thread().name}
+    rec.assert_acyclic()
+
+
+def test_recorder_wrap_is_idempotent():
+    box = _Box()
+    rec = LockOrderRecorder()
+    rec.wrap(box, "a", "A")
+    rec.wrap(box, "a", "A")
+    with box.a:
+        pass
+    assert box.a.locked() is False

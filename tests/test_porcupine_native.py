@@ -242,3 +242,46 @@ def test_verbose_native_large_failing_history_fast():
     assert verdict is CheckResult.ILLEGAL
     assert info.partials and info.partials[0], "no evidence captured"
     assert dt < 15.0, f"verbose failing-history pass took {dt:.1f}s"
+
+
+def test_false_native_illegal_is_overruled_by_exact_checker():
+    """The native DFS's Zobrist memo is probabilistic: a hash collision
+    could prune a legal branch and report a false ILLEGAL.  _worker
+    therefore re-confirms small ILLEGAL partitions with the exact
+    Python checker.  Simulate the collision with a lying native_check
+    on a trivially-legal history: the exact checker must overrule it."""
+    import dataclasses
+
+    from multiraft_tpu.porcupine.checker import _worker
+
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="k", value="v"), 0.0,
+                  KvOutput(), 1.0),
+        Operation(1, KvInput(op=OP_GET, key="k"), 2.0,
+                  KvOutput(value="v"), 3.0),
+    ]
+    lying = dataclasses.replace(
+        kv_model,
+        native_check=lambda part, deadline: CheckResult.ILLEGAL,
+        native_check_verbose=None,
+        native_generic=False,
+    )
+    idx, res, _partials = _worker((0, lying, h, 30.0, False))
+    assert res is CheckResult.OK, (
+        "exact checker must overrule a (simulated) collision-induced "
+        f"native ILLEGAL, got {res}"
+    )
+
+
+def test_true_native_illegal_survives_confirmation():
+    """The confirmation pass must not soften real ILLEGAL verdicts."""
+    from multiraft_tpu.porcupine.checker import _worker
+
+    h = [
+        Operation(0, KvInput(op=OP_PUT, key="k", value="v"), 0.0,
+                  KvOutput(), 1.0),
+        Operation(1, KvInput(op=OP_GET, key="k"), 2.0,
+                  KvOutput(value="WRONG"), 3.0),
+    ]
+    idx, res, _partials = _worker((0, kv_model, h, 30.0, False))
+    assert res is CheckResult.ILLEGAL
