@@ -94,6 +94,22 @@ def bench(
             assert sched.wait(f, 600.0) is not TIMEOUT
         elapsed = time.perf_counter() - t0
         total = n_clerks * ops_per_clerk
+        # N clerks share ONE connection here, so the server's
+        # per-iteration flush is where their replies coalesce — the
+        # mean below is the bench's coalescing factor.
+        wire = {}
+        snap = sched.wait(end.call("Obs.snapshot", None), 30.0)
+        if isinstance(snap, dict):
+            met = snap.get("metrics", {})
+            flushes = met.get("rpc.flushes", 0)
+            replies = met.get("rpc.flush_replies", 0)
+            wire = {
+                "rpc_flushes": flushes,
+                "frames_per_flush_mean": (
+                    round(replies / flushes, 2) if flushes else None
+                ),
+                "rpc_oob_buffers": met.get("rpc.oob_buffers", 0),
+            }
         return {
             "clerks": n_clerks,
             "ops": total,
@@ -102,6 +118,7 @@ def bench(
             "mean_latency_ms": round(
                 1e3 * sum(lat_acc) / max(1, len(lat_acc)), 2
             ),
+            "wire": wire,
         }
     finally:
         if node is not None:
@@ -115,16 +132,23 @@ def _pack_clerk_frames(G, clerk_id, n_frames, frame, keyspace=61):
     path measures the per-op client loop separately)."""
     import numpy as np
 
+    from multiraft_tpu.distributed.engine_wire import route_group
     from multiraft_tpu.engine.firehose import pack_request
     from multiraft_tpu.porcupine.kv import OP_APPEND, OP_PUT
 
     out = []
     cmd = 0
+    # Group column must agree with the service's key-hash routing —
+    # the server rejects frames that disagree (route_check).
+    key_groups = np.array(
+        [route_group(f"c{clerk_id}-k{i}", G) for i in range(keyspace)],
+        np.uint32,
+    )
     for fi in range(n_frames):
         n = frame
         ops = np.full(n, OP_APPEND, np.uint8)
         ops[::3] = OP_PUT
-        groups = (np.arange(n, dtype=np.uint32) * 7 + clerk_id) % G
+        groups = key_groups[np.arange(n) % keyspace]
         clients = groups.astype(np.uint64) * 64 + clerk_id
         commands = np.arange(cmd + 1, cmd + n + 1, dtype=np.uint64)
         cmd += n
@@ -306,6 +330,11 @@ def bench_firehose_sockets(
                     )
                     if reply is None or reply is TIMEOUT:
                         continue
+                    if not isinstance(reply, (bytes, bytearray, memoryview)):
+                        # ("err", reason) — count nothing, keep going
+                        # (a crashed driver coroutine would wedge the
+                        # whole measurement window).
+                        continue
                     err, _ = unpack_reply(reply)
                     ok += int((err == FH_OK).sum())
                 return ok
@@ -368,7 +397,32 @@ def bench_firehose_sockets(
                 "served firehose history not linearizable"
             )
             porc = verdict.value
+        # Scrape the server's wire fast-path counters: how often the
+        # per-iteration flush ran, how many replies each flush
+        # coalesced, and how many payload segments shipped out-of-band.
+        wire = {}
+        snap = node0.sched.wait(
+            node0.client_end(cluster.host, cluster.port).call(
+                "Obs.snapshot", None
+            ),
+            30.0,
+        )
+        if isinstance(snap, dict):
+            met = snap.get("metrics", {})
+            flushes = met.get("rpc.flushes", 0)
+            replies = met.get("rpc.flush_replies", 0)
+            wire = {
+                "rpc_flushes": flushes,
+                "rpc_flush_replies": replies,
+                "frames_per_flush_mean": (
+                    round(replies / flushes, 2) if flushes else None
+                ),
+                "frames_per_flush_p99": met.get("rpc.frames_per_flush_p99"),
+                "rpc_oob_buffers": met.get("rpc.oob_buffers", 0),
+                "wal_write_batches": met.get("wal.write_batches", 0),
+            }
         return {
+            "wire": wire,
             "mode": "firehose-sockets",
             "clients": n_clients,
             "G": G,
@@ -412,6 +466,11 @@ def main(argv) -> None:
             "inprocess_min": reps[0],
             "inprocess_max": reps[2],
             "firehose_sockets_ops_per_sec": socks["ops_per_sec"],
+            # The serving gap the wire fast path is chasing: fraction
+            # of the in-process ceiling the socketed path sustains.
+            "sockets_over_inprocess": round(
+                socks["ops_per_sec"] / reps[1], 3
+            ) if reps[1] else None,
             "porcupine": socks["porcupine"],
             "sockets": socks,
         }), flush=True)

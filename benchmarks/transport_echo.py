@@ -191,11 +191,13 @@ def main(argv: list[str]) -> None:
         runs.append(("native_2proc", 20_000, bench_native_2proc))
     if which in ("floor", "both"):
         runs.append(("loopback_floor_c", 20_000, bench_floor))
+    mins = {}
     for name, n, fn in runs:
         out = fn(n)
         if out is None:
             continue  # no C toolchain: skip the floor line
         lo, med = out if isinstance(out, tuple) else (out, out)
+        mins[name] = lo
         print(
             json.dumps(
                 {
@@ -208,6 +210,20 @@ def main(argv: list[str]) -> None:
             ),
             flush=True,
         )
+    # The in-process-vs-sockets gap, the number the wire fast path is
+    # chasing: µs each socketed round trip pays over the in-process
+    # (sim) path, and how much of the socketed cost is the kernel's
+    # (loopback floor) vs. the framework's (codec + dispatch).
+    if "sim" in mins and "native" in mins:
+        gap = {
+            "path": "gap",
+            "sockets_minus_inprocess_us": round(mins["native"] - mins["sim"], 2),
+            "sockets_over_inprocess": round(mins["native"] / mins["sim"], 2),
+        }
+        floor = mins.get("loopback_floor_c")
+        if floor:
+            gap["framework_us_over_floor"] = round(mins["native"] - floor, 2)
+        print(json.dumps(gap), flush=True)
 
 
 if __name__ == "__main__":

@@ -154,6 +154,14 @@ class EngineKVService:
     def _pump_loop(self) -> None:
         if self._stopped:
             return
+        # About to grind for up to several milliseconds: push any
+        # queued replies onto the wire first, or a client whose op
+        # resolved last tick waits out this whole one before it can
+        # pipeline its next frame.  (No-op off the IoScheduler: sim
+        # tests drive handlers with the virtual-time Scheduler.)
+        flush = getattr(self.sched, "flush_io", None)
+        if flush is not None:
+            flush()
         t0 = time.perf_counter()
         self.kv.pump(self._ticks)
         self.m.inc("pump.count")
@@ -305,7 +313,14 @@ class EngineKVService:
         from ..engine.firehose import FH_RETRY, pack_reply
 
         def run():
-            raw = bytes(blob)
+            # Buffer payloads pass straight through: the OOB codec
+            # delivers blobs as bytes-likes and every consumer below
+            # (np.frombuffer, memoryview slicing) speaks the buffer
+            # protocol, so only exotic types pay a copy.
+            raw = (
+                blob if isinstance(blob, (bytes, bytearray, memoryview))
+                else bytes(blob)
+            )
             if len(raw) < 4:
                 return ("err", "ErrMalformedFrame")
             n = int(np.frombuffer(raw, np.dtype("<u4"), 1, 0)[0])

@@ -52,6 +52,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_char_p, ctypes.c_uint32,
             ]
+            lib.mrt_sendv.restype = ctypes.c_int
+            lib.mrt_sendv.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_uint32,
+            ]
             lib.mrt_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
             lib.mrt_wake.argtypes = [ctypes.c_void_p]
             lib.mrt_set_spin.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -134,6 +141,53 @@ class NativeTransport:
             if self._h is None:
                 return False
             return self._lib.mrt_send(self._h, conn, data, len(data)) == 0
+
+    # writev caps iovec counts at IOV_MAX (1024 on Linux); chunk below it.
+    _SENDV_MAX = 512
+
+    def send_parts(self, conn: int, parts: list) -> bool:
+        """Vectored raw write: ``parts`` are PRE-FRAMED byte runs
+        (length prefixes included by the caller) delivered in order as
+        one ``writev`` per chunk — the one-syscall-per-flush half of
+        the reply-coalescing fast path.  Accepts ``bytes`` and
+        buffer-protocol objects (memoryview/bytearray/numpy views);
+        writable buffers pass their pointer zero-copy."""
+        n = len(parts)
+        if n == 0:
+            return True
+        if n == 1 and isinstance(parts[0], bytes):
+            return self.send(conn, parts[0])
+        for lo in range(0, n, self._SENDV_MAX):
+            chunk = parts[lo: lo + self._SENDV_MAX]
+            k = len(chunk)
+            ptrs = (ctypes.c_void_p * k)()
+            lens = (ctypes.c_uint32 * k)()
+            keep = []  # pins every pointer's backing object until the call
+            for i, p in enumerate(chunk):
+                if isinstance(p, bytes):
+                    ptrs[i] = ctypes.cast(ctypes.c_char_p(p), ctypes.c_void_p)
+                    lens[i] = len(p)
+                    keep.append(p)
+                    continue
+                mv = p if isinstance(p, memoryview) else memoryview(p)
+                if mv.readonly:
+                    b = bytes(mv)  # rare: readonly view not backed by bytes
+                    ptrs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                    lens[i] = len(b)
+                    keep.append(b)
+                else:
+                    arr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+                    ptrs[i] = ctypes.addressof(arr)
+                    lens[i] = mv.nbytes
+                    keep.append(arr)
+            with self._lock:
+                if self._h is None:
+                    return False
+                ok = self._lib.mrt_sendv(self._h, conn, ptrs, lens, k) == 0
+            del keep
+            if not ok:
+                return False
+        return True
 
     def set_spin(self, us: int) -> None:
         """Busy-poll budget (µs) before :meth:`poll` blocks — trades a

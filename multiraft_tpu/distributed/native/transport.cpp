@@ -216,6 +216,60 @@ class Transport {
     return true;
   }
 
+  // Vectored raw write: the caller supplies PRE-FRAMED byte parts
+  // (length prefixes included) and they hit the socket as one writev —
+  // the coalescing half of the zero-copy fast path (tcp.py queues a
+  // scheduler-iteration's replies and flushes them here, so N replies
+  // cost one syscall instead of N).  Parts need no frame alignment on
+  // the slow path: the write queue carries raw byte runs (FlushWrites
+  // is offset-based), so a partial writev's remainder becomes one
+  // queued blob.
+  bool SendV(int64_t id, const uint8_t* const* parts, const uint32_t* lens,
+             uint32_t nparts) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = conns_.find(id);
+    if (it == conns_.end() || it->second.closed) return false;
+    Conn& c = it->second;
+    size_t total = 0;
+    for (uint32_t i = 0; i < nparts; ++i) total += lens[i];
+    size_t done = 0;
+    if (!c.connecting && c.wq.empty()) {
+      std::vector<iovec> iov(nparts);
+      for (uint32_t i = 0; i < nparts; ++i) {
+        iov[i].iov_base = const_cast<uint8_t*>(parts[i]);
+        iov[i].iov_len = lens[i];
+      }
+      ssize_t n = writev(c.fd, iov.data(), static_cast<int>(nparts));
+      if (n == static_cast<ssize_t>(total)) return true;
+      if (n < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK) {
+          // Dead socket: same accepted-and-lost contract as Send.
+          c.closed = true;
+          c.err = true;
+          WakePoller();
+          return true;
+        }
+        n = 0;
+      }
+      done = static_cast<size_t>(n);
+    }
+    std::vector<uint8_t> rest;
+    rest.reserve(total - done);
+    size_t skip = done;
+    for (uint32_t i = 0; i < nparts; ++i) {
+      if (skip >= lens[i]) {
+        skip -= lens[i];
+        continue;
+      }
+      rest.insert(rest.end(), parts[i] + skip, parts[i] + lens[i]);
+      skip = 0;
+    }
+    c.wq.push_back(std::move(rest));
+    if (c.wq.size() == 1) c.woff = 0;
+    if (!c.connecting) WatchWrites(id, c);
+    return true;
+  }
+
   void CloseConn(int64_t id) {
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -555,6 +609,11 @@ int64_t mrt_connect(void* t, const char* host, int port) {
 
 int mrt_send(void* t, int64_t conn, const uint8_t* data, uint32_t len) {
   return static_cast<Transport*>(t)->Send(conn, data, len) ? 0 : -1;
+}
+
+int mrt_sendv(void* t, int64_t conn, const uint8_t* const* parts,
+              const uint32_t* lens, uint32_t nparts) {
+  return static_cast<Transport*>(t)->SendV(conn, parts, lens, nparts) ? 0 : -1;
 }
 
 void mrt_close(void* t, int64_t conn) {

@@ -102,6 +102,17 @@ class RealtimeScheduler:
     def now(self) -> float:
         return time.monotonic() - self._origin
 
+    def on_loop_thread(self) -> bool:
+        """True when called from the loop thread (the only thread that
+        may touch loop-owned state like RpcNode's reply queue)."""
+        return threading.current_thread() is self._thread
+
+    def flush_io(self) -> None:
+        """Force any pending IO flush now.  No-op here; IoScheduler
+        overrides it.  Long-running timer callbacks (an engine pump
+        about to grind for milliseconds) call this first so replies
+        already queued don't wait them out."""
+
     # -- scheduling (sim-compatible) --------------------------------------
 
     def call_at(self, when: float, fn: Callable, *args: Any) -> Timer:
@@ -277,6 +288,18 @@ class IoScheduler(RealtimeScheduler):
     place of the condvar notify.  Wakes are level-triggered in the
     transport (an eventfd counter), so a wake that lands before the
     poll starts is not lost.
+
+    ``io_flush`` (optional) runs on the loop thread at two points,
+    distinguished by its ``force`` argument.  ``io_flush(True)`` runs
+    immediately before every ``io_poll`` — nothing may sit queued while
+    the loop blocks.  ``io_flush(False)`` runs after every timer
+    callback, and the hook may decline it: under saturation the timer
+    heap is never empty (pump ticks requeue faster than they run), so
+    the before-poll flush can starve for many milliseconds — a convoy
+    where every client waits on replies stuck behind engine compute.
+    The soft flush bounds that starvation at one callback, while still
+    letting the hook accumulate replies across back-to-back cheap
+    callbacks into one vectored write per connection.
     """
 
     def __init__(
@@ -285,12 +308,22 @@ class IoScheduler(RealtimeScheduler):
         io_handle: Callable[[Any], None],
         io_wake: Callable[[], None],
         idle_max: float = 0.2,
+        io_flush: Optional[Callable[[bool], None]] = None,
     ) -> None:
         self._io_poll = io_poll
         self._io_handle = io_handle
         self._io_wake = io_wake
+        self._io_flush = io_flush
         self._idle_max = idle_max
         super().__init__()
+
+    def flush_io(self) -> None:
+        """Run the io_flush hook forced, from the loop thread.  The
+        entry point for callbacks that KNOW they are about to block the
+        loop for a while (engine pump ticks): queued replies leave
+        before the grind instead of aging through it."""
+        if self._io_flush is not None and self.on_loop_thread():
+            self._io_flush(True)
 
     def call_at(self, when: float, fn: Callable, *args: Any) -> Timer:
         timer = Timer(when, fn, args)
@@ -343,7 +376,25 @@ class IoScheduler(RealtimeScheduler):
                         import traceback
 
                         traceback.print_exc()
+                    # Soft flush after every timer callback: the hook
+                    # flushes only replies old enough that waiting out
+                    # another (potentially milliseconds-long) pump tick
+                    # would hurt, and keeps batching fresh ones.
+                    if self._io_flush is not None:
+                        try:
+                            self._io_flush(False)
+                        except Exception:  # pragma: no cover
+                            import traceback
+
+                            traceback.print_exc()
                 continue
+            if self._io_flush is not None:
+                try:
+                    self._io_flush(True)
+                except Exception:  # pragma: no cover - keep the loop alive
+                    import traceback
+
+                    traceback.print_exc()
             ev = self._io_poll(delay)
             if ev is not None:
                 self.fired_events += 1

@@ -19,6 +19,8 @@ Frames are codec-encoded tuples:
     ("req", req_id, svc_meth, args)             caller → callee
     ("req", req_id, svc_meth, args, trace_id)   …with a request id
     ("rep", req_id, value)                      callee → caller
+    ("repb", [(req_id, value), ...])            coalesced multi-reply
+    ("hello", caps)                             capability negotiation
 
 The optional fifth element is a compact trace/request id (Dapper-style)
 appended only when the caller supplies one, so untagged traffic and old
@@ -26,6 +28,21 @@ peers keep the 4-tuple wire shape.  The dispatcher stows it in
 ``_cur_trace`` (loop-thread breadcrumb) and tags the handler span with
 it — one clerk request is followable clerk → server → engine commit
 across processes by grepping one id.
+
+Wire fast path (negotiated, old peers unaffected): a connecting node
+sends ``("hello", caps)`` as its first frame and the acceptor answers
+with its own.  Unknown tags fall through ``_handle_msg`` silently, so
+an old peer simply never upgrades.  Once a connection's peer caps are
+known, two upgrades engage: **reply coalescing** — replies are queued
+per connection and flushed once per scheduler-loop iteration (the
+``io_flush`` hook fires after every timer burst, before the loop
+blocks), so the N replies one pump produces leave as one vectored
+write, packed into a single ``repb`` frame when the peer speaks it —
+and **out-of-band encoding** (``codec.encode_oob``), which ships numpy
+columns and large blobs as raw buffer segments instead of copying them
+through the pickle stream.  Requests are NOT queued: they may originate
+off the loop thread and their latency is the caller's; only replies
+(loop-thread-only by construction) coalesce.
 
 Handlers returning generator coroutines (the wait-channel pattern,
 reference: kvraft/server.go:56-96) are spawned; the reply ships when
@@ -45,10 +62,11 @@ from __future__ import annotations
 
 import itertools
 import os
+import struct
 import sys
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..sim.scheduler import Future
 from ..transport import codec
@@ -57,6 +75,40 @@ from .observe import Observability, install_obs, is_control
 from .realtime import IoScheduler
 
 __all__ = ["RpcNode", "TcpClientEnd"]
+
+# Wire capabilities this build understands (hello payload).  "oob" =
+# protocol-5 out-of-band codec segments; "repb" = coalesced multi-reply
+# frames.  Caps only ever UPGRADE encoding — a dropped/severed hello
+# (chaos may eat it) just leaves the connection on the legacy shapes.
+_WIRE_CAPS = ("oob", "repb")
+# Oldest a queued reply may get before a soft flush (the after-timer
+# call) sends it.  Well above a ticket-resolution burst (microseconds,
+# keeps batching) and below an engine pump tick (milliseconds, must not
+# wait out another one).
+_FLUSH_MAX_AGE_S = 500e-6
+# A blob reply at least this large flushes immediately instead of
+# queueing: bulk results gate the (serial) sender's next frame, and the
+# payload dwarfs any per-syscall saving batching could add.
+_BULK_REPLY_BYTES = 2048
+# Frame length prefix (big-endian u32) — must match transport.cpp's
+# framing; send_parts writes raw so Python adds it per frame.
+_U32 = struct.Struct(">I")
+
+
+def _seg_len(seg: Any) -> int:
+    return len(seg) if isinstance(seg, (bytes, bytearray)) else seg.nbytes
+
+
+def _frame_header(nbytes: int) -> bytes:
+    """Length prefix for one raw-written frame; the prefix is u32, so
+    an oversized payload must fail loudly rather than wrap and desync
+    the peer's frame parser."""
+    if nbytes >= 2 ** 32:
+        raise ValueError(
+            f"frame payload of {nbytes} bytes overflows the u32 length "
+            "prefix"
+        )
+    return _U32.pack(nbytes)
 
 
 class TcpClientEnd:
@@ -94,8 +146,21 @@ class RpcNode:
         self._conns: Dict[Tuple[str, int], int] = {}  # addr → conn id
         self._accepted: set = set()  # inbound conn ids (for sever)
         self._closed = False
+        # Wire fast path state.  _peer_caps: conn → negotiated caps
+        # (written on the loop thread, read anywhere — dict ops are
+        # atomic under the GIL).  _outq: conn → [(req_id, value), ...]
+        # replies awaiting the per-iteration flush; LOOP THREAD ONLY.
+        self._peer_caps: Dict[int, frozenset] = {}
+        self._hello_sent: set = set()
+        self._outq: Dict[int, List[Tuple[int, Any]]] = {}
+        self._outq_since: float = 0.0  # when _outq went non-empty
         # Fault injection (chaos.py ChaosState); None = clean network.
         self.chaos = None
+        # MRT_WIRE_LEGACY=1: operational kill-switch for the wire fast
+        # path — no hello (so peers never negotiate oob/repb) and
+        # replies ship immediately per frame instead of through the
+        # per-iteration flush.  A/B lever and escape hatch.
+        self._legacy_wire = bool(os.environ.get("MRT_WIRE_LEGACY"))
         # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
         self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
         # The per-process observability plane: counters + bounded span
@@ -135,9 +200,17 @@ class RpcNode:
 
         default_spin = "40" if usable_cpus() > 1 else "0"
         self._tr.set_spin(int(os.environ.get("MRT_SPIN_US", default_spin)))
+        # Span construction is gated off the untraced hot path: only
+        # tagged requests (trace_id present) or a trace-dir run build
+        # span dicts; everything else is a counter bump (see _dispatch).
+        self._trace_all = self.tracer is not None
         # The loop thread doubles as the transport's read reactor; it
-        # owns all handler execution and future resolution.
-        self.sched = IoScheduler(self._tr.poll, self._on_event, self._tr.wake)
+        # owns all handler execution and future resolution.  io_flush
+        # drains the reply queue once per loop iteration.
+        self.sched = IoScheduler(
+            self._tr.poll, self._on_event, self._tr.wake,
+            io_flush=self._flush_replies,
+        )
 
     # -- service side ------------------------------------------------------
 
@@ -173,6 +246,15 @@ class RpcNode:
             except ConnectionError:
                 return None
             self._conns[addr] = cid
+            # First frame out: offer our wire caps.  The transport
+            # queues it until the handshake completes, so it always
+            # precedes every request on this connection.
+            if not self._legacy_wire:
+                self._hello_sent.add(cid)
+                try:
+                    self._tr.send(cid, codec.encode(("hello", _WIRE_CAPS)))
+                except Exception:
+                    pass  # negotiation is best-effort; legacy shapes remain
         return cid
 
     def _call(
@@ -227,8 +309,19 @@ class RpcNode:
             frame = ("req", req_id, svc_meth, args)
         else:
             frame = ("req", req_id, svc_meth, args, trace_id)
-        buf = codec.encode(frame)
-        ok = self._tr.send(cid, buf)
+        caps = self._peer_caps.get(cid)
+        if caps is not None and "oob" in caps:
+            segs = codec.encode_oob(frame)
+            nbytes = sum(_seg_len(s) for s in segs)
+            if len(segs) > 1:
+                m.inc("rpc.oob_buffers", len(segs) - 1)
+                ok = self._tr.send_parts(cid, [_frame_header(nbytes), *segs])
+            else:
+                ok = self._tr.send(cid, segs[0])
+        else:
+            buf = codec.encode(frame)
+            nbytes = len(buf)
+            ok = self._tr.send(cid, buf)
         if not ok:
             # The transport no longer knows this conn (torn down between
             # our lookup and the send) — drop the stale cache entry so the
@@ -241,7 +334,7 @@ class RpcNode:
             self.sched.call_soon(fut.resolve, None)
             return
         m.inc("rpc.frames_out")
-        m.inc("rpc.bytes_out", len(buf))
+        m.inc("rpc.bytes_out", nbytes)
 
     def _on_event(self, ev: Tuple[int, int, bytes]) -> None:
         # Runs on the scheduler loop (the IO reactor thread).
@@ -303,21 +396,49 @@ class RpcNode:
             self._dispatch(conn, msg[1], msg[2], msg[3], trace_id)
         elif msg[0] == "rep":
             _, req_id, value = msg
-            with self._lock:
-                entry = self._pending.pop(req_id, None)
-            if entry is not None:
-                _, fut, svc_meth, t0, trace_id = entry
-                dt = time.perf_counter() - t0
-                self.obs.metrics.observe("rpc.client.call_s", dt)
-                if trace_id is not None:
-                    # Caller-side leg of the cross-process span pair.
-                    self.obs.tracer.span(
-                        svc_meth, t0 * 1e6, dt * 1e6, track="rpc-out",
-                        req=trace_id,
-                    )
-                fut.resolve(value)
+            self._complete(req_id, value)
+        elif msg[0] == "repb":
+            # Coalesced multi-reply (negotiated; we asked for it via
+            # hello, so the peer knows we decode it).
+            for req_id, value in msg[1]:
+                self._complete(req_id, value)
+        elif msg[0] == "hello":
+            # Peer capability offer.  Answer once per connection with
+            # ours (the acceptor side of the handshake); the initiator
+            # already sent its hello at connect time.  A legacy-wire
+            # node stays silent: never answering keeps the peer on the
+            # legacy shapes in BOTH directions.
+            if self._legacy_wire:
+                return
+            self._peer_caps[conn] = frozenset(msg[1])
+            if conn not in self._hello_sent:
+                self._hello_sent.add(conn)
+                try:
+                    self._tr.send(conn, codec.encode(("hello", _WIRE_CAPS)))
+                except Exception:
+                    pass
+
+    def _complete(self, req_id: int, value: Any) -> None:
+        with self._lock:
+            entry = self._pending.pop(req_id, None)
+        if entry is not None:
+            _, fut, svc_meth, t0, trace_id = entry
+            dt = time.perf_counter() - t0
+            self.obs.metrics.observe("rpc.client.call_s", dt)
+            if trace_id is not None:
+                # Caller-side leg of the cross-process span pair.
+                self.obs.tracer.span(
+                    svc_meth, t0 * 1e6, dt * 1e6, track="rpc-out",
+                    req=trace_id,
+                )
+            fut.resolve(value)
 
     def _on_closed(self, conn: int) -> None:
+        # Mid-stream loss drops queued-but-unflushed replies with the
+        # connection — same contract as bytes lost in the kernel buffer.
+        self._outq.pop(conn, None)
+        self._peer_caps.pop(conn, None)
+        self._hello_sent.discard(conn)
         with self._lock:
             for addr, cid in list(self._conns.items()):
                 if cid == conn:
@@ -349,17 +470,23 @@ class RpcNode:
         obs.metrics.inc("rpc.handled")
         t0 = time.perf_counter()
 
+        # Span dicts are only built when someone will read them: a
+        # tagged request (cross-process follow-the-id) or a trace-dir
+        # run.  The untraced hot path is a counter bump + one observe.
+        want_span = trace_id is not None or self._trace_all
+
         def _done(conn_, req_id_, value):
             dt = time.perf_counter() - t0
             obs.metrics.observe("rpc.handle_s", dt)
-            sargs: Dict[str, Any] = {
-                "outcome": "ok" if value is not None else "none"
-            }
-            if trace_id is not None:
-                sargs["req"] = trace_id
-            obs.tracer.span(
-                svc_meth, t0 * 1e6, dt * 1e6, track="rpc", **sargs
-            )
+            if want_span:
+                sargs: Dict[str, Any] = {
+                    "outcome": "ok" if value is not None else "none"
+                }
+                if trace_id is not None:
+                    sargs["req"] = trace_id
+                obs.tracer.span(
+                    svc_meth, t0 * 1e6, dt * 1e6, track="rpc", **sargs
+                )
             reply(conn_, req_id_, value)
 
         try:
@@ -409,6 +536,28 @@ class RpcNode:
         self._reply(conn, req_id, value)
 
     def _reply(self, conn: int, req_id: int, value: Any) -> None:
+        # Queue for the end-of-iteration flush.  Replies are produced
+        # on the loop thread by construction (dispatch, future
+        # callbacks, chaos-delay timers all run there), so every reply
+        # from one timer burst coalesces into one vectored write per
+        # connection; a non-loop caller (defensive) sends immediately.
+        if not self._legacy_wire and self.sched.on_loop_thread():
+            if not self._outq:
+                self._outq_since = time.perf_counter()
+            self._outq.setdefault(conn, []).append((req_id, value))
+            # Bulk blob replies (a firehose frame's results) gate a
+            # serial client's next frame: flush now — mid-tick, like
+            # the legacy inline send — instead of riding out the rest
+            # of a pump tick.  Anything already queued coalesces in.
+            if (
+                isinstance(value, (bytes, bytearray, memoryview))
+                and len(value) >= _BULK_REPLY_BYTES
+            ):
+                self._flush_replies()
+            return
+        self._reply_now(conn, req_id, value)
+
+    def _reply_now(self, conn: int, req_id: int, value: Any) -> None:
         try:
             buf = codec.encode(("rep", req_id, value))
             self._tr.send(conn, buf)
@@ -417,6 +566,66 @@ class RpcNode:
             m.inc("rpc.bytes_out", len(buf))
         except Exception:
             self.obs.metrics.inc("rpc.reply_send_fail")
+
+    def _flush_replies(self, force: bool = True) -> None:
+        """Drain the per-connection reply queues.  The scheduler calls
+        this forced right before it blocks in the poller (no reply ever
+        waits out an idle sleep) and soft (``force=False``) after every
+        timer callback.  The soft call flushes only once the oldest
+        queued reply has aged past ``_FLUSH_MAX_AGE_S``: back-to-back
+        cheap callbacks (a pump burst resolving tickets) keep batching,
+        but a reply never waits out more than ~one engine tick when the
+        timer heap is saturated and the before-poll flush would starve.
+        Each connection's batch leaves as ONE vectored write: a single
+        ``repb`` frame when the peer negotiated it, else its frames
+        back to back in one syscall."""
+        q = self._outq
+        if not q:
+            return
+        if not force and (
+            time.perf_counter() - self._outq_since < _FLUSH_MAX_AGE_S
+        ):
+            return
+        self._outq = {}
+        m = self.obs.metrics
+        for conn, pairs in q.items():
+            caps = self._peer_caps.get(conn)
+            oob = caps is not None and "oob" in caps
+            try:
+                if caps is not None and "repb" in caps and len(pairs) > 1:
+                    frames: List[Tuple] = [("repb", pairs)]
+                else:
+                    frames = [("rep", rid, val) for rid, val in pairs]
+                parts: List[Any] = []
+                nbytes = 0
+                for fr in frames:
+                    segs = codec.encode_oob(fr) if oob else [codec.encode(fr)]
+                    if len(segs) > 1:
+                        m.inc("rpc.oob_buffers", len(segs) - 1)
+                    n = sum(_seg_len(s) for s in segs)
+                    parts.append(_frame_header(n))
+                    parts.extend(segs)
+                    nbytes += n
+                if len(parts) == 2 and isinstance(parts[1], bytes):
+                    # Lone in-band reply: the transport's plain send
+                    # frames and writes header‖body in one shot, without
+                    # the vectored path's per-part pointer marshalling.
+                    ok = self._tr.send(conn, parts[1])
+                else:
+                    ok = self._tr.send_parts(conn, parts)
+                if not ok:
+                    m.inc("rpc.reply_send_fail", len(pairs))
+                    continue
+                m.inc("rpc.frames_out", len(frames))
+                m.inc("rpc.bytes_out", nbytes)
+                m.inc("rpc.flushes")
+                # Counter twin of the sample: flush_replies / flushes
+                # is the exact mean coalescing factor (samples only
+                # surface percentiles in snapshots).
+                m.inc("rpc.flush_replies", len(pairs))
+                m.observe("rpc.frames_per_flush", float(len(pairs)))
+            except Exception:
+                m.inc("rpc.reply_send_fail", len(pairs))
 
     def sever(
         self,

@@ -22,6 +22,14 @@ body) ‖ len ‖ body``.  A torn tail record fails its checksum and is
 dropped — safe because acks gate on :meth:`sync` having covered the
 record (group fsync at pump cadence), so a torn record was never
 acknowledged.
+
+Write batching: :meth:`append` only stages ``header ‖ body`` in memory;
+:meth:`sync` lands the whole batch as ONE ``write()`` before the group
+fsync.  Semantics are unchanged — acks already gate on :meth:`sync`, so
+a record that never reached the file was never acknowledged, exactly
+like a torn tail.  Startup reads the file ONCE: a single streamed scan
+both finds the valid prefix (truncating any torn tail before this
+incarnation appends) and retains the record bodies for :meth:`replay`.
 """
 
 from __future__ import annotations
@@ -30,7 +38,7 @@ import os
 import struct
 import time
 import zlib
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional, Tuple
 
 from ..utils.metrics import Metrics
 
@@ -39,6 +47,7 @@ __all__ = ["WriteAheadLog"]
 _MAGIC = b"MRWL"
 _HEADER = struct.Struct("<4sIQ")  # magic, crc32(len ‖ body), len(body)
 _LEN = struct.Struct("<Q")
+_SCAN_CHUNK = 1 << 20
 
 
 class WriteAheadLog:
@@ -62,14 +71,18 @@ class WriteAheadLog:
         # none — the instrumentation below never branches on None.
         self.metrics = metrics if metrics is not None else Metrics()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # Truncate any torn tail BEFORE appending: records written
-        # after leftover garbage would be unreachable to every future
-        # replay (it stops at the first bad record) — silently losing
-        # the next incarnation's acked writes.
-        valid = self._valid_prefix_len()
+        # ONE streamed pass over the file: find the valid prefix AND
+        # keep the intact bodies for replay().  Truncate any torn tail
+        # BEFORE appending: records written after leftover garbage
+        # would be unreachable to every future replay (it stops at the
+        # first bad record) — silently losing the next incarnation's
+        # acked writes.
+        valid, bodies = self._scan()
         if valid is not None:
             os.truncate(path, valid)
+        self._startup: Optional[List[bytes]] = bodies
         self._f = open(path, "ab")
+        self._pend: List[bytes] = []  # staged records since last sync
         # Seqs are MONOTONIC for the whole incarnation — rotation must
         # not reset them, because ack gates and the fleet GC gate hold
         # seqs across it (a reset would turn synced(seq) false again
@@ -77,70 +90,102 @@ class WriteAheadLog:
         self.appended = 0  # records appended by this incarnation
         self.synced = 0    # records known durable
 
-    def _valid_prefix_len(self) -> Optional[int]:
-        """Byte length of the intact record prefix, or None if the file
-        is missing or already fully valid."""
+    def _scan(self) -> Tuple[Optional[int], List[bytes]]:
+        """One streamed pass: byte length of the intact record prefix
+        (None if the file is missing or already fully valid) plus every
+        intact body in append order."""
+        bodies: List[bytes] = []
         try:
-            with open(self.path, "rb") as f:
-                raw = f.read()
+            f = open(self.path, "rb")
         except FileNotFoundError:
-            return None
-        off = 0
-        while off + _HEADER.size <= len(raw):
-            magic, crc, n = _HEADER.unpack_from(raw, off)
-            body = raw[off + _HEADER.size: off + _HEADER.size + n]
-            if (
-                magic != _MAGIC
-                or len(body) != n
-                or zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc
-            ):
-                return off
-            off += _HEADER.size + n
-        return off if off < len(raw) else None
+            return None, bodies
+        hdr = _HEADER.size
+        with f:
+            window = bytearray()
+            valid = 0   # bytes consumed as intact records
+            torn = False
+            while True:
+                chunk = f.read(_SCAN_CHUNK)
+                if chunk:
+                    window.extend(chunk)
+                at_eof = not chunk
+                off = 0
+                while len(window) - off >= hdr:
+                    magic, crc, n = _HEADER.unpack_from(window, off)
+                    if magic != _MAGIC:
+                        torn = True
+                        break
+                    if len(window) - off - hdr < n:
+                        if at_eof:
+                            torn = True  # record torn mid-body
+                        break  # need more bytes
+                    body = bytes(window[off + hdr: off + hdr + n])
+                    if zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc:
+                        torn = True
+                        break
+                    bodies.append(body)
+                    off += hdr + n
+                    valid += hdr + n
+                del window[:off]
+                if torn:
+                    break
+                if at_eof:
+                    if window:  # trailing partial header
+                        torn = True
+                    break
+        return (valid if torn else None), bodies
 
     # -- recovery ---------------------------------------------------------
 
     def replay(self) -> Iterator[bytes]:
         """Yield every intact record body in append order, stopping at
         the first torn/corrupt record (an unacknowledged tail).  Call
-        before appending."""
-        try:
-            with open(self.path, "rb") as f:
-                raw = f.read()
-        except FileNotFoundError:
+        before appending.  Served from the constructor's single scan;
+        a second call (or a contract-breaking replay-after-append)
+        falls back to re-scanning the file."""
+        if self._startup is not None:
+            bodies, self._startup = self._startup, None
+            yield from bodies
             return
-        off = 0
-        while off + _HEADER.size <= len(raw):
-            magic, crc, n = _HEADER.unpack_from(raw, off)
-            body = raw[off + _HEADER.size: off + _HEADER.size + n]
-            if (
-                magic != _MAGIC
-                or len(body) != n
-                or zlib.crc32(body, zlib.crc32(_LEN.pack(n))) != crc
-            ):
-                return  # torn tail: never acked, drop it and stop
-            yield body
-            off += _HEADER.size + n
+        try:  # make staged/buffered appends visible to the re-scan
+            self._write_pending()
+            self._f.flush()
+        except Exception:
+            pass
+        _, bodies = self._scan()
+        yield from bodies
 
     # -- append path ------------------------------------------------------
 
     def append(self, body: bytes) -> int:
-        """Buffer one record; returns its seq (ack-gate with
+        """Stage one record; returns its seq (ack-gate with
         ``synced >= seq`` after a :meth:`sync`)."""
         crc = zlib.crc32(body, zlib.crc32(_LEN.pack(len(body))))
-        self._f.write(_HEADER.pack(_MAGIC, crc, len(body)))
-        self._f.write(body)
+        self._pend.append(_HEADER.pack(_MAGIC, crc, len(body)) + body)
         self.appended += 1
         m = self.metrics
         m.inc("wal.appends")
         m.inc("wal.bytes", _HEADER.size + len(body))
         return self.appended
 
+    def _write_pending(self) -> None:
+        """Land every staged record as one ``write()``."""
+        if not self._pend:
+            return
+        nrec = len(self._pend)
+        batch = self._pend[0] if nrec == 1 else b"".join(self._pend)
+        self._pend.clear()
+        self._f.write(batch)
+        m = self.metrics
+        m.inc("wal.write_batches")
+        m.observe("wal.batch_records", float(nrec))
+
     def sync(self) -> None:
         """Group commit: make everything appended so far durable."""
         if self.synced >= self.appended:
             return
         t0 = time.perf_counter()
+        self._write_pending()
         self._f.flush()
         if self._fsync:
             os.fsync(self._f.fileno())
@@ -155,6 +200,12 @@ class WriteAheadLog:
         """Truncate to empty, atomically.  Call only after the covering
         checkpoint is durable — a crash in between merely makes the
         next replay redundant (dedup absorbs it)."""
+        # Staged records are covered by the checkpoint being rotated
+        # behind (rotate runs right after sync/checkpoint on the loop
+        # thread) — discard them the same way the truncate discards
+        # written-but-rotated bytes.
+        self._pend.clear()
+        self._startup = None
         self._f.close()
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
@@ -173,6 +224,10 @@ class WriteAheadLog:
         # appended/synced deliberately NOT reset — see __init__.
 
     def close(self) -> None:
+        try:
+            self._write_pending()
+        except Exception:
+            pass
         try:
             self._f.close()
         except Exception:

@@ -22,6 +22,29 @@ Python's analog of those hazards is different, so the lints are too:
 
 Encoding is ``pickle`` under the hood (self-describing, fast, stdlib); the
 registry is the schema-checking layer on top.
+
+Out-of-band fast path
+---------------------
+
+:func:`encode_oob` is the zero-copy variant for the serving hot path: it
+pickles at protocol 5 with a ``buffer_callback``, so numpy arrays and
+large ``bytes`` blobs (wrapped in :class:`pickle.PickleBuffer`) ship as
+raw buffer segments instead of being copied into the pickle stream.  The
+return value is a list of wire *segments* — ``[header ‖ pickle-bytes,
+buffer, buffer, ...]`` — which a vectored transport writes without ever
+joining them.  The segments concatenate to one self-describing payload:
+
+    0x01 ‖ u32 nbufs ‖ nbufs × u64 buffer-len ‖ pickle5 ‖ buffers...
+
+A legacy pickle stream always starts with ``0x80`` (the PROTO opcode), so
+:func:`decode` dispatches on the first byte and handles both formats.
+When a payload yields no out-of-band buffers, :func:`encode_oob`
+degrades to a single legacy-format segment — old peers never see the
+``0x01`` format unless the caller negotiated it (tcp.py's hello
+exchange).  Decode copies every buffer region into a fresh writable
+``bytearray`` before handing it to the unpickler, preserving the value-
+isolation guarantee: decoded buffers never alias the sender OR the
+transport's receive buffer.
 """
 
 from __future__ import annotations
@@ -29,10 +52,24 @@ from __future__ import annotations
 import dataclasses
 import io
 import pickle
+import struct
 import warnings
-from typing import Any, Iterable, Type
+from typing import Any, List, Tuple, Type
 
-__all__ = ["register", "registered", "encode", "decode", "CodecError", "wire_size"]
+try:  # numpy is baked into this image, but the codec must not require it
+    import numpy as _np
+except Exception:  # pragma: no cover - numpy-less fallback
+    _np = None  # type: ignore[assignment]
+
+__all__ = [
+    "register",
+    "registered",
+    "encode",
+    "encode_oob",
+    "decode",
+    "CodecError",
+    "wire_size",
+]
 
 
 class CodecError(TypeError):
@@ -41,14 +78,25 @@ class CodecError(TypeError):
 
 _REGISTRY: dict[str, Type] = {}
 # Primitive payloads allowed without registration (matches gob's built-in
-# support for basic kinds).
-_PRIMITIVES = (type(None), bool, int, float, str, bytes)
+# support for basic kinds).  bytearray/memoryview join bytes: they are
+# pure buffer payloads (the OOB path produces them on decode, so a
+# handler echoing one back must stay encodable).
+_PRIMITIVES = (type(None), bool, int, float, str, bytes, bytearray, memoryview)
+
+# Per-type registry-validation memo: class → dataclass field-name tuple
+# (empty for non-dataclasses).  Registry lookup + dataclasses.fields()
+# re-ran on EVERY encode of every frame; payload *types* are a small
+# closed set, so one dict hit replaces both.  Presence of a key means
+# "registered"; register() invalidates so a type registered after a
+# failed encode is picked up.
+_CHECK_MEMO: dict[type, Tuple[str, ...]] = {}
 
 
 def register(*classes: Type) -> None:
     """Register message/payload classes (labgob.Register equivalent)."""
     for cls in classes:
         _REGISTRY[cls.__qualname__] = cls
+    _CHECK_MEMO.clear()
 
 
 def registered(cls: Type) -> Type:
@@ -70,37 +118,160 @@ def _check_encodable(obj: Any) -> None:
             _check_encodable(v)
         return
     cls = type(obj)
-    if cls.__qualname__ not in _REGISTRY:
-        raise CodecError(
-            f"codec: {cls.__qualname__} is not registered; call "
-            f"codec.register({cls.__name__}) before sending it on the wire "
-            "(labgob.Register equivalent)"
-        )
-    if dataclasses.is_dataclass(obj):
-        missing_ok = not hasattr(obj, "__dict__")  # slotted: trust hasattr
-        for field in dataclasses.fields(obj):
-            absent = (
-                not hasattr(obj, field.name)
-                if missing_ok
-                else field.name not in obj.__dict__
+    fields = _CHECK_MEMO.get(cls)
+    if fields is None:
+        if _np is not None and isinstance(obj, _np.ndarray):
+            if obj.dtype.hasobject:
+                raise CodecError(
+                    "codec: object-dtype arrays smuggle arbitrary Python "
+                    "objects past the registry; send a registered class "
+                    "or a plain-dtype array"
+                )
+            return  # plain-dtype arrays are buffer payloads, not schemas
+        if cls.__qualname__ not in _REGISTRY:
+            raise CodecError(
+                f"codec: {cls.__qualname__} is not registered; call "
+                f"codec.register({cls.__name__}) before sending it on the wire "
+                "(labgob.Register equivalent)"
             )
+        fields = (
+            tuple(f.name for f in dataclasses.fields(obj))
+            if dataclasses.is_dataclass(obj)
+            else ()
+        )
+        _CHECK_MEMO[cls] = fields
+    if fields:
+        missing_ok = not hasattr(obj, "__dict__")  # slotted: trust hasattr
+        d = None if missing_ok else obj.__dict__
+        for name in fields:
+            absent = not hasattr(obj, name) if missing_ok else name not in d
             if absent:
                 warnings.warn(
-                    f"codec: {cls.__qualname__}.{field.name} missing at "
+                    f"codec: {cls.__qualname__}.{name} missing at "
                     "encode time; receiver will see a partial message",
                     stacklevel=3,
                 )
 
 
 def encode(obj: Any) -> bytes:
-    """Serialize ``obj`` to self-describing bytes, enforcing registration."""
+    """Serialize ``obj`` to self-describing bytes, enforcing registration.
+
+    Runs the same buffer rewrite as :func:`encode_oob` but without a
+    ``buffer_callback``, so wrapped buffers serialize in-band — one
+    self-contained segment, but memoryview payloads (which raw pickle
+    rejects) still encode.  Readonly buffers reconstruct as ``bytes``,
+    writable ones as ``bytearray``."""
     _check_encodable(obj)
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    return pickle.dumps(_wrap_buffers(obj), protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def decode(data: bytes) -> Any:
-    """Deserialize bytes produced by :func:`encode` into a fresh object."""
-    return _RestrictedUnpickler(io.BytesIO(data)).load()
+# -- out-of-band fast path --------------------------------------------------
+
+# Header: format byte ‖ u32 buffer count; then per-buffer u64 lengths.
+_OOB_FIRST = 0x01
+_OOB_HDR = struct.Struct(">BI")
+_OOB_LEN = struct.Struct(">Q")
+# bytes blobs below this stay in-band: the PickleBuffer indirection and
+# the extra iovec entry cost more than a small memcpy saves.
+_OOB_MIN_BYTES = 2048
+# Wrap depth: frame tuple → repb pair list → (req_id, value) pairs.
+_OOB_DEPTH = 3
+
+
+def _wrap_buffers(obj: Any, depth: int = _OOB_DEPTH) -> Any:
+    """Shallow rebuild of ``obj`` with large bytes wrapped in
+    PickleBuffer so protocol 5 ships them out-of-band.  numpy arrays
+    need no wrapping (their reducer is already buffer-aware).  Depth-
+    bounded: only frame-shaped nesting is rewritten, deep payload
+    structure is left to the pickler.
+
+    memoryview is wrapped regardless of size: the pickler cannot
+    serialize one raw, and handlers legitimately hold them — OOB decode
+    hands out views over the receive-side copy, and echoing a payload
+    back is the simplest server.  Without a buffer_callback the wrapper
+    serializes in-band and reconstructs as bytes/bytearray, so the same
+    rewrite also makes the legacy :func:`encode` path view-safe."""
+    if isinstance(obj, bytes) and len(obj) >= _OOB_MIN_BYTES:
+        return pickle.PickleBuffer(obj)
+    if isinstance(obj, memoryview):
+        # PickleBuffer refuses non-contiguous views; flatten those first.
+        return pickle.PickleBuffer(obj if obj.contiguous else obj.tobytes())
+    if isinstance(obj, bytearray) and len(obj) >= _OOB_MIN_BYTES:
+        return pickle.PickleBuffer(obj)
+    if depth > 0:
+        if type(obj) is tuple:
+            return tuple(_wrap_buffers(x, depth - 1) for x in obj)
+        if type(obj) is list:
+            return [_wrap_buffers(x, depth - 1) for x in obj]
+    return obj
+
+
+def encode_oob(obj: Any) -> List[Any]:
+    """Serialize ``obj`` into wire segments whose concatenation is one
+    :func:`decode`-able payload, shipping numpy arrays and large bytes
+    blobs as raw out-of-band segments (no serialize copy).  Falls back
+    to a single legacy-format segment when the payload yields no
+    buffers, so callers can use it unconditionally once the peer
+    negotiated the format."""
+    _check_encodable(obj)
+    bufs: List[pickle.PickleBuffer] = []
+    # buffer_callback returning a FALSY value is what takes the buffer
+    # out-of-band (truthy would serialize it in-band as well) —
+    # list.append's None is exactly right.
+    pkl = pickle.dumps(
+        _wrap_buffers(obj),
+        protocol=5,
+        buffer_callback=bufs.append,
+    )
+    if not bufs:
+        return [pkl]
+    views = []
+    lens = bytearray()
+    for pb in bufs:
+        mv = pb.raw()
+        under = getattr(mv, "obj", None)
+        if isinstance(under, bytes) and len(under) == mv.nbytes:
+            # The buffer IS a whole bytes object — pass it through so
+            # the ctypes layer gets a pointer without a view wrapper.
+            views.append(under)
+        else:
+            views.append(mv)
+        lens.extend(_OOB_LEN.pack(mv.nbytes))
+    if len(views) >= 2 ** 32:
+        # The header's buffer count is u32; wrapping it would desync
+        # every buffer offset on decode.
+        raise CodecError(
+            f"codec: payload yields {len(views)} out-of-band buffers; "
+            f"the wire header caps the count below {2 ** 32}"
+        )
+    head = _OOB_HDR.pack(_OOB_FIRST, len(views)) + bytes(lens) + pkl
+    return [head, *views]
+
+
+def decode(data: Any) -> Any:
+    """Deserialize bytes produced by :func:`encode` (or a joined
+    :func:`encode_oob` segment list) into a fresh object."""
+    mv = memoryview(data)
+    if mv.nbytes and mv[0] == _OOB_FIRST:
+        _, nbufs = _OOB_HDR.unpack_from(mv, 0)
+        off = _OOB_HDR.size
+        sizes = [
+            _OOB_LEN.unpack_from(mv, off + i * _OOB_LEN.size)[0]
+            for i in range(nbufs)
+        ]
+        off += nbufs * _OOB_LEN.size
+        tail = sum(sizes)
+        pkl = mv[off: mv.nbytes - tail]
+        # Fresh writable copies: decoded buffers must never alias the
+        # sender's objects or the transport's receive buffer (value
+        # isolation), and numpy rebuilds writable arrays over them.
+        bufs = []
+        boff = mv.nbytes - tail
+        for n in sizes:
+            bufs.append(bytearray(mv[boff: boff + n]))
+            boff += n
+        return _RestrictedUnpickler(io.BytesIO(pkl), buffers=bufs).load()
+    return _RestrictedUnpickler(io.BytesIO(mv)).load()
 
 
 def wire_size(obj: Any) -> int:
@@ -113,7 +284,18 @@ class _RestrictedUnpickler(pickle.Unpickler):
     """Only resolves registered classes plus stdlib builtins — the decode
     side of the schema check."""
 
-    _ALLOWED_MODULES = {"builtins", "collections"}
+    # numpy's array reconstructors moved between numpy 1.x and 2.x;
+    # allow both spellings (find_class sees whichever the encoder's
+    # numpy emitted).
+    _ALLOWED_MODULES = {
+        "builtins",
+        "collections",
+        "numpy",
+        "numpy.core.multiarray",
+        "numpy.core.numeric",
+        "numpy._core.multiarray",
+        "numpy._core.numeric",
+    }
 
     def find_class(self, module: str, name: str) -> Any:
         short = name.rsplit(".", 1)[-1]

@@ -2,8 +2,10 @@
 
 The encoder ships 4-tuples for "req" but the decoder grew a fifth
 field without a ``len()`` guard, and unpacks "rep" into 4 names while
-the encoder only ever produces 3.  graftlint must flag both
-(frame-arity).
+the encoder only ever produces 3.  The batched-reply frame drifts the
+same two ways: the decoder reads a third "repb" field the 2-tuple
+encoder never packs, and unpacks the frame into 3 names.  graftlint
+must flag all four (frame-arity).
 """
 
 from somewhere import codec  # noqa: F401  (never executed)
@@ -17,9 +19,23 @@ def send_rep(tr, cid, req_id, value):
     tr.send(cid, codec.encode(("rep", req_id, value)))
 
 
+def send_repb(tr, cid, pairs):
+    tr.send(cid, codec.encode(("repb", pairs)))
+
+
 def handle(msg, dispatch, resolve):
     if msg[0] == "req":
         dispatch(msg[1], msg[2], msg[3], msg[4])  # 5th field, no guard
     elif msg[0] == "rep":
         _, req_id, value, trace = msg  # decoder expects 4, encoder packs 3
         resolve(req_id, value, trace)
+    elif msg[0] == "repb":
+        for req_id, value in msg[1]:
+            resolve(req_id, value, msg[2])  # 3rd field, encoder packs 2
+
+
+def handle_batch(msg, resolve):
+    if msg[0] == "repb":
+        _, pairs, trace = msg  # decoder expects 3, encoder packs 2
+        for req_id, value in pairs:
+            resolve(req_id, value, trace)

@@ -53,7 +53,7 @@ _FIXTURE_CASES = [
     # (fixture, rule, minimum number of findings)
     ("alias_restore.py", "donated-alias", 1),  # PR 1 restore segfault
     ("wire_pack.py", "wire-width", 3),  # PR 1 u16 key-length wrap
-    ("frame_drift.py", "frame-arity", 2),  # trace-id wire drift class
+    ("frame_drift.py", "frame-arity", 4),  # trace-id + repb wire drift
     ("control_drift.py", "control-exempt", 1),  # PR 2 exemption drift
     ("impure_tick.py", "jit-purity", 4),  # trace-time effects
     ("lock_cycle.py", "lock-order", 1),  # ABBA across node/transport

@@ -78,3 +78,95 @@ def test_wire_size_positive_and_monotone():
     big = codec.wire_size(T1(y="a" * 5000))
     assert 0 < small < big
     assert big >= 5000
+
+# -- out-of-band fast path ---------------------------------------------------
+
+
+def _join(segs):
+    return b"".join(bytes(s) for s in segs)
+
+
+def test_oob_small_payload_degrades_to_legacy():
+    # No buffers extracted → a single legacy-pickle segment an old peer
+    # (plain decode, no negotiation) handles unchanged.
+    segs = codec.encode_oob(("rep", 7, {"k": [1, 2]}))
+    assert len(segs) == 1
+    assert bytes(segs[0])[0] == 0x80  # PROTO opcode, not the OOB marker
+    assert codec.decode(segs[0]) == ("rep", 7, {"k": [1, 2]})
+
+
+def test_oob_large_bytes_ship_out_of_band():
+    blob = bytes(range(256)) * 64  # 16 KiB, above _OOB_MIN_BYTES
+    segs = codec.encode_oob(("rep", 1, blob))
+    assert len(segs) == 2  # head + one raw buffer segment
+    assert bytes(segs[0])[0] == 0x01
+    out = codec.decode(_join(segs))
+    assert out == ("rep", 1, blob)
+    # A true out-of-band blob decodes as a buffer view over the fresh
+    # receive-side copy (no materialization); every hot-path consumer
+    # speaks the buffer protocol (np.frombuffer, memoryview slicing).
+    assert isinstance(out[2], (bytes, bytearray, memoryview))
+    assert bytes(out[2]) == blob
+
+
+def test_oob_numpy_roundtrip_writable_no_alias():
+    np = pytest.importorskip("numpy")
+    col = np.arange(4096, dtype=np.float32)
+    segs = codec.encode_oob(("rep", 2, col))
+    assert len(segs) >= 2  # numpy reducer emits at least one buffer
+    out = codec.decode(_join(segs))
+    arr = out[2]
+    assert isinstance(arr, np.ndarray)
+    assert arr.dtype == col.dtype and np.array_equal(arr, col)
+    # Value isolation: the decoded array must be writable and mutating
+    # it must not touch the sender's array.
+    arr[0] = -1.0
+    assert col[0] == 0.0
+
+
+def test_oob_repb_frame_many_buffers():
+    blob_a, blob_b = b"a" * 4096, b"b" * 8192
+    frame = ("repb", [(1, blob_a), (2, blob_b)])
+    segs = codec.encode_oob(frame)
+    assert len(segs) == 3  # head + both blobs out-of-band
+    assert codec.decode(_join(segs)) == frame
+
+
+def test_oob_segments_alias_sender_but_decode_copies():
+    # Zero-copy on the encode side: the raw segment IS the sender's
+    # bytes object (no serialize copy)…
+    blob = b"z" * 4096
+    segs = codec.encode_oob(("rep", 3, blob))
+    assert any(s is blob for s in segs[1:])
+    # …while decode still hands the receiver an independent copy.
+    out = codec.decode(_join(segs))
+    assert out[2] == blob and out[2] is not blob
+
+
+def test_oob_decoded_view_reencodes_both_paths():
+    # Echo servers hand a decoded payload straight back.  OOB decode
+    # yields memoryviews, which raw pickle rejects — both encode paths
+    # must rewrite them (in-band for legacy peers, out-of-band for
+    # negotiated ones) instead of crashing the reply.
+    blob = b"e" * 4096
+    out = codec.decode(_join(codec.encode_oob(("req", 9, blob))))
+    view = out[2]
+    assert isinstance(view, memoryview)
+    legacy = codec.decode(codec.encode(("rep", 9, ("echo", view))))
+    assert bytes(legacy[2][1]) == blob
+    fast = codec.decode(_join(codec.encode_oob(("rep", 9, ("echo", view)))))
+    assert bytes(fast[2][1]) == blob
+
+
+def test_oob_decode_still_enforces_registry():
+    import pickle
+
+    pkl = pickle.dumps(Unregistered(), protocol=5)
+    with pytest.raises(codec.CodecError):
+        codec.decode(pkl)
+
+
+def test_oob_object_dtype_rejected():
+    np = pytest.importorskip("numpy")
+    with pytest.raises(codec.CodecError):
+        codec.encode_oob(np.array([object()], dtype=object))
