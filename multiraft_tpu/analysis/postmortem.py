@@ -1,0 +1,545 @@
+"""Postmortem doctor: read the fleet's black boxes and say what broke.
+
+``python -m multiraft_tpu.analysis.postmortem <bundle>`` consumes a
+bundle directory produced by :func:`multiraft_tpu.harness.bundle.
+collect_bundle` (flight rings + final snapshots + manifest) and emits:
+
+* a human-readable report (stdout + ``<bundle>/report.txt``): per
+  process — clean vs UNCLEAN death, last committed op (group / client /
+  command / rid), WAL fsync gap (appends that were staged but never
+  fsync'd when the process died), last known role/term/commit per raft
+  peer, chaos fault bursts; fleet-wide — a clock-aligned anomaly
+  timeline with the FIRST anomaly called out, and commit/apply lag
+  from the final ``Obs.groups`` snapshots.
+* a Perfetto trace (``<bundle>/flight_trace.json.gz``): every intact
+  ring record as a span/instant/counter on one clock-aligned time
+  axis, commit instants tagged with their rid so a request can be
+  chased across processes with the trace viewer's search.
+
+Clock alignment reuses the harness's min-RTT offsets: the manifest
+maps address → offset (remote perf_counter µs − host) and address →
+pid, so each ring's timestamps shift by −offset onto the host clock —
+including rings of processes that were dead at collection time, whose
+offsets were cached while they lived.
+
+The doctor also accepts a bare ``.ring`` file or a directory of rings
+(no manifest): alignment degrades to per-process clocks, the analyses
+still run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..distributed import flightrec
+from ..utils.trace import Tracer
+
+__all__ = ["load_bundle", "analyze", "build_report", "main"]
+
+Record = Dict[str, Any]
+
+# A reply-drop (or any chaos) burst this dense is worth a report line:
+# ≥ BURST_MIN faults inside BURST_WINDOW_US.
+BURST_WINDOW_US = 1_000_000.0
+BURST_MIN = 5
+
+
+# -- loading ---------------------------------------------------------------
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Load a bundle dir, a directory of rings, or one ``.ring`` file
+    into ``{"dir", "manifest", "snapshots", "windows", "rings"}``.
+    Unreadable rings are skipped with a note in ``"skipped"`` — one
+    corrupt file must not block the rest of the postmortem."""
+    out: Dict[str, Any] = {
+        "dir": path, "manifest": {}, "snapshots": {}, "windows": [],
+        "rings": [], "skipped": [],
+    }
+    if os.path.isfile(path):
+        ring_paths = [path]
+        out["dir"] = os.path.dirname(path) or "."
+    else:
+        for name in ("manifest.json", "snapshots.json", "windows.json"):
+            p = os.path.join(path, name)
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        out[name.split(".", 1)[0]] = json.load(f)
+                except (OSError, ValueError) as exc:
+                    out["skipped"].append(f"{name}: {exc}")
+        ring_paths = sorted(
+            glob.glob(os.path.join(path, "rings", "*.ring"))
+            or glob.glob(os.path.join(path, "*.ring"))
+        )
+    for rp in ring_paths:
+        try:
+            ring = flightrec.read_ring(rp)
+        except (OSError, ValueError) as exc:
+            out["skipped"].append(f"{os.path.basename(rp)}: {exc}")
+            continue
+        ring["path"] = rp
+        out["rings"].append(ring)
+    return out
+
+
+def _pid_offsets(manifest: Dict[str, Any]) -> Dict[int, float]:
+    """pid → clock offset (remote − host, µs) via the manifest's
+    addr→offset and addr→ident tables; the collecting host is 0."""
+    offs: Dict[int, float] = {}
+    idents = manifest.get("idents") or {}
+    offsets = manifest.get("offsets_us") or {}
+    for addr, ident in idents.items():
+        off = offsets.get(addr)
+        pid = int(ident.get("pid", -1))
+        if off is not None and pid > 0:
+            offs[pid] = float(off)
+    host_pid = manifest.get("host_pid")
+    if host_pid:
+        offs[int(host_pid)] = 0.0
+    return offs
+
+
+def _pid_addr(manifest: Dict[str, Any], pid: int) -> Optional[str]:
+    for addr, ident in (manifest.get("idents") or {}).items():
+        if int(ident.get("pid", -1)) == pid:
+            return addr
+    return None
+
+
+# -- per-ring + fleet analysis ---------------------------------------------
+
+
+def _last(records: List[Record], etype: int) -> Optional[Record]:
+    for r in reversed(records):
+        if r["type"] == etype:
+            return r
+    return None
+
+
+def _max_burst(
+    ts_list: List[float], window_us: float = BURST_WINDOW_US,
+) -> Tuple[int, float]:
+    """Densest ``window_us`` window over sorted timestamps:
+    ``(count, window_start_ts)``."""
+    best, best_ts = 0, 0.0
+    lo = 0
+    for hi, t in enumerate(ts_list):
+        while t - ts_list[lo] > window_us:
+            lo += 1
+        if hi - lo + 1 > best:
+            best, best_ts = hi - lo + 1, ts_list[lo]
+    return best, best_ts
+
+
+def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    """Run every per-ring analysis plus the fleet-wide anomaly merge.
+
+    Returns ``{"procs": [per-ring dict...], "anomalies": [...],
+    "first_anomaly": ... | None, "lag": {addr: ...}}``.  Anomaly
+    timestamps are host-clock µs when the manifest provides offsets,
+    else the ring's own clock (flagged ``aligned: False``)."""
+    manifest = bundle.get("manifest") or {}
+    offsets = _pid_offsets(manifest)
+    procs: List[Dict[str, Any]] = []
+    anomalies: List[Dict[str, Any]] = []
+
+    for ring in bundle["rings"]:
+        recs: List[Record] = ring["records"]
+        pid = ring["pid"]
+        off = offsets.get(pid)
+        addr = _pid_addr(manifest, pid)
+        label = f"{ring['name'] or 'pid' + str(pid)}" + (
+            f" @ {addr}" if addr else ""
+        )
+
+        def aligned(ts: float, _off: Optional[float] = off) -> float:
+            return ts - _off if _off is not None else ts
+
+        info: Dict[str, Any] = {
+            "pid": pid, "name": ring["name"], "addr": addr,
+            "label": label, "path": ring["path"],
+            "records": len(recs), "torn": ring["torn"],
+            "slots": ring["slots"], "clean_close": ring["clean_close"],
+            "aligned": off is not None,
+        }
+        if not recs:
+            procs.append(info)
+            continue
+        info["first_seq"] = recs[0]["seq"]
+        info["last_seq"] = recs[-1]["seq"]
+        info["last_event"] = recs[-1]
+
+        last_commit = _last(recs, flightrec.COMMIT)
+        if last_commit is not None:
+            info["last_commit"] = last_commit
+        last_append = _last(recs, flightrec.WAL_APPEND)
+        last_fsync = _last(recs, flightrec.WAL_FSYNC)
+        if last_append is not None:
+            appended = last_append["a"]
+            synced = last_fsync["a"] if last_fsync is not None else 0
+            info["wal"] = {"appended": appended, "synced": synced,
+                           "gap": appended - synced}
+        roles: Dict[int, Record] = {}
+        for r in recs:
+            if r["type"] == flightrec.ROLE:
+                roles[r["code"]] = r
+        if roles:
+            info["roles"] = {
+                peer: {"role": r["a"], "term": r["b"], "commit": r["c"]}
+                for peer, r in sorted(roles.items())
+            }
+        chaos_ts: Dict[str, List[float]] = {}
+        for r in recs:
+            if r["type"] == flightrec.CHAOS:
+                chaos_ts.setdefault(r["tag"], []).append(r["ts"])
+        bursts = {}
+        for path_tag, ts_list in chaos_ts.items():
+            n, t0 = _max_burst(ts_list)
+            bursts[path_tag] = {
+                "total": len(ts_list), "max_burst": n,
+                "burst_at": aligned(t0),
+            }
+        if bursts:
+            info["chaos"] = bursts
+
+        # -- anomaly extraction (all timestamps aligned when possible)
+        if not ring["clean_close"]:
+            last = recs[-1]
+            what = f"last event {last['type_name']} seq {last['seq']}"
+            if last_commit is not None:
+                what += f"; last commit {_fmt_commit(last_commit)}"
+            anomalies.append({
+                "ts": aligned(last["ts"]), "proc": label,
+                "kind": "unclean_death", "detail": what,
+                "aligned": off is not None,
+            })
+        if info.get("wal", {}).get("gap", 0) > 0:
+            gap = info["wal"]["gap"]
+            anomalies.append({
+                "ts": aligned(last_append["ts"]), "proc": label,
+                "kind": "fsync_gap",
+                "detail": (
+                    f"{gap} WAL append(s) past last fsync "
+                    f"(appended seq {info['wal']['appended']}, "
+                    f"synced {info['wal']['synced']}) — unacked writes "
+                    f"staged at death"
+                ),
+                "aligned": off is not None,
+            })
+        for path_tag, bst in bursts.items():
+            if bst["max_burst"] >= BURST_MIN:
+                anomalies.append({
+                    "ts": bst["burst_at"], "proc": label,
+                    "kind": "chaos_burst",
+                    "detail": (
+                        f"{bst['max_burst']} faults on '{path_tag}' "
+                        f"within {BURST_WINDOW_US / 1e6:.0f}s "
+                        f"({bst['total']} total)"
+                    ),
+                    "aligned": off is not None,
+                })
+        torn = ring["torn"]
+        if torn > 1:
+            # One torn slot is the expected SIGKILL signature; more
+            # means the file itself took damage — say so.
+            anomalies.append({
+                "ts": aligned(recs[-1]["ts"]), "proc": label,
+                "kind": "torn_slots",
+                "detail": f"{torn} slots failed checksum",
+                "aligned": off is not None,
+            })
+        procs.append(info)
+
+    # Missing processes per the final scrape (dead at collection).
+    lag: Dict[str, Any] = {}
+    for addr, snap in (bundle.get("snapshots") or {}).items():
+        if snap.get("missing"):
+            lag[addr] = {"missing": True, "pid": snap.get("pid")}
+            continue
+        groups = snap.get("groups")
+        if not groups:
+            continue
+        commit = groups.get("commit") or []
+        applied = groups.get("applied") or []
+        pairs = list(zip(commit, applied))
+        if not pairs:
+            continue
+        worst = max(range(len(pairs)), key=lambda i: pairs[i][0] - pairs[i][1])
+        lag[addr] = {
+            "max_lag": pairs[worst][0] - pairs[worst][1],
+            "group": worst,
+            "commit": pairs[worst][0],
+            "applied": pairs[worst][1],
+        }
+
+    anomalies.sort(key=lambda a: a["ts"])
+    return {
+        "procs": procs,
+        "anomalies": anomalies,
+        "first_anomaly": anomalies[0] if anomalies else None,
+        "lag": lag,
+    }
+
+
+# -- Perfetto export -------------------------------------------------------
+
+
+def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
+    """One clock-aligned Chrome trace from every ring in the bundle."""
+    manifest = bundle.get("manifest") or {}
+    offsets = _pid_offsets(manifest)
+    total = sum(len(r["records"]) for r in bundle["rings"])
+    out = Tracer(max_events=total + 16 * max(1, len(bundle["rings"])))
+    for ring in bundle["rings"]:
+        pid = ring["pid"]
+        off = offsets.get(pid, 0.0)
+        addr = _pid_addr(manifest, pid)
+        tagbits = "" if pid in offsets else " (unaligned clock)"
+        out.process_name(
+            pid, f"{ring['name'] or 'pid' + str(pid)}"
+                 + (f" @ {addr}" if addr else "") + tagbits,
+        )
+        for r in ring["records"]:
+            ts = r["ts"] - off
+            t = r["type"]
+            if t in (flightrec.RPC_HANDLE, flightrec.RPC_CLIENT):
+                track = "rpc" if t == flightrec.RPC_HANDLE else "rpc_client"
+                out.span(r["tag"] or r["type_name"], ts - r["a"], r["a"],
+                         track=track, pid=pid, ok=r["b"], seq=r["seq"])
+            elif t == flightrec.RPC_OUT:
+                out.instant(r["tag"] or "rpc_out", ts, track="rpc_out",
+                            pid=pid, req_id=r["a"], bytes=r["b"])
+            elif t == flightrec.WAL_APPEND:
+                out.counter("wal_appended", ts, {"seq": r["a"]}, pid=pid,
+                            track="wal")
+            elif t == flightrec.WAL_FSYNC:
+                out.counter("wal_synced", ts, {"seq": r["a"]}, pid=pid,
+                            track="wal")
+            elif t in (flightrec.STATE, flightrec.TICK):
+                out.counter("commits_total", ts,
+                            {"commits": r["a"] if t == flightrec.STATE
+                             else r["c"]}, pid=pid, track="engine")
+            elif t == flightrec.COMMIT:
+                out.instant("commit", ts, track="commit", pid=pid,
+                            group=r["code"], client=r["a"], cmd=r["b"],
+                            rid=r["tag"])
+            elif t == flightrec.CHAOS:
+                out.instant(f"chaos:{r['tag']}", ts, track="chaos",
+                            pid=pid, kind=r["code"])
+            elif t == flightrec.ROLE:
+                out.instant(f"role:peer{r['code']}", ts, track="raft",
+                            pid=pid, role=r["a"], term=r["b"],
+                            commit=r["c"])
+            else:  # NODE_CLOSE / MARK / future types
+                out.instant(r["type_name"], ts, track="marks", pid=pid,
+                            tag=r["tag"])
+    return out
+
+
+def rid_events(
+    bundle: Dict[str, Any], rid: str,
+) -> List[Tuple[str, Record]]:
+    """Every ring record tagged with ``rid`` (the request's commit
+    trail across processes), as ``(ring label, record)`` in seq order
+    per ring."""
+    hits: List[Tuple[str, Record]] = []
+    for ring in bundle["rings"]:
+        label = ring["name"] or f"pid{ring['pid']}"
+        for r in ring["records"]:
+            if r["tag"] == rid:
+                hits.append((label, r))
+    return hits
+
+
+# -- report ----------------------------------------------------------------
+
+_ROLE_NAMES = {0: "follower", 1: "candidate", 2: "leader"}
+
+
+def _fmt_commit(r: Record) -> str:
+    # Client ids are unsigned 64-bit on the wire; the ring stores the
+    # low 64 bits two's-complement (flightrec._i64) — undo that here.
+    client = r["a"] & 0xFFFFFFFFFFFFFFFF
+    return (
+        f"group {r['code']} client {client:#x} cmd {r['b']}"
+        + (f" rid {r['tag']}" if r["tag"] else "")
+    )
+
+
+def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
+    manifest = bundle.get("manifest") or {}
+    lines: List[str] = []
+    add = lines.append
+    add("=" * 72)
+    add(f"POSTMORTEM  {bundle['dir']}")
+    if manifest.get("reason"):
+        add(f"reason: {manifest['reason']}")
+    if manifest.get("addrs"):
+        add(
+            f"fleet: {len(manifest['addrs'])} process(es), "
+            f"{len(bundle['rings'])} ring(s), "
+            f"{len(manifest.get('unreachable') or [])} unreachable at "
+            f"collection"
+        )
+    add("=" * 72)
+
+    fa = analysis["first_anomaly"]
+    if fa is not None:
+        add("")
+        add("FIRST ANOMALY")
+        mark = "" if fa["aligned"] else " (unaligned clock)"
+        add(f"  t={fa['ts']:.0f}us{mark}  [{fa['proc']}]  {fa['kind']}")
+        add(f"  {fa['detail']}")
+    else:
+        add("")
+        add("no anomalies detected (all rings closed cleanly, no fsync "
+            "gaps, no chaos bursts)")
+
+    add("")
+    add("PROCESSES")
+    for p in analysis["procs"]:
+        death = "clean close" if p["clean_close"] else "UNCLEAN DEATH"
+        add(f"  {p['label']}  (pid {p['pid']})  — {death}")
+        add(
+            f"    ring: {p['records']} intact record(s)"
+            f" / {p['slots']} slots, {p['torn']} torn"
+            + ("" if p["aligned"] else ", clock unaligned")
+        )
+        if "last_event" in p:
+            le = p["last_event"]
+            add(f"    last event: {le['type_name']} seq {le['seq']}")
+        if "last_commit" in p:
+            add(f"    last commit: {_fmt_commit(p['last_commit'])}")
+        if "wal" in p:
+            w = p["wal"]
+            gap = (
+                f"  ** {w['gap']} append(s) NOT fsync'd **"
+                if w["gap"] > 0 else ""
+            )
+            add(f"    wal: appended seq {w['appended']}, "
+                f"synced {w['synced']}{gap}")
+        for peer, r in (p.get("roles") or {}).items():
+            add(
+                f"    raft peer {peer}: "
+                f"{_ROLE_NAMES.get(r['role'], r['role'])} "
+                f"term {r['term']} commit {r['commit']}"
+            )
+        for path_tag, b in (p.get("chaos") or {}).items():
+            add(
+                f"    chaos '{path_tag}': {b['total']} fault(s), "
+                f"max burst {b['max_burst']}/"
+                f"{BURST_WINDOW_US / 1e6:.0f}s"
+            )
+
+    if analysis["lag"]:
+        add("")
+        add("COMMIT/APPLY AT FINAL SCRAPE")
+        for addr, d in sorted(analysis["lag"].items()):
+            if d.get("missing"):
+                add(f"  {addr}: MISSING (dead at collection, "
+                    f"pid {d.get('pid')})")
+            else:
+                add(
+                    f"  {addr}: max lag {d['max_lag']} "
+                    f"(group {d['group']}: commit {d['commit']}, "
+                    f"applied {d['applied']})"
+                )
+
+    if analysis["anomalies"]:
+        add("")
+        add("ANOMALY TIMELINE (host-clock us)")
+        for a in analysis["anomalies"]:
+            mark = "" if a["aligned"] else " ~"
+            add(f"  t={a['ts']:>16.0f}{mark}  [{a['proc']}] "
+                f"{a['kind']}: {a['detail']}")
+
+    if bundle["skipped"]:
+        add("")
+        add("SKIPPED INPUTS")
+        for s in bundle["skipped"]:
+            add(f"  {s}")
+    add("")
+    return "\n".join(lines)
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m multiraft_tpu.analysis.postmortem",
+        description="Flight-recorder postmortem doctor",
+    )
+    ap.add_argument("bundle", help="bundle dir, rings dir, or .ring file")
+    ap.add_argument(
+        "--trace-out", default=None,
+        help="Perfetto trace path (default <bundle>/flight_trace.json.gz;"
+             " 'none' to skip)",
+    )
+    ap.add_argument(
+        "--rid", default=None,
+        help="also print every ring record tagged with this request id",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the analysis as JSON instead of the text report",
+    )
+    ns = ap.parse_args(argv)
+
+    if not os.path.exists(ns.bundle):
+        print(f"postmortem: no such bundle: {ns.bundle}", file=sys.stderr)
+        return 2
+    bundle = load_bundle(ns.bundle)
+    if not bundle["rings"] and not bundle["snapshots"]:
+        print(
+            f"postmortem: {ns.bundle}: no readable rings or snapshots"
+            + (f" ({'; '.join(bundle['skipped'])})"
+               if bundle["skipped"] else ""),
+            file=sys.stderr,
+        )
+        return 2
+    analysis = analyze(bundle)
+
+    if ns.json:
+        print(json.dumps(analysis, indent=2, sort_keys=True, default=str))
+    else:
+        report = build_report(bundle, analysis)
+        print(report)
+        if os.path.isdir(bundle["dir"]):
+            try:
+                with open(os.path.join(bundle["dir"], "report.txt"),
+                          "w") as f:
+                    f.write(report)
+            except OSError:
+                pass
+
+    if ns.rid:
+        hits = rid_events(bundle, ns.rid)
+        print(f"rid {ns.rid}: {len(hits)} record(s)")
+        for label, r in hits:
+            print(
+                f"  [{label}] seq {r['seq']} {r['type_name']} "
+                f"code={r['code']} a={r['a']} b={r['b']} ts={r['ts']:.0f}"
+            )
+
+    if ns.trace_out != "none" and bundle["rings"]:
+        trace_path = ns.trace_out or os.path.join(
+            bundle["dir"], "flight_trace.json.gz"
+        )
+        try:
+            rings_to_trace(bundle).save(trace_path)
+            print(f"perfetto trace: {trace_path}", file=sys.stderr)
+        except OSError as exc:  # pragma: no cover - fs full etc.
+            print(f"postmortem: trace write failed: {exc}",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

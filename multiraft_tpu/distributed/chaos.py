@@ -46,6 +46,8 @@ import threading
 from collections import defaultdict
 from typing import Any, Dict, Optional, Tuple
 
+from . import flightrec
+
 __all__ = ["ChaosRule", "ChaosState", "ChaosControl", "install_chaos"]
 
 # Decision verbs returned by ChaosState.decide_*: the frame proceeds,
@@ -138,6 +140,10 @@ class ChaosState:
         # Optional mirror into the node's scrapeable registry (wired by
         # install_chaos when the node carries an obs plane).
         self.metrics: Optional[Any] = None
+        # Crash-surviving record of applied faults (flightrec ring):
+        # the postmortem doctor correlates drop/delay bursts with the
+        # anomalies they caused even when this process dies next.
+        self.frec: Optional[Any] = None
 
     # -- decisions ---------------------------------------------------------
 
@@ -145,6 +151,12 @@ class ChaosState:
         self.hits[path][kind] += 1
         if self.metrics is not None:
             self.metrics.inc(f"chaos.{kind}.{path}")
+        if self.frec is not None:
+            self.frec.record(
+                flightrec.CHAOS,
+                code=flightrec.CHAOS_KIND_CODES.get(kind, 0),
+                a=1, tag=path,
+            )
 
     def _decide(self, rule: Optional[ChaosRule], path: str = "?") -> Any:
         if rule is None:
@@ -273,6 +285,7 @@ def install_chaos(node: Any, seed: int = 0) -> ChaosState:
         # Applied faults surface in Obs.snapshot alongside the RPC
         # counters (chaos.<kind>.<path> — the per-peer hit export).
         state.metrics = obs.metrics
+    state.frec = getattr(node, "_frec", None)
     node.add_service("Chaos", ChaosControl(node, state))
     node.chaos = state
     return state

@@ -42,6 +42,7 @@ from .engine_durability import (
     demote_unsynced_rows,
     replay_kv_wal,
 )
+from . import flightrec
 from .engine_wire import (
     _OPCODE,
     _OPNAME,
@@ -115,6 +116,13 @@ class EngineKVService:
         # record; handlers gate their ack on it being fsynced.  Pruned
         # once synced (absence = already durable).
         self._write_seqs: dict = {}
+        # Black box: tick boundaries + consensus frontier transitions
+        # land in the crash-surviving ring (flightrec.py).  The
+        # frontier triple is only recorded when it CHANGES — a quiet
+        # pump loop writes one TICK record per pump and nothing else.
+        self._frec = flightrec.get_recorder()
+        self._pumps = 0
+        self._last_frontier = (-1, -1, -1)
         if durability is not None:
             # WAL at APPLY time (commit order): evict-and-resubmit can
             # commit ops in a different order than submission, and
@@ -164,8 +172,32 @@ class EngineKVService:
             flush()
         t0 = time.perf_counter()
         self.kv.pump(self._ticks)
+        dt = time.perf_counter() - t0
         self.m.inc("pump.count")
-        self.m.observe("pump.wall_s", time.perf_counter() - t0)
+        self.m.observe("pump.wall_s", dt)
+        fr = self._frec
+        if fr is not None:
+            # Tick boundary + (on change only) the consensus frontier.
+            # Everything here is host-side bookkeeping the pump already
+            # computed — no device readback is added.
+            self._pumps += 1
+            d = self.kv.driver
+            commits = int(d.commits_total)
+            fr.record(
+                flightrec.TICK, a=self._pumps, b=int(dt * 1e6), c=commits
+            )
+            lm = getattr(d, "last_metrics", None) or {}
+            frontier = (
+                commits,
+                int(lm.get("leaders", -1)),
+                int(lm.get("max_term", -1)),
+            )
+            if frontier != self._last_frontier:
+                self._last_frontier = frontier
+                fr.record(
+                    flightrec.STATE, a=frontier[0], b=frontier[1],
+                    c=frontier[2],
+                )
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
             if self._write_seqs:
@@ -418,6 +450,18 @@ class EngineKVService:
                     self.m.observe(
                         "kv.command_s", self.sched.now - t_start
                     )
+                    # getattr: stub handlers built via __new__ (tests)
+                    # carry no recorder.
+                    _fr = getattr(self, "_frec", None)
+                    if _fr is not None:
+                        # Last-committed evidence for the postmortem:
+                        # survives a SIGKILL that the tracer's commit
+                        # instant (below) would die with.
+                        _fr.record(
+                            flightrec.COMMIT, code=g,
+                            a=args.client_id, b=args.command_id,
+                            tag=rid or "",
+                        )
                     if rid is not None:
                         # The engine-side leg of the request's journey:
                         # commit instant under the same id the clerk

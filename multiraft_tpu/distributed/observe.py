@@ -109,7 +109,43 @@ class ObsControl:
         chaos = getattr(self._node, "chaos", None)
         if chaos is not None:
             out["chaos"] = chaos.snapshot()
+        groups = self.groups()
+        if groups is not None:
+            out["groups"] = groups
         return out
+
+    def groups(self, args: Any = None) -> Optional[Dict[str, Any]]:
+        """Per-raft-group introspection (columnar, one entry per group):
+        leader replica (−1 = none), max term, commit index, applied
+        index, log length above the snapshot base, and last snapshot
+        index.  ``None`` on nodes without an engine service (pure
+        clients, sim-backend servers).  The postmortem doctor uses the
+        commit/applied columns to compute apply lag at time of death;
+        folded into :meth:`snapshot` so every scrape carries it."""
+        svc = getattr(self._node, "engine_service", None)
+        driver = getattr(getattr(svc, "kv", None), "driver", None)
+        state = getattr(driver, "state", None)
+        if state is None:
+            return None
+        # numpy/engine imports stay local: pure-client nodes must not
+        # pull the jax stack in just to serve Obs.ping.
+        import numpy as np
+
+        from ..engine.core import LEADER
+
+        role = np.asarray(state.role)
+        alive = np.asarray(state.alive).astype(bool)
+        lead = (role == LEADER) & alive
+        leader = np.where(lead.any(axis=1), lead.argmax(axis=1), -1)
+        return {
+            "G": int(role.shape[0]),
+            "leader": leader.tolist(),
+            "term": np.asarray(state.term).max(axis=1).tolist(),
+            "commit": np.asarray(state.commit).max(axis=1).tolist(),
+            "applied": np.asarray(state.applied).max(axis=1).tolist(),
+            "log_len": np.asarray(state.log_len).max(axis=1).tolist(),
+            "snap_index": np.asarray(state.base).max(axis=1).tolist(),
+        }
 
     def trace(self, args: Any = None) -> Dict[str, Any]:
         obs = self._node.obs

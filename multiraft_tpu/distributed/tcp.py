@@ -70,6 +70,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..sim.scheduler import Future
 from ..transport import codec
+from . import flightrec
 from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
 from .observe import Observability, install_obs, is_control
 from .realtime import IoScheduler
@@ -171,6 +172,11 @@ class RpcNode:
         self.obs.node = self
         self._cur_trace: Optional[str] = None
         install_obs(self)
+        # Crash-surviving black box (flightrec.py): fixed-width event
+        # records in an mmap ring, shared process-wide, env-gated
+        # (MRT_FLIGHTREC_DIR).  None = disabled = zero hot-path cost
+        # beyond one `is None` check per frame.
+        self._frec = flightrec.get_recorder(name=name or "")
         # MRT_TRACE_DIR=<dir>: save the span buffer on close().  Engine
         # servers additionally point their driver's tick spans at the
         # same tracer (via ``self.tracer``), so one timeline shows RPC
@@ -335,6 +341,11 @@ class RpcNode:
             return
         m.inc("rpc.frames_out")
         m.inc("rpc.bytes_out", nbytes)
+        fr = self._frec
+        if fr is not None and not is_control(svc_meth):
+            fr.record(
+                flightrec.RPC_OUT, a=req_id, b=nbytes, tag=svc_meth
+            )
 
     def _on_event(self, ev: Tuple[int, int, bytes]) -> None:
         # Runs on the scheduler loop (the IO reactor thread).
@@ -425,6 +436,12 @@ class RpcNode:
             _, fut, svc_meth, t0, trace_id = entry
             dt = time.perf_counter() - t0
             self.obs.metrics.observe("rpc.client.call_s", dt)
+            fr = self._frec
+            if fr is not None and not is_control(svc_meth):
+                fr.record(
+                    flightrec.RPC_CLIENT, a=int(dt * 1e6),
+                    b=int(value is not None), tag=svc_meth,
+                )
             if trace_id is not None:
                 # Caller-side leg of the cross-process span pair.
                 self.obs.tracer.span(
@@ -475,9 +492,16 @@ class RpcNode:
         # run.  The untraced hot path is a counter bump + one observe.
         want_span = trace_id is not None or self._trace_all
 
+        frec = self._frec
+
         def _done(conn_, req_id_, value):
             dt = time.perf_counter() - t0
             obs.metrics.observe("rpc.handle_s", dt)
+            if frec is not None and not is_control(svc_meth):
+                frec.record(
+                    flightrec.RPC_HANDLE, a=int(dt * 1e6),
+                    b=int(value is not None), tag=svc_meth,
+                )
             if want_span:
                 sargs: Dict[str, Any] = {
                     "outcome": "ok" if value is not None else "none"
@@ -664,6 +688,12 @@ class RpcNode:
         self._closed = True
         self.sched.stop()
         self._tr.close()
+        if self._frec is not None:
+            # Clean-shutdown marker: its absence as the ring's last
+            # record is how the postmortem doctor tells an unclean
+            # death from an orderly exit.  The shared recorder itself
+            # stays open (other nodes in this process still write).
+            self._frec.record(flightrec.NODE_CLOSE, tag=self.obs.name)
         if self.tracer is not None and self._trace_path:
             try:
                 self.tracer.save(self._trace_path)

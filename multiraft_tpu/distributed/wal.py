@@ -41,6 +41,7 @@ import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from ..utils.metrics import Metrics
+from . import flightrec
 
 __all__ = ["WriteAheadLog"]
 
@@ -89,6 +90,11 @@ class WriteAheadLog:
         # and wedge a quiet server's ack waits forever).
         self.appended = 0  # records appended by this incarnation
         self.synced = 0    # records known durable
+        # Black-box evidence of durability progress: append seq and
+        # fsync frontier land in the crash-surviving ring, so a
+        # SIGKILL'd process still shows how far its acks were covered
+        # (the doctor's fsync-gap analysis).  None when disabled.
+        self._frec = flightrec.get_recorder()
 
     def _scan(self) -> Tuple[Optional[int], List[bytes]]:
         """One streamed pass: byte length of the intact record prefix
@@ -166,6 +172,10 @@ class WriteAheadLog:
         m = self.metrics
         m.inc("wal.appends")
         m.inc("wal.bytes", _HEADER.size + len(body))
+        if self._frec is not None:
+            self._frec.record(
+                flightrec.WAL_APPEND, a=self.appended, b=len(body)
+            )
         return self.appended
 
     def _write_pending(self) -> None:
@@ -189,10 +199,15 @@ class WriteAheadLog:
         self._f.flush()
         if self._fsync:
             os.fsync(self._f.fileno())
+        dt = time.perf_counter() - t0
         m = self.metrics
         m.inc("wal.fsyncs")
-        m.observe("wal.fsync_s", time.perf_counter() - t0)
+        m.observe("wal.fsync_s", dt)
         self.synced = self.appended
+        if self._frec is not None:
+            self._frec.record(
+                flightrec.WAL_FSYNC, a=self.synced, b=int(dt * 1e6)
+            )
 
     # -- rotation (after a successful checkpoint) -------------------------
 

@@ -2,6 +2,7 @@
 ctrler_harness.py), the real-socket nemesis (nemesis.py), and the
 fleet observability scraper (observe.py)."""
 
+from .bundle import collect_bundle
 from .nemesis import (
     ChaosClient,
     Nemesis,
@@ -16,6 +17,7 @@ __all__ = [
     "FleetObserver",
     "Nemesis",
     "NemesisVerificationError",
+    "collect_bundle",
     "make_schedule",
     "run_clerk_load",
 ]

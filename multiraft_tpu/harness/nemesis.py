@@ -40,6 +40,7 @@ converge — pick per-op timeouts longer than the longest window.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -426,10 +427,34 @@ class Nemesis:
             elif w["kind"] in require_hits and w["hits"] < 1:
                 bad.append(f"{tag} — acked but zero faults applied")
         if bad:
-            raise NemesisVerificationError(
+            reason = (
                 "scheduled fault windows did not fire:\n  "
                 + "\n  ".join(bad)
             )
+            self._auto_bundle(reason)
+            raise NemesisVerificationError(reason)
+
+    def _auto_bundle(self, reason: str) -> Optional[str]:
+        """Collect a postmortem bundle when ``MRT_POSTMORTEM_DIR`` is
+        set (timestamped subdirectory).  Verification failures are
+        exactly the runs worth a black-box readout, and by the time a
+        human looks, the fleet is gone — so collection is automatic
+        and best-effort (never masks the verification error)."""
+        root = os.environ.get("MRT_POSTMORTEM_DIR")
+        if not root:
+            return None
+        from .bundle import collect_bundle  # local: avoid import cycle
+
+        out = os.path.join(
+            root, f"nemesis-{os.getpid()}-{int(time.time() * 1000)}"
+        )
+        try:
+            return collect_bundle(
+                out, addrs=self.addrs, reason=reason,
+                windows=self.windows, t0_us=self.t0_us,
+            )
+        except Exception:  # pragma: no cover - best-effort by design
+            return None
 
     # -- execution ---------------------------------------------------------
 

@@ -52,8 +52,9 @@ class FleetObserver:
 
     The observer's own node carries no chaos, and ``Obs.*`` frames are
     control-exempt on the targets, so scrapes work mid-fault — a
-    CRASHED process is simply unreachable and is skipped (recorded in
-    :attr:`unreachable`)."""
+    CRASHED process is unreachable and shows up as an explicit
+    ``missing`` marker (and in :attr:`unreachable`), never as a
+    silently shorter fleet."""
 
     def __init__(self, addrs: Sequence[Addr]) -> None:
         self.node = RpcNode()
@@ -62,6 +63,10 @@ class FleetObserver:
         self.ends = {a: self.node.client_end(*a) for a in self.addrs}
         # addr -> best (min-RTT) clock offset estimate so far, µs.
         self.offsets: Dict[Addr, float] = {}
+        # addr -> (pid, name) from the last successful snapshot — kept
+        # so a process that later dies can still be identified in
+        # postmortem bundles (its ring file is keyed by pid).
+        self.idents: Dict[Addr, Tuple[int, str]] = {}
         self.unreachable: List[Addr] = []
 
     # -- raw scrape verbs --------------------------------------------------
@@ -86,12 +91,26 @@ class FleetObserver:
         return self.call(addr, "snapshot")
 
     def snapshot_all(self) -> Dict[str, Dict[str, Any]]:
-        """Scrape every reachable process: ``{"host:port": snapshot}``."""
+        """Scrape the whole fleet: ``{"host:port": snapshot}``.
+
+        A process that died (or was partitioned from the scraper) gets
+        an explicit ``{"missing": True, ...}`` marker instead of being
+        silently absent — a postmortem that omits the dead process is
+        hiding exactly the row that matters.  The marker carries the
+        pid/name remembered from the last successful scrape, so the
+        doctor can still pair the dead address with its on-disk flight
+        ring."""
         out: Dict[str, Dict[str, Any]] = {}
         for a in self.addrs:
+            key = f"{a[0]}:{a[1]}"
             snap = self.snapshot(a)
             if snap is not None:
-                out[f"{a[0]}:{a[1]}"] = snap
+                self.idents[a] = (int(snap.get("pid", -1)),
+                                  str(snap.get("name", "")))
+                out[key] = snap
+            else:
+                pid, name = self.idents.get(a, (-1, ""))
+                out[key] = {"missing": True, "pid": pid, "name": name}
         return out
 
     def drain_trace(self, addr: Addr) -> Optional[Dict[str, Any]]:
@@ -144,24 +163,27 @@ class FleetObserver:
           ``Observability.name``, events shifted by the min-RTT clock
           offset.
 
-        Unreachable processes are skipped and listed in
-        :attr:`unreachable` — a merged trace must not silently present
-        a partial fleet as the whole one."""
-        parts: List[Tuple[Addr, float, Dict[str, Any]]] = []
+        Unreachable processes are listed in :attr:`unreachable` AND get
+        their own (empty) process row in the trace, labelled
+        ``"MISSING"`` with an instant marking when the scrape failed —
+        a merged trace must not silently present a partial fleet as
+        the whole one."""
+        parts: List[Tuple[Addr, float, Optional[Dict[str, Any]]]] = []
         self.unreachable = []
         for a in self.addrs:
             off = self.clock_offset_us(a)
             part = self.drain_trace(a) if off is not None else None
-            if part is None or off is None:
+            if part is None:
+                # Dead or partitioned: keep its slot in the merge (a
+                # cached offset from an earlier scrape may survive).
                 self.unreachable.append(a)
-                continue
-            parts.append((a, off, part))
+            parts.append((a, off if off is not None else 0.0, part))
 
         n_events = (
             len(local_events)
-            + sum(len(p["events"]) for _, _, p in parts)
+            + sum(len(p["events"]) for _, _, p in parts if p is not None)
             + 2 * (len(windows) + len(schedule))
-            + len(parts)
+            + 2 * len(parts)
             + 64
         )
         out = Tracer(max_events=n_events)
@@ -173,6 +195,14 @@ class FleetObserver:
 
         for i, (a, off, part) in enumerate(parts):
             pid = i + 1
+            if part is None:
+                label = self.idents.get(a, (-1, "?"))[1] or "?"
+                out.process_name(pid, f"MISSING {label} @ {a[0]}:{a[1]}")
+                out.instant(
+                    "process_missing", now_us(),
+                    track="obs", pid=pid, addr=f"{a[0]}:{a[1]}",
+                )
+                continue
             out.process_name(pid, f"{part.get('name')} @ {a[0]}:{a[1]}")
             for ev in part["events"]:
                 ev = dict(ev)

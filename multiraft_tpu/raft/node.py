@@ -54,6 +54,7 @@ from .messages import (
     Role,
 )
 from .persister import Persister
+from ..distributed import flightrec
 from ..utils.metrics import trace
 
 __all__ = ["RaftNode", "HEARTBEAT_INTERVAL", "ELECTION_TIMEOUT"]
@@ -116,6 +117,11 @@ class RaftNode:
 
         self._election_timer = None
         self._heartbeat_timer = None
+
+        # Black box (flightrec.py): role/term/commit transitions in the
+        # crash-surviving ring.  None when MRT_FLIGHTREC_DIR is unset —
+        # the sim suites pay one `is None` check per transition.
+        self._frec = flightrec.get_recorder()
 
         self._read_persist()
         self.commit_index = self.log.base
@@ -296,11 +302,22 @@ class RaftNode:
     # Election (reference: raft/raft_election.go)
     # ------------------------------------------------------------------
 
+    def _record_role(self) -> None:
+        """Flight-recorder hook: one fixed-width record per role/term
+        transition (no-op when recording is disabled)."""
+        fr = self._frec
+        if fr is not None:
+            fr.record(
+                flightrec.ROLE, code=self.me, a=int(self.role),
+                b=self.current_term, c=self.commit_index,
+            )
+
     def _start_election(self) -> None:
         """(reference: raft/raft_election.go:4-51)"""
         self.role = Role.CANDIDATE
         self.current_term += 1
         self.voted_for = self.me
+        self._record_role()
         self._persist()
         term = self.current_term
         granted = [1]  # own vote; list for closure mutation
@@ -347,6 +364,7 @@ class RaftNode:
         """(reference: raft/raft_election.go:30-41)"""
         trace("raft %d: leader at term %d", self.me, self.current_term)
         self.role = Role.LEADER
+        self._record_role()
         last = self.log.last_index
         for p in range(len(self.peers)):
             self.next_index[p] = last + 1
@@ -360,10 +378,13 @@ class RaftNode:
         if changed and self.role is not Role.FOLLOWER:
             trace("raft %d: step down %d -> %d", self.me,
                   self.current_term, term)
+        was_follower = self.role is Role.FOLLOWER
         self.current_term = max(self.current_term, term)
         if changed:
             self.voted_for = None
         self.role = Role.FOLLOWER
+        if changed or not was_follower:
+            self._record_role()
         if self._heartbeat_timer:
             self._heartbeat_timer.cancel()
         if changed:

@@ -144,8 +144,16 @@ class Tracer:
 
     @staticmethod
     def load(path: str) -> Dict[str, Any]:
-        """Round-trip loader for :meth:`save` output (either transport)."""
-        if path.endswith(".gz"):
+        """Round-trip loader for :meth:`save` output (either transport).
+
+        Transport is sniffed from the gzip magic bytes, not trusted
+        from the suffix — a ``.gz``-named file that is actually plain
+        JSON (or vice versa: a crash between rename and write) should
+        parse or fail on its CONTENT, with json/gzip's own diagnostic,
+        rather than on its name."""
+        with open(path, "rb") as fb:
+            head = fb.read(2)
+        if head == b"\x1f\x8b":
             with gzip.open(path, "rt", encoding="utf-8") as f:
                 return json.load(f)
         with open(path, "r") as f:

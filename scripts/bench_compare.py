@@ -1,0 +1,183 @@
+"""Compare a fresh bench.py result against the recorded trajectory.
+
+    python scripts/bench_compare.py FRESH.json [--threshold PCT]
+                                    [--history 'BENCH_r*.json'] [--quiet]
+
+``FRESH.json`` is either bench.py's summary object (the ``bench:
+summary {...}`` JSON: ``value`` commits/s, ``p99_commit_latency_ms``,
+``failover_p99_ms``, ...) or a round wrapper (``{"parsed": {...}}``,
+the ``BENCH_r*.json`` shape).  The history is every ``BENCH_r*.json``
+in the repo root (override with ``--history``).
+
+Prints one table row per tracked metric: the full round trajectory,
+the fresh value, and the delta against the LATEST round.  Exit status:
+
+* 0 — within ``--threshold`` (default 5%) of the latest round on every
+  metric present in both (direction-aware: commits/s regresses DOWN,
+  latency regresses UP; improvements never fail);
+* 1 — at least one metric regressed past the threshold;
+* 2 — the fresh result (or the entire history) was unreadable.
+
+Metrics missing on either side are reported as ``n/a`` and never fail
+the comparison — early rounds lack failover numbers (BENCH_r01 is a
+different headline metric entirely) and a CPU-only smoke run may lack
+everything but commits/s.  CI runs this as a NON-BLOCKING artifact
+step: the table lands in the job log and the exit code is recorded,
+but a perf regression alone does not veto a merge (the ±5% gate in the
+acceptance checklist is enforced on the benchmark host, where the
+numbers are not noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (key, label, higher_is_better)
+METRICS: List[Tuple[str, str, bool]] = [
+    ("value", "commits/s", True),
+    ("p99_commit_latency_ms", "p99 commit latency (ms)", False),
+    ("failover_p99_ms", "failover p99 (ms)", False),
+]
+
+
+def load_result(path: str) -> Dict[str, Any]:
+    """Load a bench result; unwrap the ``BENCH_r*`` round shape."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench result (top-level "
+                         f"{type(doc).__name__})")
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    return doc
+
+
+def load_history(pattern: str) -> List[Tuple[str, Dict[str, Any]]]:
+    """``[(round_name, parsed), ...]`` sorted by round number; rounds
+    that fail to parse are skipped (one corrupt round must not kill
+    the comparison)."""
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    for p in glob.glob(pattern):
+        try:
+            out.append((os.path.basename(p), load_result(p)))
+        except (OSError, ValueError):
+            print(f"bench_compare: skipping unreadable {p}",
+                  file=sys.stderr)
+    def round_no(item: Tuple[str, Dict[str, Any]]) -> Tuple[int, str]:
+        m = re.search(r"(\d+)", item[0])
+        return (int(m.group(1)) if m else 0, item[0])
+    out.sort(key=round_no)
+    return out
+
+
+def _get(doc: Dict[str, Any], key: str) -> Optional[float]:
+    v = doc.get(key)
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    return None
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if abs(v) >= 1e3:
+        return f"{v / 1e3:.1f}k"
+    return f"{v:.3g}"
+
+
+def compare(
+    fresh: Dict[str, Any],
+    history: List[Tuple[str, Dict[str, Any]]],
+    threshold_pct: float,
+) -> Tuple[List[str], List[str]]:
+    """Returns ``(table_lines, regressions)``; empty regressions means
+    every shared metric is within the threshold of the latest round."""
+    lines: List[str] = []
+    regressions: List[str] = []
+    latest_name, latest = history[-1] if history else ("(none)", {})
+    lines.append(
+        f"{'metric':28s} "
+        + " ".join(f"{name.replace('BENCH_', ''):>10s}"
+                   for name, _ in history)
+        + f" {'fresh':>10s} {'delta':>9s}"
+    )
+    for key, label, higher_better in METRICS:
+        fv = _get(fresh, key)
+        traj = [_get(doc, key) for _, doc in history]
+        lv = _get(latest, key)
+        if fv is None or lv is None:
+            delta_s = "n/a"
+        else:
+            delta = (fv - lv) / lv * 100.0 if lv else 0.0
+            delta_s = f"{delta:+.1f}%"
+            regressed = (-delta if higher_better else delta) > threshold_pct
+            if regressed:
+                regressions.append(
+                    f"{label}: {_fmt(fv)} vs {_fmt(lv)} in {latest_name} "
+                    f"({delta_s}, threshold {threshold_pct:.1f}%)"
+                )
+        lines.append(
+            f"{label:28s} "
+            + " ".join(f"{_fmt(v):>10s}" for v in traj)
+            + f" {_fmt(fv):>10s} {delta_s:>9s}"
+        )
+    return lines, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_compare")
+    ap.add_argument("fresh", help="fresh bench.py JSON result")
+    ap.add_argument(
+        "--threshold", type=float, default=5.0,
+        help="regression threshold in percent (default 5)",
+    )
+    ap.add_argument(
+        "--history", default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
+        help="glob of recorded rounds (default repo-root BENCH_r*.json)",
+    )
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only regressions")
+    ns = ap.parse_args(argv)
+
+    try:
+        fresh = load_result(ns.fresh)
+    except (OSError, ValueError) as exc:
+        print(f"bench_compare: cannot read fresh result: {exc}",
+              file=sys.stderr)
+        return 2
+    history = load_history(ns.history)
+    if not history:
+        print(
+            f"bench_compare: no readable history at {ns.history!r}; "
+            f"nothing to compare against", file=sys.stderr,
+        )
+        return 2
+
+    lines, regressions = compare(fresh, history, ns.threshold)
+    if not ns.quiet:
+        print("\n".join(lines))
+    if regressions:
+        print(
+            f"bench_compare: {len(regressions)} regression(s) past "
+            f"{ns.threshold:.1f}%:", file=sys.stderr,
+        )
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    latest_name = history[-1][0]
+    print(f"bench_compare: within {ns.threshold:.1f}% of {latest_name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

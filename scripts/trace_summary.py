@@ -38,8 +38,19 @@ def summarize(path: str, top: int = 10) -> Dict[str, Any]:
          "tracks": {"pid/tid": {"spans": n, "dur_us": total}},
          "top_spans": [(name, total_dur_us, count), ...]}
     """
+    if os.path.getsize(path) == 0:
+        raise ValueError("empty file (0 bytes)")
     doc = Tracer.load(path)
+    # Chrome traces come in two shapes: {"traceEvents": [...]} (what
+    # Tracer.save writes) and a bare event array (what other tools
+    # emit) — accept both; anything else is not a trace.
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict):
+        raise ValueError(f"not a Chrome trace (top-level {type(doc).__name__})")
     events = doc.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
     names: Dict[Any, str] = {}
     tracks: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"spans": 0, "dur_us": 0.0}
@@ -52,6 +63,8 @@ def summarize(path: str, top: int = 10) -> Dict[str, Any]:
         (doc.get("otherData") or {}).get("dropped_events", 0)
     )
     for ev in events:
+        if not isinstance(ev, dict):
+            continue  # foreign tools sometimes append raw strings
         ph = ev.get("ph")
         if ph == "M":
             if ev.get("name") == "process_name":

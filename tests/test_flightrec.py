@@ -1,0 +1,643 @@
+"""Flight-recorder + postmortem-pipeline tests: ring write/read round
+trips, wraparound, torn-tail recovery (the SIGKILL-at-any-byte
+invariant), env gating, the bundle collector, the postmortem doctor's
+analyses and CLI, bench_compare, trace hardening — and the tier-1
+integration test that SIGKILLs a live engine process mid-traffic and
+reads its last committed op back out of the black box."""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import pytest
+
+from multiraft_tpu.analysis import postmortem
+from multiraft_tpu.distributed import flightrec
+from multiraft_tpu.distributed.flightrec import (
+    HDR_SIZE,
+    REC_SIZE,
+    FlightRecorder,
+    read_ring,
+)
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.utils.trace import Tracer
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+# ---------------------------------------------------------------------------
+# Ring format: round trip, wraparound, torn-tail recovery
+# ---------------------------------------------------------------------------
+
+
+class TestRing:
+    def test_round_trip(self, tmp_path):
+        p = str(tmp_path / "a.ring")
+        rec = FlightRecorder(p, slots=16, name="unit")
+        rec.record(flightrec.COMMIT, code=3, a=7, b=41, tag="00000a.12")
+        rec.record(flightrec.WAL_APPEND, a=1, b=100)
+        rec.mark("phase-two")
+        rec.close()
+        rr = read_ring(p)
+        assert rr["name"] == "unit"
+        assert rr["pid"] == os.getpid()
+        assert rr["torn"] == 0
+        assert [r["seq"] for r in rr["records"]] == [1, 2, 3]
+        c = rr["records"][0]
+        assert (c["type_name"], c["code"], c["a"], c["b"], c["tag"]) == (
+            "commit", 3, 7, 41, "00000a.12"
+        )
+        assert rr["records"][2]["tag"] == "phase-two"
+        assert not rr["clean_close"]
+
+    def test_clean_close_marker(self, tmp_path):
+        p = str(tmp_path / "c.ring")
+        rec = FlightRecorder(p, slots=8)
+        rec.record(flightrec.STATE, a=5)
+        rec.record(flightrec.NODE_CLOSE, tag="srv")
+        rec.close()
+        assert read_ring(p)["clean_close"]
+
+    def test_wraparound_keeps_newest_slots(self, tmp_path):
+        p = str(tmp_path / "w.ring")
+        rec = FlightRecorder(p, slots=8)
+        for i in range(1, 21):  # 20 records into 8 slots
+            rec.record(flightrec.TICK, a=i)
+        rec.close()
+        rr = read_ring(p)
+        assert [r["seq"] for r in rr["records"]] == list(range(13, 21))
+        assert [r["a"] for r in rr["records"]] == list(range(13, 21))
+        assert rr["torn"] == 0
+
+    def test_torn_tail_replays_from_oldest_intact(self, tmp_path):
+        # SIGKILL mid-write tears exactly the slot being written; the
+        # reader must skip it and replay everything else.
+        p = str(tmp_path / "t.ring")
+        rec = FlightRecorder(p, slots=8)
+        for i in range(1, 7):
+            rec.record(flightrec.TICK, a=i)
+        rec.close()
+        with open(p, "r+b") as f:  # corrupt a byte mid-payload of seq 6
+            f.seek(HDR_SIZE + 5 * REC_SIZE + 30)
+            f.write(b"\xff")
+        rr = read_ring(p)
+        assert rr["torn"] == 1
+        assert [r["seq"] for r in rr["records"]] == [1, 2, 3, 4, 5]
+
+    def test_torn_byte_at_any_offset_never_crashes_reader(self, tmp_path):
+        # The acceptance invariant, brute-forced at small scale: flip a
+        # byte at EVERY offset of one record; the reader always returns
+        # the other records intact.
+        p = str(tmp_path / "b.ring")
+        rec = FlightRecorder(p, slots=4)
+        for i in range(1, 4):
+            rec.record(flightrec.TICK, a=i)
+        rec.close()
+        with open(p, "rb") as f:
+            pristine = f.read()
+        off0 = HDR_SIZE + 1 * REC_SIZE  # seq 2's slot
+        for k in range(REC_SIZE):
+            raw = bytearray(pristine)
+            raw[off0 + k] ^= 0xA5
+            with open(p, "wb") as f:
+                f.write(raw)
+            rr = read_ring(p)
+            seqs = [r["seq"] for r in rr["records"]]
+            assert 1 in seqs and 3 in seqs
+            assert rr["torn"] <= 1
+
+    def test_truncated_file_reads_prefix(self, tmp_path):
+        p = str(tmp_path / "tr.ring")
+        rec = FlightRecorder(p, slots=8)
+        for i in range(1, 5):
+            rec.record(flightrec.TICK, a=i)
+        rec.close()
+        # Truncate mid-slot-3 (e.g. the copy raced the crash).
+        os.truncate(p, HDR_SIZE + 2 * REC_SIZE + 10)
+        rr = read_ring(p)
+        assert [r["seq"] for r in rr["records"]] == [1, 2]
+
+    def test_not_a_ring_raises(self, tmp_path):
+        p = tmp_path / "junk.ring"
+        p.write_bytes(b"\x00" * (HDR_SIZE + REC_SIZE))
+        with pytest.raises(ValueError, match="magic"):
+            read_ring(str(p))
+        p.write_bytes(b"hi")
+        with pytest.raises(ValueError, match="too short"):
+            read_ring(str(p))
+
+    def test_unsigned_64bit_values_never_kill_the_writer(self, tmp_path):
+        # Client ids are full unsigned 64-bit (utils/ids.py nonce<<24);
+        # the recorder must clamp, not raise struct.error into the RPC
+        # handler that called it.
+        p = str(tmp_path / "u.ring")
+        rec = FlightRecorder(p, slots=4)
+        big = (1 << 64) - 5
+        rec.record(flightrec.COMMIT, code=1, a=big, b=3, tag="x.1")
+        rec.record(flightrec.MARK, a="not-an-int", tag="dropped")
+        rec.record(flightrec.MARK, tag="survives")  # writer still alive
+        rec.close()
+        rr = read_ring(p)
+        assert rr["torn"] == 0
+        assert rr["records"][0]["a"] & 0xFFFFFFFFFFFFFFFF == big
+        tags = [r["tag"] for r in rr["records"]]
+        assert "survives" in tags and "dropped" not in tags
+
+    def test_record_layout_is_frozen(self):
+        # The doctor reads rings from OTHER processes (possibly other
+        # builds); the layout is a wire format and must not drift.
+        assert REC_SIZE == 72
+        assert struct.calcsize("<IIQdHHqqq20s") == REC_SIZE
+
+
+class TestGetRecorder:
+    @pytest.fixture
+    def frec_env(self, tmp_path, monkeypatch):
+        d = tmp_path / "frec"
+        d.mkdir()
+        monkeypatch.setenv("MRT_FLIGHTREC_DIR", str(d))
+        old = flightrec._proc_rec
+        flightrec._proc_rec = None
+        yield d
+        if flightrec._proc_rec is not None:
+            flightrec._proc_rec.close()
+        flightrec._proc_rec = old
+
+    def test_disabled_without_env(self, monkeypatch):
+        monkeypatch.delenv("MRT_FLIGHTREC_DIR", raising=False)
+        assert flightrec.get_recorder() is None
+
+    def test_singleton_per_process(self, frec_env):
+        a = flightrec.get_recorder(name="first")
+        b = flightrec.get_recorder(name="second")
+        assert a is b
+        assert a.path == str(frec_env / f"flight-{os.getpid()}.ring")
+        a.record(flightrec.MARK, tag="x")
+        a.flush()
+        rr = read_ring(a.path)
+        assert rr["name"] == "first"  # first caller names the ring
+        assert rr["records"][-1]["tag"] == "x"
+
+
+# ---------------------------------------------------------------------------
+# Doctor: analyses over synthetic rings, report, CLI
+# ---------------------------------------------------------------------------
+
+
+def _make_bundle(tmp_path):
+    """A two-process bundle: one clean closer, one unclean death with
+    an fsync gap and a chaos drop burst."""
+    bdir = tmp_path / "bundle"
+    rings = bdir / "rings"
+    rings.mkdir(parents=True)
+
+    dead = FlightRecorder(str(rings / "flight-1111.ring"), slots=64,
+                          name="engine-dead")
+    # Forge the header pid (offset 20 in <8sIIIId64s) so the ring
+    # pairs with the synthetic manifest idents below.
+    struct.pack_into("<I", dead._mm, 20, 1111)
+    dead.record(flightrec.ROLE, code=0, a=2, b=3, c=9)
+    for i in range(1, 8):
+        dead.record(flightrec.WAL_APPEND, a=i, b=64)
+        if i <= 5:
+            dead.record(flightrec.WAL_FSYNC, a=i, b=120)
+    dead.record(flightrec.COMMIT, code=2, a=55, b=7, tag="00dead.7")
+    for _ in range(6):
+        dead.record(flightrec.CHAOS,
+                    code=flightrec.CHAOS_KIND_CODES["drop"], a=1,
+                    tag="reply")
+    dead.close()  # no NODE_CLOSE record: unclean
+
+    live = FlightRecorder(str(rings / "flight-2222.ring"), slots=64,
+                          name="engine-live")
+    struct.pack_into("<I", live._mm, 20, 2222)
+    live.record(flightrec.WAL_APPEND, a=1, b=64)
+    live.record(flightrec.WAL_FSYNC, a=1, b=100)
+    live.record(flightrec.NODE_CLOSE, tag="engine-live")
+    live.close()
+
+    manifest = {
+        "reason": "unit-test failure",
+        "host_pid": os.getpid(),
+        "addrs": ["127.0.0.1:1", "127.0.0.1:2"],
+        "offsets_us": {"127.0.0.1:1": 10.0, "127.0.0.1:2": -5.0},
+        "idents": {
+            "127.0.0.1:1": {"pid": 1111, "name": "engine-dead"},
+            "127.0.0.1:2": {"pid": 2222, "name": "engine-live"},
+        },
+        "unreachable": ["127.0.0.1:1"],
+        "rings": ["flight-1111.ring", "flight-2222.ring"],
+    }
+    (bdir / "manifest.json").write_text(json.dumps(manifest))
+    snapshots = {
+        "127.0.0.1:1": {"missing": True, "pid": 1111,
+                        "name": "engine-dead"},
+        "127.0.0.1:2": {
+            "name": "engine-live", "pid": 2222, "metrics": {},
+            "groups": {"G": 3, "leader": [0, 1, -1],
+                       "term": [3, 3, 2], "commit": [9, 4, 2],
+                       "applied": [9, 1, 2], "log_len": [9, 4, 2],
+                       "snap_index": [0, 0, 0]},
+        },
+    }
+    (bdir / "snapshots.json").write_text(json.dumps(snapshots))
+    return bdir
+
+
+class TestDoctor:
+    def test_analyze_finds_the_right_anomalies(self, tmp_path):
+        bundle = postmortem.load_bundle(str(_make_bundle(tmp_path)))
+        assert len(bundle["rings"]) == 2
+        analysis = postmortem.analyze(bundle)
+        kinds = {a["kind"] for a in analysis["anomalies"]}
+        assert "unclean_death" in kinds
+        assert "fsync_gap" in kinds
+        assert "chaos_burst" in kinds
+        assert analysis["first_anomaly"]["aligned"]
+
+        dead = next(p for p in analysis["procs"] if p["pid"] == 1111)
+        assert not dead["clean_close"]
+        assert dead["addr"] == "127.0.0.1:1"
+        assert dead["wal"] == {"appended": 7, "synced": 5, "gap": 2}
+        assert dead["last_commit"]["tag"] == "00dead.7"
+        assert dead["roles"][0] == {"role": 2, "term": 3, "commit": 9}
+        live = next(p for p in analysis["procs"] if p["pid"] == 2222)
+        assert live["clean_close"]
+
+        # Commit/apply lag from the final scrape's Obs.groups columns.
+        assert analysis["lag"]["127.0.0.1:2"]["max_lag"] == 3
+        assert analysis["lag"]["127.0.0.1:2"]["group"] == 1
+        assert analysis["lag"]["127.0.0.1:1"]["missing"]
+
+    def test_report_names_the_dead_process(self, tmp_path):
+        bundle = postmortem.load_bundle(str(_make_bundle(tmp_path)))
+        report = postmortem.build_report(bundle, postmortem.analyze(bundle))
+        assert "UNCLEAN DEATH" in report
+        assert "engine-dead @ 127.0.0.1:1" in report
+        assert "2 append(s) NOT fsync'd" in report
+        assert "rid 00dead.7" in report or "00dead.7" in report
+        assert "FIRST ANOMALY" in report
+        assert "MISSING" in report  # dead at collection time
+
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        bdir = _make_bundle(tmp_path)
+        rc = postmortem.main([str(bdir), "--rid", "00dead.7"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "FIRST ANOMALY" in out
+        assert "rid 00dead.7: 1 record(s)" in out
+        assert (bdir / "report.txt").exists()
+        assert (bdir / "flight_trace.json.gz").exists()
+        doc = Tracer.load(str(bdir / "flight_trace.json.gz"))
+        names = {
+            (e["args"] or {}).get("name")
+            for e in doc["traceEvents"] if e.get("ph") == "M"
+        }
+        assert any("engine-dead" in (n or "") for n in names)
+
+    def test_cli_on_bare_ring_and_bad_inputs(self, tmp_path, capsys):
+        bdir = _make_bundle(tmp_path)
+        ring = bdir / "rings" / "flight-1111.ring"
+        assert postmortem.main([str(ring), "--trace-out", "none"]) == 0
+        assert postmortem.main([str(tmp_path / "nope")]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert postmortem.main([str(empty)]) == 2
+
+    def test_corrupt_ring_is_skipped_not_fatal(self, tmp_path):
+        bdir = _make_bundle(tmp_path)
+        (bdir / "rings" / "flight-9.ring").write_bytes(b"garbage")
+        bundle = postmortem.load_bundle(str(bdir))
+        assert len(bundle["rings"]) == 2
+        assert any("flight-9.ring" in s for s in bundle["skipped"])
+
+
+# ---------------------------------------------------------------------------
+# Obs.groups (satellite: per-group introspection in every snapshot)
+# ---------------------------------------------------------------------------
+
+
+class TestObsGroups:
+    def _node_with_state(self):
+        import types
+
+        import numpy as np
+
+        state = types.SimpleNamespace(
+            role=np.array([[2, 0, 0], [0, 0, 0]], dtype=np.int32),
+            alive=np.array([[True, True, True], [True, False, True]]),
+            term=np.array([[4, 4, 4], [2, 2, 2]], dtype=np.int32),
+            commit=np.array([[9, 9, 8], [3, 3, 3]], dtype=np.int32),
+            applied=np.array([[9, 8, 8], [1, 1, 1]], dtype=np.int32),
+            log_len=np.array([[9, 9, 9], [3, 3, 3]], dtype=np.int32),
+            base=np.array([[0, 0, 0], [0, 0, 0]], dtype=np.int32),
+        )
+        svc = types.SimpleNamespace(
+            kv=types.SimpleNamespace(
+                driver=types.SimpleNamespace(state=state)
+            )
+        )
+        return types.SimpleNamespace(engine_service=svc)
+
+    def test_groups_columns(self):
+        from multiraft_tpu.distributed.observe import ObsControl
+
+        g = ObsControl(self._node_with_state()).groups()
+        assert g["G"] == 2
+        assert g["leader"] == [0, -1]  # group 1 has no live leader
+        assert g["term"] == [4, 2]
+        assert g["commit"] == [9, 3]
+        assert g["applied"] == [9, 1]
+        assert g["log_len"] == [9, 3]
+        assert g["snap_index"] == [0, 0]
+
+    def test_none_without_engine(self):
+        import types
+
+        from multiraft_tpu.distributed.observe import ObsControl
+
+        assert ObsControl(types.SimpleNamespace()).groups() is None
+
+
+# ---------------------------------------------------------------------------
+# snapshot_all missing markers (satellite: degrade, don't omit)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.timeout_s(60)
+def test_snapshot_all_marks_dead_process_explicitly():
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    live = RpcNode(listen=True)
+    dying = RpcNode(listen=True)
+    obs = None
+    try:
+        addrs = [(live.host, live.port), (dying.host, dying.port)]
+        obs = FleetObserver(addrs)
+        first = obs.snapshot_all()
+        assert all(not s.get("missing") for s in first.values())
+        dead_key = f"{dying.host}:{dying.port}"
+        dead_pid = first[dead_key]["pid"]
+
+        dying.close()
+        second = obs.snapshot_all()
+        assert not second[f"{live.host}:{live.port}"].get("missing")
+        marker = second[dead_key]
+        assert marker["missing"] is True
+        # Ident remembered from the last successful scrape: the bundle
+        # can still pair the dead address with its flight ring.
+        assert marker["pid"] == dead_pid
+
+        merged = obs.merged_timeline()
+        names = [
+            (e["args"] or {}).get("name", "")
+            for e in merged.events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        ]
+        assert any(n.startswith("MISSING") and dead_key in n for n in names)
+    finally:
+        if obs is not None:
+            obs.close()
+        live.close()
+        dying.close()
+
+
+# ---------------------------------------------------------------------------
+# bench_compare (satellite: trajectory regression gate)
+# ---------------------------------------------------------------------------
+
+
+class TestBenchCompare:
+    def _write(self, path, **kv):
+        path.write_text(json.dumps(kv))
+        return str(path)
+
+    def _history(self, tmp_path):
+        self._write(tmp_path / "BENCH_r01.json",
+                    parsed={"value": 100e6})  # old round: no latency keys
+        self._write(tmp_path / "BENCH_r02.json",
+                    parsed={"value": 200e6, "p99_commit_latency_ms": 3.0,
+                            "failover_p99_ms": 12.0})
+        return str(tmp_path / "BENCH_r0*.json")
+
+    def test_within_threshold_passes(self, tmp_path):
+        from scripts.bench_compare import main
+
+        fresh = self._write(tmp_path / "fresh.json", value=196e6,
+                            p99_commit_latency_ms=3.1,
+                            failover_p99_ms=12.2)
+        assert main([fresh, "--history", self._history(tmp_path)]) == 0
+
+    def test_throughput_regression_fails(self, tmp_path):
+        from scripts.bench_compare import main
+
+        fresh = self._write(tmp_path / "fresh.json", value=150e6,
+                            p99_commit_latency_ms=3.0)
+        assert main([fresh, "--history", self._history(tmp_path)]) == 1
+
+    def test_latency_regression_fails_but_improvement_passes(self, tmp_path):
+        from scripts.bench_compare import main
+
+        hist = self._history(tmp_path)
+        worse = self._write(tmp_path / "worse.json", value=200e6,
+                            p99_commit_latency_ms=3.5)
+        assert main([worse, "--history", hist]) == 1
+        # 2x the throughput is a DELTA past 5% — in the good direction.
+        better = self._write(tmp_path / "better.json", value=400e6,
+                             p99_commit_latency_ms=1.0,
+                             failover_p99_ms=5.0)
+        assert main([better, "--history", hist]) == 0
+
+    def test_missing_metrics_never_fail(self, tmp_path):
+        from scripts.bench_compare import main
+
+        fresh = self._write(tmp_path / "fresh.json", value=199e6)
+        assert main([fresh, "--history", self._history(tmp_path)]) == 0
+
+    def test_unreadable_inputs_exit_2(self, tmp_path):
+        from scripts.bench_compare import main
+
+        bad = tmp_path / "bad.json"
+        bad.write_text("{truncated")
+        assert main([str(bad), "--history",
+                     self._history(tmp_path)]) == 2
+        ok = self._write(tmp_path / "ok.json", value=1.0)
+        assert main([ok, "--history", str(tmp_path / "none_*.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Trace hardening (satellite: truncated/empty/misnamed artifacts)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceHardening:
+    def test_load_sniffs_gzip_not_suffix(self, tmp_path):
+        # Plain JSON under a .gz name (crash between write and rename)
+        # must load by content.
+        p = tmp_path / "t.json.gz"
+        p.write_text(json.dumps({"traceEvents": []}))
+        assert Tracer.load(str(p)) == {"traceEvents": []}
+        # ...and gzip bytes under a plain name.
+        import gzip
+
+        q = tmp_path / "t.json"
+        with gzip.open(q, "wt") as f:
+            json.dump({"traceEvents": [1]}, f)
+        assert Tracer.load(str(q)) == {"traceEvents": [1]}
+
+    def test_summarize_accepts_bare_event_list(self, tmp_path):
+        from scripts.trace_summary import summarize
+
+        p = tmp_path / "bare.json"
+        p.write_text(json.dumps([
+            {"ph": "X", "name": "s", "ts": 0, "dur": 5, "pid": 0,
+             "tid": "t"},
+            "stray-string-event",
+        ]))
+        s = summarize(str(p))
+        assert s["spans"] == 1
+
+    def test_summarize_diagnoses_empty_and_junk(self, tmp_path):
+        from scripts.trace_summary import summarize
+
+        empty = tmp_path / "e.json.gz"
+        empty.write_bytes(b"")
+        with pytest.raises(ValueError, match="empty file"):
+            summarize(str(empty))
+        scalar = tmp_path / "s.json"
+        scalar.write_text("42")
+        with pytest.raises(ValueError, match="not a Chrome trace"):
+            summarize(str(scalar))
+        trunc = tmp_path / "t.json.gz"
+        import gzip as _gzip
+
+        blob = _gzip.compress(json.dumps({"traceEvents": []}).encode())
+        trunc.write_bytes(blob[: len(blob) // 2])
+        with pytest.raises(Exception):
+            summarize(str(trunc))
+
+    def test_cli_exit_codes_one_line_diagnostic(self, tmp_path):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        empty = tmp_path / "e.json.gz"
+        empty.write_bytes(b"")
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts",
+                                          "trace_summary.py"), str(empty)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 2
+        assert "Traceback" not in r.stderr
+        assert len(r.stderr.strip().splitlines()) == 1
+
+
+# ---------------------------------------------------------------------------
+# The acceptance test: SIGKILL a live engine mid-traffic, read the box
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.timeout_s(240)
+def test_sigkill_leaves_readable_ring_and_doctor_names_the_dead(
+    tmp_path, monkeypatch, capsys,
+):
+    """kill -9 an engine process under real clerk traffic; its mmap
+    ring must survive, replay to the last committed op, and the
+    postmortem doctor must name the dead process, its last commit, and
+    its WAL frontier from the collected bundle."""
+    from multiraft_tpu.distributed.engine_cluster import (
+        EngineProcessCluster,
+    )
+    from multiraft_tpu.harness.bundle import collect_bundle
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    frec_dir = tmp_path / "frec"
+    frec_dir.mkdir()
+    monkeypatch.setenv("MRT_FLIGHTREC_DIR", str(frec_dir))
+    # Host-process singleton must be fresh for this env (other tests
+    # may have resolved it already with recording disabled).
+    old_rec = flightrec._proc_rec
+    flightrec._proc_rec = None
+
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=16, seed=11,
+        data_dir=str(tmp_path / "data"),
+    )
+    obs = None
+    n_ops = 12
+    try:
+        cluster.start()
+        server_pid = cluster.proc.pid
+        addr = (cluster.host, cluster.port)
+        obs = FleetObserver([addr])
+
+        ck = cluster.clerk()
+        try:
+            for i in range(n_ops):
+                ck.append("blackbox", f"({i})")
+        finally:
+            ck.close()
+
+        # Scrape while alive: caches the pid ident and a clock offset
+        # that will outlive the process.
+        snaps = obs.snapshot_all()
+        key = f"{addr[0]}:{addr[1]}"
+        assert snaps[key]["pid"] == server_pid
+        assert "groups" in snaps[key]  # Obs.groups rides every snapshot
+        assert len(snaps[key]["groups"]["commit"]) == 16
+        assert obs.clock_offset_us(addr) is not None
+
+        cluster.kill()  # SIGKILL, no flush, no goodbye
+
+        ring_path = frec_dir / f"flight-{server_pid}.ring"
+        assert ring_path.exists(), os.listdir(frec_dir)
+        rr = read_ring(str(ring_path))
+        assert rr["pid"] == server_pid
+        assert rr["records"], "ring empty after SIGKILL"
+        assert not rr["clean_close"]
+
+        commits = [r for r in rr["records"]
+                   if r["type"] == flightrec.COMMIT]
+        assert commits, "no commit records in ring"
+        last = max(commits, key=lambda r: r["seq"])
+        # The ring replays to the LAST acked op: command ids are
+        # 1-based per clerk, so the final acked append is op n_ops.
+        assert last["b"] == n_ops
+        assert last["tag"], "commit record lost its rid"
+        # Durable mode: every ack gated on fsync, so the WAL frontier
+        # in the ring covers every acked op.
+        fsyncs = [r for r in rr["records"]
+                  if r["type"] == flightrec.WAL_FSYNC]
+        assert fsyncs and max(r["a"] for r in fsyncs) >= n_ops
+
+        bdir = tmp_path / "bundle"
+        collect_bundle(str(bdir), observer=obs, reason="sigkill test")
+        assert (bdir / "rings" / ring_path.name).exists()
+        snaps2 = json.loads((bdir / "snapshots.json").read_text())
+        assert snaps2[key]["missing"] is True
+        assert snaps2[key]["pid"] == server_pid
+
+        rc = postmortem.main([str(bdir)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "FIRST ANOMALY" in out
+        assert "UNCLEAN DEATH" in out
+        assert str(server_pid) in out
+        assert f"cmd {n_ops}" in out
+        report = (bdir / "report.txt").read_text()
+        assert key in report  # the dead process is named by address
+    finally:
+        if obs is not None:
+            obs.close()
+        cluster.shutdown()
+        if flightrec._proc_rec is not None:
+            flightrec._proc_rec.close()
+        flightrec._proc_rec = old_rec
