@@ -14,15 +14,19 @@ rules, :mod:`.lockgraph` for the static lock audit and
 from .core import ALL_RULES, Finding, ModuleInfo, Project, Rule, run
 from . import rules as _rules  # noqa: F401  (registration side effect)
 from . import lockgraph as _lockgraph  # noqa: F401
+from . import dataflow as _dataflow  # noqa: F401
+from .dataflow import Dataflow, get_dataflow
 from .lockgraph import LockGraph
 from .lockorder import LockOrderRecorder, RecordingLock
 
 __all__ = [
     "ALL_RULES",
+    "Dataflow",
     "Finding",
     "ModuleInfo",
     "Project",
     "Rule",
+    "get_dataflow",
     "run",
     "LockGraph",
     "LockOrderRecorder",

@@ -14,6 +14,7 @@ from pathlib import Path
 from .core import ALL_RULES, run
 from . import rules as _rules  # noqa: F401
 from . import lockgraph as _lockgraph  # noqa: F401
+from . import dataflow as _dataflow  # noqa: F401
 
 
 def main(argv=None) -> int:
@@ -36,6 +37,20 @@ def main(argv=None) -> int:
         default=None,
         help="run only the named rule(s)",
     )
+    ap.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help=(
+            "finding output format: github emits ::error annotation "
+            "lines that render inline on PRs"
+        ),
+    )
+    ap.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule wall-clock timing to stderr",
+    )
     ns = ap.parse_args(argv)
     rules = ALL_RULES
     if ns.rule:
@@ -45,9 +60,28 @@ def main(argv=None) -> int:
             print(f"graftlint: no such rule(s); known: {known}",
                   file=sys.stderr)
             return 2
-    active, suppressed = run([Path(p) for p in ns.paths], rules)
+    timings = {} if ns.timings else None
+    active, suppressed = run(
+        [Path(p) for p in ns.paths], rules, timings=timings
+    )
     for f in active:
-        print(f)
+        if ns.format == "github":
+            # GitHub workflow-command annotation: shows inline on the PR
+            # diff.  Message must be single-line (newlines end the
+            # command) and paths repo-relative.
+            msg = f.message.replace("\n", " ")
+            print(
+                f"::error file={f.path},line={f.line},"
+                f"title=graftlint/{f.rule}::{msg}"
+            )
+        else:
+            print(f)
+    if timings is not None:
+        for rname, secs in sorted(
+            timings.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  rule {rname:<22} {secs * 1000:8.1f} ms",
+                  file=sys.stderr)
     if ns.verbose and suppressed:
         print(f"-- {len(suppressed)} suppressed --")
         for f in suppressed:

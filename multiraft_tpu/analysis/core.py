@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import ast
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -165,25 +166,35 @@ def register(rule_cls):
 def run(
     paths: Iterable[Path],
     rules: Optional[Sequence[Rule]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[Finding], List[Finding]]:
     """Lint ``paths``; returns ``(active, suppressed)`` findings.
 
     ``active`` are unsuppressed violations (the gate fails on any);
     ``suppressed`` were matched by a ``# graftlint: disable`` pragma
-    and are reported so suppressions stay visible.
+    and are reported so suppressions stay visible.  Pass a dict as
+    ``timings`` to collect per-rule wall-clock seconds (the first rule
+    that touches the dataflow cache pays its build cost).
     """
     project = Project.load(paths)
     if rules is None:
         # Import for the registration side effect only.
         from . import rules as _rules  # noqa: F401
         from . import lockgraph as _lockgraph  # noqa: F401
+        from . import dataflow as _dataflow  # noqa: F401
 
         rules = ALL_RULES
     by_path = {str(m.path): m for m in project.modules}
     active: List[Finding] = []
     suppressed: List[Finding] = []
     for rule in rules:
-        for f in rule.check(project):
+        t0 = time.perf_counter() if timings is not None else 0.0
+        findings = rule.check(project)
+        if timings is not None:
+            timings[rule.name] = (
+                timings.get(rule.name, 0.0) + time.perf_counter() - t0
+            )
+        for f in findings:
             mod = by_path.get(f.path)
             if mod is not None and mod.is_suppressed(f.rule, f.line):
                 suppressed.append(f)

@@ -32,35 +32,21 @@ has a runtime cross-check.
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Project, Rule, dotted_name, register
+from .core import Finding, Project, Rule, register
+from .dataflow import ClassInfo, get_dataflow, is_lock_ctor as _is_lock_ctor
+
+__all__ = [
+    "Acquisition",
+    "ClassInfo",
+    "LockGraph",
+    "LockId",
+    "get_lock_graph",
+]
 
 LockId = Tuple[str, str]  # (scope = class or module stem, attr/name)
-
-_LOCK_CTORS = ("Lock", "RLock", "Condition")
-
-
-def _is_lock_ctor(node: ast.AST) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    d = dotted_name(node.func)
-    if d is None:
-        return False
-    leaf = d.rsplit(".", 1)[-1]
-    return leaf in _LOCK_CTORS
-
-
-@dataclass
-class ClassInfo:
-    name: str
-    module: str
-    path: str
-    node: ast.ClassDef
-    lock_attrs: Set[str] = field(default_factory=set)
-    attr_types: Dict[str, str] = field(default_factory=dict)
-    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
 
 
 @dataclass
@@ -72,144 +58,26 @@ class Acquisition:
 
 
 class LockGraph:
-    """Extracted classes, per-method acquisitions, and the edge set."""
+    """Per-method acquisitions and the lock-order edge set.
+
+    Class/lock/type collection lives in :mod:`.dataflow` (one shared
+    pass per lint run — the serving-path rules read the same tables);
+    this class keeps the lock-specific analysis: with-block resolution,
+    transitive acquisition closure, and edge construction."""
 
     def __init__(self, project: Project) -> None:
         self.project = project
-        self.classes: Dict[str, ClassInfo] = {}
-        self.module_locks: Dict[str, Set[str]] = {}
-        self.module_funcs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        df = get_dataflow(project)
+        self.classes: Dict[str, ClassInfo] = df.classes
+        self.module_locks: Dict[str, Set[str]] = df.module_locks
+        self.module_funcs: Dict[str, Dict[str, ast.FunctionDef]] = (
+            df.module_funcs
+        )
         # (scope, method) → locks transitively acquired inside
         self._acq_memo: Dict[Tuple[str, str], Set[LockId]] = {}
         # edge → one witness site
         self.edges: Dict[Tuple[LockId, LockId], Acquisition] = {}
-        self._collect()
         self._build_edges()
-
-    # -- collection --------------------------------------------------------
-
-    def _collect(self) -> None:
-        for mod in self.project.modules:
-            stem = mod.name
-            funcs: Dict[str, ast.FunctionDef] = {}
-            locks: Set[str] = set()
-            for stmt in mod.tree.body:
-                if isinstance(stmt, ast.FunctionDef):
-                    funcs[stmt.name] = stmt
-                elif isinstance(stmt, ast.Assign) and _is_lock_ctor(
-                    stmt.value
-                ):
-                    for t in stmt.targets:
-                        if isinstance(t, ast.Name):
-                            locks.add(t.id)
-            self.module_funcs[stem] = funcs
-            self.module_locks[stem] = locks
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, ast.ClassDef):
-                    continue
-                ci = ClassInfo(
-                    name=node.name,
-                    module=stem,
-                    path=str(mod.path),
-                    node=node,
-                )
-                for item in node.body:
-                    if isinstance(item, ast.FunctionDef):
-                        ci.methods[item.name] = item
-                for meth in ci.methods.values():
-                    for n in ast.walk(meth):
-                        if (
-                            isinstance(n, ast.Assign)
-                            and len(n.targets) == 1
-                            and isinstance(n.targets[0], ast.Attribute)
-                            and isinstance(
-                                n.targets[0].value, ast.Name
-                            )
-                            and n.targets[0].value.id == "self"
-                        ):
-                            attr = n.targets[0].attr
-                            if _is_lock_ctor(n.value):
-                                ci.lock_attrs.add(attr)
-                            else:
-                                t = self._ctor_class(n.value)
-                                if t is not None:
-                                    ci.attr_types[attr] = t
-                self.classes[node.name] = ci
-        self._bind_ctor_params()
-
-    def _bind_ctor_params(self) -> None:
-        """One-step inter-procedural attr typing: when class C calls
-        ``T(self, …)``, bind T.__init__'s parameter to type C, so
-        ``self._node = node`` inside T.__init__ types ``_node: C``.
-        This is what closes back-references like transport → node."""
-        for _ in range(2):  # fixpoint over 1-hop chains
-            for ci in self.classes.values():
-                for meth in ci.methods.values():
-                    for call in ast.walk(meth):
-                        if not isinstance(call, ast.Call):
-                            continue
-                        d = dotted_name(call.func)
-                        if d is None:
-                            continue
-                        target = self.classes.get(d.rsplit(".", 1)[-1])
-                        if target is None or "__init__" not in target.methods:
-                            continue
-                        params = [
-                            a.arg
-                            for a in target.methods["__init__"].args.args
-                        ][1:]  # drop self
-                        bound: Dict[str, str] = {}
-                        for p, arg in zip(params, call.args):
-                            t = self._arg_type(ci, arg)
-                            if t is not None:
-                                bound[p] = t
-                        for kw in call.keywords:
-                            if kw.arg is not None:
-                                t = self._arg_type(ci, kw.value)
-                                if t is not None:
-                                    bound[kw.arg] = t
-                        if not bound:
-                            continue
-                        for n in ast.walk(target.methods["__init__"]):
-                            if (
-                                isinstance(n, ast.Assign)
-                                and len(n.targets) == 1
-                                and isinstance(n.targets[0], ast.Attribute)
-                                and isinstance(
-                                    n.targets[0].value, ast.Name
-                                )
-                                and n.targets[0].value.id == "self"
-                                and isinstance(n.value, ast.Name)
-                                and n.value.id in bound
-                            ):
-                                target.attr_types.setdefault(
-                                    n.targets[0].attr, bound[n.value.id]
-                                )
-
-    def _arg_type(
-        self, ci: ClassInfo, arg: ast.AST
-    ) -> Optional[str]:
-        if isinstance(arg, ast.Name) and arg.id == "self":
-            return ci.name
-        if (
-            isinstance(arg, ast.Attribute)
-            and isinstance(arg.value, ast.Name)
-            and arg.value.id == "self"
-        ):
-            return ci.attr_types.get(arg.attr)
-        return None
-
-    @staticmethod
-    def _ctor_class(value: ast.AST) -> Optional[str]:
-        """Class name constructed anywhere in an assignment RHS."""
-        for n in ast.walk(value):
-            if isinstance(n, ast.Call):
-                d = dotted_name(n.func)
-                if d is not None:
-                    leaf = d.rsplit(".", 1)[-1]
-                    if leaf[:1].isupper():
-                        return leaf
-        return None
 
     # -- lock resolution ---------------------------------------------------
 
@@ -401,6 +269,18 @@ class LockGraph:
         return out
 
 
+def get_lock_graph(project: Project) -> LockGraph:
+    """Build (or reuse) the lock graph for this project.
+
+    Both lock rules need the same edge set; memoizing on the project
+    halves the cost of the most expensive analysis pass."""
+    cached = getattr(project, "_graftlint_lockgraph", None)
+    if cached is None:
+        cached = LockGraph(project)
+        project._graftlint_lockgraph = cached  # type: ignore[attr-defined]
+    return cached
+
+
 @register
 class LockOrderRule(Rule):
     name = "lock-order"
@@ -410,7 +290,7 @@ class LockOrderRule(Rule):
     )
 
     def check(self, project: Project) -> List[Finding]:
-        graph = LockGraph(project)
+        graph = get_lock_graph(project)
         out: List[Finding] = []
         for cycle in graph.cycles():
             # find a witness edge on the cycle for location info
@@ -446,7 +326,7 @@ class UnlockedWriteRule(Rule):
     )
 
     def check(self, project: Project) -> List[Finding]:
-        graph = LockGraph(project)
+        graph = get_lock_graph(project)
         out: List[Finding] = []
         for ci in graph.classes.values():
             if not ci.lock_attrs:

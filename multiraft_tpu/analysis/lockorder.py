@@ -23,7 +23,7 @@ cost; nothing global is monkeypatched.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 __all__ = ["LockOrderRecorder", "RecordingLock"]
 
@@ -61,11 +61,19 @@ class RecordingLock:
 class LockOrderRecorder:
     """Observed acquisition-order graph across all threads."""
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        on_edge: Optional[Callable[[str, str, str], None]] = None,
+    ) -> None:
         self._tls = threading.local()
         self._mu = threading.Lock()
         # (held_label, acquired_label) → witness thread name
         self.edges: Dict[Tuple[str, str], str] = {}
+        # Called once per NEW edge as (held, acquired, thread_name),
+        # outside the recorder's own lock — the runtime sanitizer uses
+        # it to check acyclicity as edges appear instead of only at
+        # test teardown.
+        self._on_edge = on_edge
 
     # -- wiring ------------------------------------------------------------
 
@@ -95,9 +103,15 @@ class LockOrderRecorder:
             ]
             if new:
                 tname = threading.current_thread().name
+                inserted: List[Tuple[str, str]] = []
                 with self._mu:
                     for key in new:
-                        self.edges.setdefault(key, tname)
+                        if key not in self.edges:
+                            self.edges[key] = tname
+                            inserted.append(key)
+                if self._on_edge is not None:
+                    for held, acq in inserted:
+                        self._on_edge(held, acq, tname)
         st.append(label)
 
     def _released(self, label: str) -> None:

@@ -48,6 +48,9 @@ Record = Dict[str, Any]
 BURST_WINDOW_US = 1_000_000.0
 BURST_MIN = 5
 
+# SANITIZE record code → violation kind (sanitize.py writes them).
+_SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
+
 
 # -- loading ---------------------------------------------------------------
 
@@ -244,6 +247,19 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                     ),
                     "aligned": off is not None,
                 })
+        for r in recs:
+            if r["type"] != flightrec.SANITIZE:
+                continue
+            kind = _SANITIZE_KINDS.get(r["code"], f"kind{r['code']}")
+            detail = f"runtime sanitizer: {kind} on '{r['tag']}'"
+            if r["a"] or r["b"]:
+                detail += f" (value {r['a']}, limit {r['b']})"
+            anomalies.append({
+                "ts": aligned(r["ts"]), "proc": label,
+                "kind": "sanitizer_violation",
+                "detail": detail,
+                "aligned": off is not None,
+            })
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more

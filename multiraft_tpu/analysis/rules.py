@@ -800,6 +800,9 @@ class JitPurityRule(Rule):
     )
 
     def check(self, project: Project) -> List[Finding]:
+        from .dataflow import get_dataflow
+
+        df = get_dataflow(project)
         out: List[Finding] = []
         for mod in project.modules:
             jitted = _jit_wrapped_names(mod.tree)
@@ -811,41 +814,95 @@ class JitPurityRule(Rule):
                     and fn.name in jitted
                 ):
                     out.extend(self._scan(mod, fn))
+                    out.extend(self._scan_callees(df, mod, fn, jitted))
         return out
+
+    @staticmethod
+    def _impurity_of(n: ast.AST) -> Optional[str]:
+        """Description of the impurity a node performs, or None."""
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d is None:
+                return None
+            if d in _IMPURE_CALLS:
+                return f"wall-clock call {d}()"
+            if d.startswith("random.") or d.startswith(
+                ("np.random.", "numpy.random.")
+            ):
+                return f"host RNG call {d}()"
+            if d == "open":
+                return "file I/O (open)"
+            if d == "print":
+                return "print()"
+            return None
+        if isinstance(n, ast.Global):
+            return f"global write ({', '.join(n.names)})"
+        return None
 
     def _scan(self, mod: ModuleInfo, fn: ast.AST) -> List[Finding]:
         out: List[Finding] = []
-
-        def flag(node: ast.AST, what: str) -> None:
-            out.append(
-                Finding(
-                    rule=self.name,
-                    path=str(mod.path),
-                    line=node.lineno,
-                    message=(
-                        f"{what} inside jitted function "
-                        f"'{getattr(fn, 'name', '?')}' executes at trace "
-                        "time only (constant-folds into the compiled "
-                        "graph)"
-                    ),
-                )
-            )
-
         for n in ast.walk(fn):
-            if isinstance(n, ast.Call):
-                d = dotted_name(n.func)
-                if d is None:
+            what = self._impurity_of(n)
+            if what is not None:
+                out.append(
+                    Finding(
+                        rule=self.name,
+                        path=str(mod.path),
+                        line=n.lineno,
+                        message=(
+                            f"{what} inside jitted function "
+                            f"'{getattr(fn, 'name', '?')}' executes at "
+                            "trace time only (constant-folds into the "
+                            "compiled graph)"
+                        ),
+                    )
+                )
+        return out
+
+    def _scan_callees(
+        self,
+        df: "object",
+        mod: ModuleInfo,
+        fn: ast.AST,
+        jitted: Set[str],
+    ) -> List[Finding]:
+        """One-level closure: impurities inside project helpers the
+        jitted function calls, flagged at the call site.  Jitted
+        callees are skipped — they are scanned (and flagged) on their
+        own."""
+        from .dataflow import own_nodes
+
+        fi = df.func_of_node(fn)  # type: ignore[attr-defined]
+        if fi is None:
+            return []
+        out: List[Finding] = []
+        for call in own_nodes(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            for tgt in df.resolve_call(fi, call):  # type: ignore[attr-defined]
+                if tgt.name in jitted and tgt.path == str(mod.path):
                     continue
-                if d in _IMPURE_CALLS:
-                    flag(n, f"wall-clock call {d}()")
-                elif d.startswith("random.") or d.startswith(
-                    ("np.random.", "numpy.random.")
-                ):
-                    flag(n, f"host RNG call {d}()")
-                elif d == "open":
-                    flag(n, "file I/O (open)")
-                elif d == "print":
-                    flag(n, "print()")
-            elif isinstance(n, ast.Global):
-                flag(n, f"global write ({', '.join(n.names)})")
+                what = next(
+                    (
+                        w
+                        for n in own_nodes(tgt.node)
+                        if (w := self._impurity_of(n)) is not None
+                    ),
+                    None,
+                )
+                if what is not None:
+                    out.append(
+                        Finding(
+                            rule=self.name,
+                            path=str(mod.path),
+                            line=call.lineno,
+                            message=(
+                                f"call to '{tgt.name}' from jitted "
+                                f"function '{getattr(fn, 'name', '?')}' "
+                                f"reaches {what} — it executes at trace "
+                                "time only (constant-folds into the "
+                                "compiled graph)"
+                            ),
+                        )
+                    )
         return out

@@ -93,6 +93,7 @@ CHAOS = 9        # code=kind_code a=1         tag=path
 ROLE = 10        # code=peer_id a=role b=term c=commit_index
 NODE_CLOSE = 11  # clean shutdown marker      tag=name
 MARK = 12        # free-form harness marker   tag=text
+SANITIZE = 13    # code=kind a=value b=limit  tag=label (sanitize.py)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -107,10 +108,15 @@ _TYPE_NAMES = {
     ROLE: "role",
     NODE_CLOSE: "node_close",
     MARK: "mark",
+    SANITIZE: "sanitize",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.
 CHAOS_KIND_CODES = {"drop": 1, "delay": 2, "block": 3}
+
+# Runtime-sanitizer violation kinds → compact codes for SANITIZE
+# records (sanitize.py; the postmortem doctor names them back).
+SANITIZE_KIND_CODES = {"lock_order": 1, "queue_bound": 2, "callback_budget": 3}
 
 
 def type_name(etype: int) -> str:

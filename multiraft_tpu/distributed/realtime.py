@@ -26,6 +26,7 @@ from typing import Any, Callable, Generator, Optional
 
 from ..sim.scheduler import TIMEOUT, Future, Timer
 from ..utils.cpus import usable_cpus
+from .sanitize import get_sanitizer
 
 __all__ = [
     "RealtimeScheduler",
@@ -91,6 +92,10 @@ class RealtimeScheduler:
         self._wakeup = threading.Condition(self._lock)
         self._stopped = False
         self.fired_events = 0
+        # Runtime sanitizer (MRT_SANITIZE=1): every callback the loop
+        # runs goes through its duration-budget shim.  None = off =
+        # one `is None` check per dispatch.
+        self._san = get_sanitizer()
         self._thread = threading.Thread(
             target=self._run, name="multiraft-loop", daemon=True
         )
@@ -263,7 +268,10 @@ class RealtimeScheduler:
                 continue
             self.fired_events += 1
             try:
-                fn(*args)
+                if self._san is not None:
+                    self._san.run_callback(fn, *args)
+                else:
+                    fn(*args)
             except Exception:  # pragma: no cover - keep the loop alive
                 import traceback
 
@@ -371,7 +379,10 @@ class IoScheduler(RealtimeScheduler):
                 if fn is not None:  # else cancelled between push and pop
                     self.fired_events += 1
                     try:
-                        fn(*args)
+                        if self._san is not None:
+                            self._san.run_callback(fn, *args)
+                        else:
+                            fn(*args)
                     except Exception:  # pragma: no cover - keep loop alive
                         import traceback
 
@@ -399,7 +410,10 @@ class IoScheduler(RealtimeScheduler):
             if ev is not None:
                 self.fired_events += 1
                 try:
-                    self._io_handle(ev)
+                    if self._san is not None:
+                        self._san.run_callback(self._io_handle, ev)
+                    else:
+                        self._io_handle(ev)
                 except Exception:  # pragma: no cover - keep the loop alive
                     import traceback
 

@@ -197,11 +197,14 @@ class SplitPersistence:
         with open(tmp, "wb") as f:
             self._pickle.dump(blob, f, protocol=4)
             f.flush()
-            os.fsync(f.fileno())
+            # Intentional loop-thread sync point: the snapshot MUST be
+            # durable before wal.rotate() discards its records (same
+            # contract as the WAL's allowlisted group-commit fsync).
+            os.fsync(f.fileno())  # graftlint: disable=blocking-in-callback
         os.replace(tmp, self.snap_path)
         dfd = os.open(os.path.dirname(self.snap_path) or ".", os.O_RDONLY)
         try:
-            os.fsync(dfd)
+            os.fsync(dfd)  # graftlint: disable=blocking-in-callback
         finally:
             os.close(dfd)
         # A crash between replace and rotate leaves redundant WAL

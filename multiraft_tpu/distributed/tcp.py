@@ -74,6 +74,7 @@ from . import flightrec
 from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
 from .observe import Observability, install_obs, is_control
 from .realtime import IoScheduler
+from .sanitize import get_sanitizer
 
 __all__ = ["RpcNode", "TcpClientEnd"]
 
@@ -91,6 +92,16 @@ _FLUSH_MAX_AGE_S = 500e-6
 # queueing: bulk results gate the (serial) sender's next frame, and the
 # payload dwarfs any per-syscall saving batching could add.
 _BULK_REPLY_BYTES = 2048
+
+# Per-connection reply-queue cap (MRT_REPLY_Q_CAP overrides).  A client
+# that stops draining its socket must not grow this node's memory: once
+# a connection's queue hits the cap the OLDEST undelivered reply is
+# shed (counted as rpc.reply_shed).  Shedding old over new is the right
+# polarity for an RPC server — the caller of a shed reply has already
+# timed out and retried, while the newest replies still have a waiting
+# caller; session dedup keeps the retry exactly-once, the same
+# machinery that already covers chaos-dropped replies.
+_REPLY_Q_CAP = int(os.environ.get("MRT_REPLY_Q_CAP", "4096"))
 # Frame length prefix (big-endian u32) — must match transport.cpp's
 # framing; send_parts writes raw so Python adds it per frame.
 _U32 = struct.Struct(">I")
@@ -177,6 +188,17 @@ class RpcNode:
         # (MRT_FLIGHTREC_DIR).  None = disabled = zero hot-path cost
         # beyond one `is None` check per frame.
         self._frec = flightrec.get_recorder(name=name or "")
+        # Runtime sanitizer (MRT_SANITIZE=1, sanitize.py): wraps this
+        # node's and its transport's locks in order-recording proxies
+        # (acyclicity asserted on every new edge) and checks the reply
+        # queue's cap at every growth site.  None = off = zero cost.
+        self._san = get_sanitizer()
+        if self._san is not None:
+            self._san.install_locks(self, {"_lock": "RpcNode._lock"})
+            self._san.install_locks(
+                self._tr, {"_lock": "NativeTransport._lock"}
+            )
+            self._san.register_metrics(self.obs.metrics)
         # MRT_TRACE_DIR=<dir>: save the span buffer on close().  Engine
         # servers additionally point their driver's tick spans at the
         # same tracer (via ``self.tracer``), so one timeline shows RPC
@@ -256,7 +278,8 @@ class RpcNode:
             # queues it until the handshake completes, so it always
             # precedes every request on this connection.
             if not self._legacy_wire:
-                self._hello_sent.add(cid)
+                # Bounded by open connections (discarded on close).
+                self._hello_sent.add(cid)  # graftlint: disable=unbounded-queue
                 try:
                     self._tr.send(cid, codec.encode(("hello", _WIRE_CAPS)))
                 except Exception:
@@ -423,7 +446,8 @@ class RpcNode:
                 return
             self._peer_caps[conn] = frozenset(msg[1])
             if conn not in self._hello_sent:
-                self._hello_sent.add(conn)
+                # Bounded by open connections (discarded on close).
+                self._hello_sent.add(conn)  # graftlint: disable=unbounded-queue
                 try:
                     self._tr.send(conn, codec.encode(("hello", _WIRE_CAPS)))
                 except Exception:
@@ -568,7 +592,13 @@ class RpcNode:
         if not self._legacy_wire and self.sched.on_loop_thread():
             if not self._outq:
                 self._outq_since = time.perf_counter()
-            self._outq.setdefault(conn, []).append((req_id, value))
+            q = self._outq.setdefault(conn, [])
+            if len(q) >= _REPLY_Q_CAP:
+                q.pop(0)  # shed-oldest: that caller already retried
+                self.obs.metrics.inc("rpc.reply_shed")
+            q.append((req_id, value))
+            if self._san is not None:
+                self._san.guard_queue("rpc.outq", len(q), _REPLY_Q_CAP)
             # Bulk blob replies (a firehose frame's results) gate a
             # serial client's next frame: flush now — mid-tick, like
             # the legacy inline send — instead of riding out the rest

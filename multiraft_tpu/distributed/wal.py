@@ -167,7 +167,11 @@ class WriteAheadLog:
         """Stage one record; returns its seq (ack-gate with
         ``synced >= seq`` after a :meth:`sync`)."""
         crc = zlib.crc32(body, zlib.crc32(_LEN.pack(len(body))))
-        self._pend.append(_HEADER.pack(_MAGIC, crc, len(body)) + body)
+        # Drained by _write_pending on every sync(): bounded by the
+        # records staged within one pump (group-commit batching).
+        self._pend.append(  # graftlint: disable=unbounded-queue
+            _HEADER.pack(_MAGIC, crc, len(body)) + body
+        )
         self.appended += 1
         m = self.metrics
         m.inc("wal.appends")

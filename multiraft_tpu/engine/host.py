@@ -313,7 +313,10 @@ class EngineDriver:
                         rng.integers(self.reorder_min, self.reorder_max + 1)
                     )
                     payload = {f: host[f][g, s, dst].copy() for f in fields}
-                    self._delayed.append(
+                    # Chaos reorder buffer: every entry carries a
+                    # release tick ≤ tick+reorder_max, so occupancy is
+                    # bounded by reorder_max windows of traffic.
+                    self._delayed.append(  # graftlint: disable=unbounded-queue
                         (release, prefix, (int(g), int(s), int(dst)), payload)
                     )
                 act[pick] = False
@@ -369,7 +372,10 @@ class EngineDriver:
         """Queue a command for group g (the synthetic firehose feeds
         this in bulk)."""
         self.backlog[g] += 1
-        self._pending_payloads[g].append(command)
+        # Drained by the tick's ingest path at INGEST ops/group/tick;
+        # admission control above this layer (reply-queue caps, item 3)
+        # is what bounds a sustained overload.
+        self._pending_payloads[g].append(command)  # graftlint: disable=unbounded-queue
 
     def start_bulk(self, counts: np.ndarray) -> None:
         self.backlog += counts
@@ -617,7 +623,10 @@ class EngineDriver:
         with open(tmp, "wb") as f:
             pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
             f.flush()
-            os.fsync(f.fileno())
+            # Intentional loop-thread sync point: checkpoint atomicity
+            # (the durable server truncates its WAL right after this
+            # returns, so the checkpoint must hit the platter first).
+            os.fsync(f.fileno())  # graftlint: disable=blocking-in-callback
         os.replace(tmp, path)  # atomic: a crash mid-save keeps the old one
         # Make the rename itself durable: the durable-server protocol
         # truncates its WAL right after this call, and on power loss
@@ -625,7 +634,7 @@ class EngineDriver:
         # become durable while the checkpoint rename does not.
         dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
         try:
-            os.fsync(dfd)
+            os.fsync(dfd)  # graftlint: disable=blocking-in-callback
         finally:
             os.close(dfd)
         return path

@@ -156,7 +156,10 @@ class BatchedKV(FrontierService):
         """Append a porcupine operation for a recorded group.  ``ret``
         is padded by 0.5 so intervals are non-degenerate in tick time."""
         if g in self._record:
-            self.histories[g].append(
+            # Porcupine history capture: only for groups the TEST
+            # harness opted into recording; production serves with
+            # _record empty.
+            self.histories[g].append(  # graftlint: disable=unbounded-queue
                 Operation(
                     client_id=0,
                     input=inp,

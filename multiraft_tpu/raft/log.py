@@ -76,7 +76,9 @@ class RaftLog:
 
     def append(self, entry: Entry) -> None:
         entry.index = self.last_index + 1
-        self.entries.append(entry)
+        # The replicated log grows by design; snapshot compaction
+        # (compact_to, driven by maxraftstate) is what bounds it.
+        self.entries.append(entry)  # graftlint: disable=unbounded-queue
 
     def truncate_from(self, index: int) -> None:
         """Drop entries with absolute index ≥ ``index``

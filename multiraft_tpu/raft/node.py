@@ -544,7 +544,8 @@ class RaftNode:
                     continue
                 self.log.truncate_from(entry.index)
                 changed = True
-            self.log.entries.append(entry)
+            # Replicated log: bounded by snapshot compaction, not here.
+            self.log.entries.append(entry)  # graftlint: disable=unbounded-queue
             changed = True
         if changed:
             self._persist()

@@ -332,7 +332,9 @@ class ShardKVServer:
                 continue
             for s in range(NSHARDS):
                 if self.shards[s].state == PULLING and s not in self._pulling_now:
-                    self._pulling_now.add(s)
+                    # In-flight dedup set: ≤ NSHARDS entries, discarded
+                    # when _pull_one completes.
+                    self._pulling_now.add(s)  # graftlint: disable=unbounded-queue
                     self.sched.spawn(self._pull_one(s, self.cur.num))
 
     def _pull_one(self, shard: int, config_num: int):
@@ -372,7 +374,9 @@ class ShardKVServer:
                 continue
             for s in range(NSHARDS):
                 if self.shards[s].state == GCING and s not in self._gcing_now:
-                    self._gcing_now.add(s)
+                    # In-flight dedup set: ≤ NSHARDS entries, discarded
+                    # when _gc_one completes.
+                    self._gcing_now.add(s)  # graftlint: disable=unbounded-queue
                     self.sched.spawn(self._gc_one(s, self.cur.num))
 
     def _gc_one(self, shard: int, config_num: int):

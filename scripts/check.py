@@ -8,18 +8,31 @@ are reported as SKIPPED and do not fail the gate; CI installs both so
 the full gate runs there.  graftlint has no dependencies beyond the
 stdlib and always runs.
 
+graftlint additionally carries a wall-clock budget
+(``GRAFTLINT_BUDGET_S``): the interprocedural serving-path rules walk
+a whole-package call graph, and a gate developers stop running is a
+gate — exceeding the budget fails the run just like a finding would.
+``--timings`` prints the per-rule breakdown when hunting a regression.
+
+``--format=github`` makes graftlint findings come out as GitHub
+workflow annotations (``::error file=...,line=...``) so a CI failure
+is pinned to the offending line in the PR diff.
+
 The mypy step checks only the typed core (the modules listed in
 ``MYPY_CORE``, matching the strict overrides in pyproject.toml):
-wire/WAL/chaos/observe/utils are the modules whose type drift has
+wire/WAL/chaos/observe/utils plus the flight-recorder → bundle →
+postmortem evidence chain are the modules whose type drift has
 historically produced wire bugs, so they are held to
 ``disallow_untyped_defs``.
 """
 
 from __future__ import annotations
 
+import argparse
 import importlib.util
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -29,8 +42,14 @@ MYPY_CORE = [
     "multiraft_tpu/distributed/wal.py",
     "multiraft_tpu/distributed/chaos.py",
     "multiraft_tpu/distributed/observe.py",
+    "multiraft_tpu/distributed/flightrec.py",
+    "multiraft_tpu/analysis/postmortem.py",
+    "multiraft_tpu/harness/bundle.py",
     "multiraft_tpu/utils",
 ]
+
+# Total graftlint wall clock the gate tolerates, in seconds.
+GRAFTLINT_BUDGET_S = 30.0
 
 
 def _have(module: str) -> bool:
@@ -46,16 +65,48 @@ def _run(label: str, cmd: list[str]) -> bool:
     return ok
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="graftlint finding format (github = workflow annotations)",
+    )
+    ap.add_argument(
+        "--timings",
+        action="store_true",
+        help="print graftlint's per-rule wall clock to stderr",
+    )
+    args = ap.parse_args(argv)
+
     failed: list[str] = []
     skipped: list[str] = []
 
-    if not _run(
-        "graftlint",
-        [sys.executable, "-m", "multiraft_tpu.analysis", "multiraft_tpu",
-         "-v"],
-    ):
+    lint_cmd = [
+        sys.executable, "-m", "multiraft_tpu.analysis", "multiraft_tpu",
+        "-v", "--format", args.format,
+    ]
+    if args.timings:
+        lint_cmd.append("--timings")
+    t0 = time.perf_counter()
+    if not _run("graftlint", lint_cmd):
         failed.append("graftlint")
+    lint_s = time.perf_counter() - t0
+    if lint_s > GRAFTLINT_BUDGET_S:
+        print(
+            f"== graftlint: wall clock {lint_s:.1f}s EXCEEDS the "
+            f"{GRAFTLINT_BUDGET_S:.0f}s budget (run with --timings to "
+            "find the slow rule)",
+            flush=True,
+        )
+        failed.append("graftlint-budget")
+    else:
+        print(
+            f"== graftlint: {lint_s:.1f}s wall clock "
+            f"(budget {GRAFTLINT_BUDGET_S:.0f}s)",
+            flush=True,
+        )
 
     if _have("ruff"):
         if not _run(

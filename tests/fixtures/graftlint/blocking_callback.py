@@ -1,0 +1,35 @@
+"""Fixture: blocking calls on the scheduler loop thread.
+
+Reproduces the stall class blocking-in-callback exists for: a timer
+callback sleeping on the loop thread, plus a helper it calls that
+fsyncs — both stall every reply riding the loop while they block.
+``Poller`` is the clean negative: a non-blocking try-acquire is
+exempt.
+"""
+
+import os
+import threading
+import time
+
+
+class Checkpointer:
+    def __init__(self, sched, fd):
+        self.fd = fd
+        sched.call_after(1.0, self.on_timer)
+
+    def on_timer(self):
+        time.sleep(0.01)  # BUG: sleeps on the loop thread
+        self.flush()
+
+    def flush(self):
+        os.fsync(self.fd)  # BUG: reachable from the timer callback
+
+
+class Poller:
+    def __init__(self, sched):
+        self._lock = threading.Lock()
+        sched.call_soon(self.on_poll)
+
+    def on_poll(self):
+        if self._lock.acquire(blocking=False):  # try-acquire: exempt
+            self._lock.release()

@@ -1,0 +1,32 @@
+"""Fixture: a reply backlog growing on the serving path.
+
+Reproduces the tcp.py per-connection reply-queue bug: a method
+reachable from a scheduler callback root appends to a self-attribute
+container with no bound check or shed path in scope, so an overloaded
+peer grows it without limit.  ``Bounded`` is the clean negative —
+same shape, but it sheds oldest past a cap.
+"""
+
+
+class Backlog:
+    def __init__(self, sched):
+        self.pending = []
+        sched.call_soon(self.on_ready)
+
+    def on_ready(self):
+        self.pump()
+
+    def pump(self):
+        for item in ("a", "b", "c"):
+            self.pending.append(item)  # BUG: unbounded on serving path
+
+
+class Bounded:
+    def __init__(self, sched):
+        self.replies = []
+        sched.call_after(0.1, self.on_flush)
+
+    def on_flush(self):
+        if len(self.replies) >= 16:
+            self.replies.pop(0)  # shed-oldest keeps it bounded
+        self.replies.append("ok")

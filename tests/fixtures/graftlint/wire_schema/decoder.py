@@ -1,0 +1,20 @@
+"""Consumer half of the wire-schema fixture.
+
+Unpacks "migrate" with the wrong arity and reads an "ack" field past
+the shipped arity — both against encoders that live in encoder.py.
+The "cfg" branch is the clean negative: access past the minimum
+arity, but behind a len() guard.
+"""
+
+
+def on_frame(msg):
+    if msg[0] == "migrate":
+        tag, shard, payload = msg  # BUG: encoder ships 4 fields
+        return (shard, payload)
+    if msg[0] == "ack":
+        return msg[3]  # BUG: encoder ships arity 3 (indices 0..2)
+    if msg[0] == "cfg":
+        if len(msg) >= 4:
+            return msg[3]  # guarded: clean
+        return msg[1]
+    return None

@@ -1,0 +1,19 @@
+"""Producer half of the wire-schema fixture.
+
+Ships "migrate" frames with 4 fields and "ack"/"cfg" frames with 3
+through the shared codec.  The decoder lives in decoder.py — the
+drift is invisible to any single-module lexical check (frame-arity),
+which is exactly the gap wire-schema closes.
+"""
+
+
+def send_migrate(codec, shard, epoch, payload):
+    codec.encode(("migrate", shard, epoch, payload))
+
+
+def send_ack(codec, shard):
+    codec.encode_oob(("ack", shard, 0))
+
+
+def send_cfg(codec, gen):
+    codec.encode(("cfg", gen, 0))

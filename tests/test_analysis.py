@@ -44,6 +44,9 @@ def test_rule_registry_complete():
         "jit-purity",
         "lock-order",
         "unlocked-write",
+        "unbounded-queue",
+        "blocking-in-callback",
+        "wire-schema",
     }
 
 
@@ -58,6 +61,9 @@ _FIXTURE_CASES = [
     ("impure_tick.py", "jit-purity", 4),  # trace-time effects
     ("lock_cycle.py", "lock-order", 1),  # ABBA across node/transport
     ("unlocked_counter.py", "unlocked-write", 1),  # chaos counter race
+    ("unbounded_queue.py", "unbounded-queue", 1),  # PR 6 reply-queue bug
+    ("blocking_callback.py", "blocking-in-callback", 2),  # loop stalls
+    ("wire_schema", "wire-schema", 2),  # cross-module frame drift
 ]
 
 
@@ -136,6 +142,26 @@ def test_lock_graph_sees_threaded_classes():
     locked = {c.name for c in g.classes.values() if c.lock_attrs}
     assert {"RpcNode", "NativeTransport", "ChaosState",
             "RealtimeScheduler"} <= locked
+
+
+def test_lock_graph_covers_flight_recorder():
+    """The PR 5 observability modules participate in the audited lock
+    graph: the recorder's per-instance lock and the module-level
+    process-registry lock are both modeled, and adding them kept the
+    graph acyclic (postmortem/bundle run lock-free on top)."""
+    g = LockGraph(Project.load([PACKAGE]))
+    assert "_lock" in g.classes["FlightRecorder"].lock_attrs
+    assert "_proc_lock" in g.module_locks["flightrec"]
+    assert g.cycles() == [], g.cycles()
+
+
+def test_lock_rules_share_one_graph():
+    """Both lock rules run off one memoized LockGraph per project (the
+    most expensive pass would otherwise be built twice per lint run)."""
+    from multiraft_tpu.analysis.lockgraph import get_lock_graph
+
+    p = Project.load([PACKAGE])
+    assert get_lock_graph(p) is get_lock_graph(p)
 
 
 # -- dynamic lock-order recorder -------------------------------------------
