@@ -1,0 +1,444 @@
+"""Open-loop load generator: offered rate the server cannot refuse.
+
+Every existing bench in this repo is CLOSED-loop: clerks wait for each
+reply before sending the next op, so an overloaded server silently
+throttles its own offered load and the measured "latency at X ops/s"
+is really "latency at whatever rate the server let us sustain" — the
+coordinated-omission trap.  This generator is open-loop: arrivals come
+from a precomputed schedule (Poisson, bursty, or diurnal-ramp, with
+zipfian key skew), each arrival fires an ``EngineKV.command`` RPC at
+its scheduled instant WITHOUT waiting for the previous reply, and
+per-rid send/reply timestamps are recorded via future done-callbacks.
+Under overload the queues (not the generator) absorb the excess, so
+the latency curve shows the real knee.
+
+Layering:
+
+* :func:`gen_schedule` / :class:`ZipfKeys` — pure and deterministic
+  (seeded ``random.Random``; same seed → byte-identical schedule), so
+  a step is reproducible and the schedule is testable without sockets.
+* :func:`fire_schedule` — one open-loop step against a served engine:
+  fresh client ``RpcNode`` per step (bounds dropped-reply futures to
+  the step), fires the schedule, drains briefly, folds client-observed
+  latencies into a :class:`~multiraft_tpu.utils.metrics.Hist`.
+* :func:`sweep` — rate ladder via harness/loadcurve.py (windowed
+  fleet scrapes give the per-stage p50/p99 per step), with a porcupine
+  sampler clerk running THROUGHOUT the sweep — overload may shed or
+  starve, but it must never reorder acknowledged state.
+
+Usage::
+
+    python -m benchmarks.openloop [--mode poisson|bursty|diurnal]
+        [--rates 500,1000,...] [--step-s 4] [--seed 7]
+        [--out LOADCURVE_r01.json]
+
+Writes the LOADCURVE JSON (throughput-vs-p99 curve, detected knee,
+per-stage decomposition per rate step) gated by scripts/bench_compare.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import random
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ARRIVAL_MODES = ("poisson", "bursty", "diurnal")
+
+# One scheduled arrival: (t_offset_s, op, key, value).
+Arrival = Tuple[float, str, str, str]
+
+
+# -- pure schedule generation ----------------------------------------------
+
+class ZipfKeys:
+    """Zipf(s) sampler over ``n`` keys via inverse CDF — key ``i`` has
+    weight ``(i+1)^-s``, so key 0 is hottest.  Pure (caller supplies
+    the rng), so schedules stay deterministic."""
+
+    def __init__(self, n: int, s: float = 1.1, prefix: str = "olk") -> None:
+        assert n >= 1
+        weights = [(i + 1) ** -s for i in range(n)]
+        total = sum(weights)
+        acc = 0.0
+        self._cdf: List[float] = []
+        for w in weights:
+            acc += w / total
+            self._cdf.append(acc)
+        self._cdf[-1] = 1.0  # guard float drift at the tail
+        self.prefix = prefix
+
+    def pick(self, rng: random.Random) -> str:
+        i = bisect.bisect_left(self._cdf, rng.random())
+        return f"{self.prefix}{i}"
+
+
+def rate_at(
+    mode: str, t: float, duration: float, rate: float,
+    burst_factor: float = 4.0, burst_cycle: float = 1.0,
+    burst_duty: float = 0.2,
+) -> float:
+    """Instantaneous arrival rate λ(t) for the three shapes.  All keep
+    the MEAN offered rate ≈ ``rate`` so a ladder step means the same
+    load regardless of shape:
+
+    * ``poisson`` — constant λ.
+    * ``bursty`` — on/off square wave: ``burst_duty`` of each
+      ``burst_cycle`` runs at ``burst_factor``·rate, the rest at the
+      complementary rate that preserves the mean.
+    * ``diurnal`` — half-sine ramp 0→peak→0 across the step (peak =
+      π/2·rate keeps the mean at ``rate``), the compressed shape of a
+      daily traffic cycle.
+    """
+    if mode == "poisson":
+        return rate
+    if mode == "bursty":
+        assert burst_factor * burst_duty <= 1.0, "burst exceeds the mean"
+        phase = (t % burst_cycle) / burst_cycle
+        if phase < burst_duty:
+            return rate * burst_factor
+        off = rate * (1.0 - burst_factor * burst_duty) / (1.0 - burst_duty)
+        return max(off, rate * 0.01)
+    if mode == "diurnal":
+        frac = min(max(t / duration, 0.0), 1.0)
+        lam = rate * (math.pi / 2.0) * math.sin(math.pi * frac)
+        return max(lam, rate * 0.01)  # floor: no zero-rate stall at edges
+    raise ValueError(f"unknown arrival mode {mode!r}")
+
+
+def gen_schedule(
+    seed: int,
+    rate: float,
+    duration: float,
+    mode: str = "poisson",
+    keyspace: int = 512,
+    zipf_s: float = 1.1,
+    get_frac: float = 0.2,
+    append_frac: float = 0.2,
+    burst_factor: float = 4.0,
+    burst_cycle: float = 1.0,
+    burst_duty: float = 0.2,
+) -> List[Arrival]:
+    """Deterministic arrival schedule: ``[(t, op, key, value), ...]``
+    sorted by ``t`` ∈ [0, duration).  Inter-arrivals are exponential at
+    the instantaneous λ(t) (stepwise time-rescaling — exact for
+    ``poisson``, a fine approximation for the smooth shapes at bench
+    rates); keys are zipf-skewed; the op mix is Get/Append/Put at
+    ``get_frac``/``append_frac``/remainder."""
+    assert mode in ARRIVAL_MODES, mode
+    rng = random.Random(seed)
+    keys = ZipfKeys(keyspace, zipf_s)
+    out: List[Arrival] = []
+    t = 0.0
+    i = 0
+    while True:
+        lam = rate_at(mode, t, duration, rate,
+                      burst_factor, burst_cycle, burst_duty)
+        t += rng.expovariate(lam)
+        if t >= duration:
+            break
+        u = rng.random()
+        if u < get_frac:
+            op, value = "Get", ""
+        elif u < get_frac + append_frac:
+            op, value = "Append", f"a{i},"
+        else:
+            op, value = "Put", f"v{i}"
+        out.append((t, op, keys.pick(rng), value))
+        i += 1
+    return out
+
+
+# -- one open-loop step -----------------------------------------------------
+
+def fire_schedule(
+    host: str,
+    port: int,
+    schedule: Sequence[Arrival],
+    duration: float,
+    service: str = "EngineKV",
+    drain_s: float = 2.0,
+) -> Dict[str, Any]:
+    """Fire one schedule open-loop and return the client-side record.
+
+    The driver coroutine runs on a fresh client node's loop: it sleeps
+    to each arrival's instant, fires the call with a per-rid trace id,
+    and moves on — reply timestamps land via done-callbacks (loop
+    thread), never blocking the firing line.  Replies that never come
+    (shed under overload) count as ``drops``; the fresh node per step
+    bounds their leaked futures to the step's lifetime."""
+    from multiraft_tpu.distributed.engine_clerks import EngineClerk
+    from multiraft_tpu.distributed.engine_wire import OK
+    from multiraft_tpu.distributed.engine_wire import EngineCmdArgs
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.sim.scheduler import TIMEOUT
+    from multiraft_tpu.utils.ids import unique_client_id
+    from multiraft_tpu.utils.metrics import Hist
+
+    node = RpcNode()
+    try:
+        end = node.client_end(host, port)
+        sched = node.sched
+        n = len(schedule)
+        # Indexed by arrival; written only on the loop thread.
+        lats: List[Optional[float]] = [None] * n
+        oks = [0] * n
+        client_id = unique_client_id(next(EngineClerk._next))
+
+        def make_done(i: int, t_send: float):
+            def _done(f) -> None:
+                lats[i] = time.perf_counter() - t_send
+                r = f.value
+                if r is not None and r is not TIMEOUT and \
+                        getattr(r, "err", None) == OK:
+                    oks[i] = 1
+            return _done
+
+        def driver():
+            cmd = 0
+            t0 = time.perf_counter()
+            for i, (at, op, key, value) in enumerate(schedule):
+                delay = at - (time.perf_counter() - t0)
+                if delay > 0.0002:
+                    yield delay
+                if op != "Get":
+                    cmd += 1
+                args = EngineCmdArgs(
+                    op=op, key=key, value=value,
+                    client_id=client_id, command_id=cmd,
+                )
+                t_send = time.perf_counter()
+                fut = end.call(
+                    f"{service}.command", args, trace=f"ol.{i}"
+                )
+                fut.add_done_callback(make_done(i, t_send))
+            return time.perf_counter() - t0
+
+        wall = sched.wait(sched.spawn(driver()), duration + 120.0)
+        assert wall is not TIMEOUT, "open-loop driver wedged"
+        # Drain grace: let in-flight replies land (stop early once all
+        # have; under true overload some never will — those are drops).
+        deadline = time.monotonic() + drain_s
+        while time.monotonic() < deadline:
+            if all(v is not None for v in lats):
+                break
+            time.sleep(0.05)
+
+        h = Hist()
+        for v in lats:
+            if v is not None:
+                h.observe(v)
+        replied = h.count
+        ok = sum(oks)
+        p50 = h.percentile(0.50)
+        p99 = h.percentile(0.99)
+        return {
+            "sent": n,
+            "replied": replied,
+            "ok": ok,
+            "drops": n - replied,
+            "wall_s": round(float(wall), 3),
+            "achieved_ops_per_sec": round(ok / wall, 1) if wall else 0.0,
+            "client_p50_ms": round(1e3 * p50, 3) if p50 is not None else None,
+            "client_p99_ms": round(1e3 * p99, 3) if p99 is not None else None,
+            "client_mean_ms": (
+                round(1e3 * h.total / h.count, 3) if h.count else None
+            ),
+        }
+    finally:
+        node.close()
+
+
+# -- porcupine sampling -----------------------------------------------------
+
+class PorcupineSampler:
+    """Low-rate closed-loop clerk sampling linearizability THROUGHOUT
+    an open-loop sweep: two blocking clerks interleave Appends/Gets on
+    shared keys, recording wall-clock histories checked against the KV
+    model at :meth:`finish`.  Overload may delay or shed the samplers'
+    ops (they retry), but acknowledged state must stay linearizable —
+    running the checker clerk DURING overload is the point."""
+
+    def __init__(self, host: str, port: int, n_clerks: int = 2,
+                 period_s: float = 0.05) -> None:
+        self.host, self.port = host, port
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.history: List[Any] = []
+        self._threads = [
+            threading.Thread(target=self._run, args=(vi,), daemon=True)
+            for vi in range(n_clerks)
+        ]
+
+    def start(self) -> "PorcupineSampler":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _run(self, vi: int) -> None:
+        from multiraft_tpu.distributed.engine_cluster import (
+            BlockingEngineClerk,
+        )
+        from multiraft_tpu.porcupine.kv import (
+            OP_APPEND, OP_GET, KvInput, KvOutput,
+        )
+        from multiraft_tpu.porcupine.model import Operation
+
+        ck = BlockingEngineClerk(self.port, host=self.host)
+        try:
+            j = 0
+            while not self._stop.is_set():
+                key = f"olshared{j % 2}"
+                t0 = time.monotonic()
+                try:
+                    if j % 3 == 2:
+                        val = ck.get(key, timeout=60.0)
+                        inp = KvInput(op=OP_GET, key=key)
+                        out = KvOutput(value=val)
+                    else:
+                        tag = f"({vi}.{j})"
+                        ck.append(key, tag, timeout=60.0)
+                        inp = KvInput(op=OP_APPEND, key=key, value=tag)
+                        out = KvOutput(value="")
+                except TimeoutError:
+                    # Starved past the clerk timeout: ambiguous op —
+                    # recording it without a return edge would poison
+                    # the history, so drop it and keep sampling.
+                    j += 1
+                    continue
+                with self._lock:
+                    self.history.append(Operation(
+                        client_id=vi, input=inp, call=t0,
+                        output=out, ret=time.monotonic(),
+                    ))
+                j += 1
+                self._stop.wait(self.period_s)
+        finally:
+            ck.close()
+
+    def finish(self, timeout: float = 60.0) -> Dict[str, Any]:
+        """Stop sampling and porcupine-check the recorded history."""
+        from multiraft_tpu.porcupine.checker import check_operations
+        from multiraft_tpu.porcupine.kv import kv_model
+        from multiraft_tpu.porcupine.model import CheckResult
+
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=120.0)
+        with self._lock:
+            history = list(self.history)
+        if not history:
+            return {"porcupine": "empty", "verifier_ops": 0}
+        verdict = check_operations(kv_model, history, timeout=timeout)
+        assert verdict is not CheckResult.ILLEGAL, (
+            "open-loop sweep history not linearizable"
+        )
+        return {"porcupine": verdict.value, "verifier_ops": len(history)}
+
+
+# -- the sweep --------------------------------------------------------------
+
+DEFAULT_RATES = (250.0, 500.0, 1000.0, 2000.0, 4000.0, 8000.0)
+
+
+def sweep(
+    rates: Sequence[float] = DEFAULT_RATES,
+    step_s: float = 4.0,
+    mode: str = "poisson",
+    seed: int = 7,
+    groups: int = 64,
+    keyspace: int = 512,
+    p99_target_ms: float = 50.0,
+    verify: bool = True,
+    drain_s: float = 2.0,
+) -> Dict[str, Any]:
+    """Run the full open-loop rate ladder against one served engine
+    and return the LOADCURVE report (see module docstring)."""
+    from multiraft_tpu.distributed.engine_cluster import (
+        BlockingEngineClerk, EngineProcessCluster,
+    )
+    from multiraft_tpu.harness.loadcurve import build_loadcurve, run_sweep
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=groups, seed=41)
+    obs = None
+    sampler = None
+    try:
+        cluster.start()
+        # Warm both server tick variants before the ladder starts.
+        warm = BlockingEngineClerk(cluster.port, host=cluster.host)
+        warm.put("warm", "1")
+        warm.close()
+        obs = FleetObserver([(cluster.host, cluster.port)])
+        if verify:
+            sampler = PorcupineSampler(cluster.host, cluster.port).start()
+
+        def fire_step(rate: float) -> Dict[str, Any]:
+            sched = gen_schedule(
+                seed=seed + int(rate), rate=rate, duration=step_s,
+                mode=mode, keyspace=keyspace,
+            )
+            return fire_schedule(
+                cluster.host, cluster.port, sched, duration=step_s,
+                drain_s=drain_s,
+            )
+
+        steps = run_sweep(obs, fire_step, rates)
+        porc = sampler.finish() if sampler is not None else {
+            "porcupine": "skipped", "verifier_ops": 0,
+        }
+        out = build_loadcurve(steps, p99_target_ms=p99_target_ms)
+        out.update(porc)
+        out["mode"] = mode
+        out["seed"] = seed
+        out["step_s"] = step_s
+        out["keyspace"] = keyspace
+        return out
+    finally:
+        if sampler is not None and not sampler._stop.is_set():
+            sampler._stop.set()
+        if obs is not None:
+            obs.close()
+        cluster.shutdown()
+
+
+def main(argv: List[str]) -> None:
+    rates: Sequence[float] = DEFAULT_RATES
+    mode, step_s, seed, out_path, verify = "poisson", 4.0, 7, "", True
+    target = 50.0
+    it = iter(argv[1:])
+    for a in it:
+        if a == "--mode":
+            mode = next(it)
+        elif a == "--rates":
+            rates = [float(x) for x in next(it).split(",")]
+        elif a == "--step-s":
+            step_s = float(next(it))
+        elif a == "--seed":
+            seed = int(next(it))
+        elif a == "--out":
+            out_path = next(it)
+        elif a == "--p99-target-ms":
+            target = float(next(it))
+        elif a == "--no-verify":
+            verify = False
+        else:
+            raise SystemExit(f"unknown arg {a!r}")
+    report = sweep(
+        rates=rates, step_s=step_s, mode=mode, seed=seed,
+        p99_target_ms=target, verify=verify,
+    )
+    blob = json.dumps(report, indent=1)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+    print(blob, flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -51,6 +51,11 @@ BURST_MIN = 5
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
+# OVERLOAD record codes (overload.py writes them).
+_OVL_STAGE = flightrec.OVERLOAD_KIND_CODES["stage_p99"]
+_OVL_GAUGE = flightrec.OVERLOAD_KIND_CODES["gauge"]
+_OVL_CTX = flightrec.OVERLOAD_KIND_CODES["gauge_ctx"]
+
 
 # -- loading ---------------------------------------------------------------
 
@@ -260,6 +265,53 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                 "detail": detail,
                 "aligned": off is not None,
             })
+        # Overload-watch trips → ONE "queueing collapse" anomaly per
+        # ring, anchored on the FIRST saturated stage (a collapse can
+        # leave hundreds of trip records; the first one names where the
+        # queueing started).  The paired gauge_ctx record supplies the
+        # queue the collapse backed up into.
+        over = [r for r in recs if r["type"] == flightrec.OVERLOAD]
+        trips = [r for r in over if r["code"] != _OVL_CTX]
+        if trips:
+            first = trips[0]
+            gauge = next(
+                (r for r in over
+                 if r["code"] == _OVL_CTX and r["seq"] >= first["seq"]),
+                None,
+            ) or next(
+                (r for r in over
+                 if r["code"] == _OVL_GAUGE and r is not first),
+                None,
+            )
+            if first["code"] == _OVL_STAGE:
+                detail = (
+                    f"queueing collapse: first saturated stage "
+                    f"'{first['tag']}' windowed p99 "
+                    f"{first['a'] / 1e3:.1f}ms > bound "
+                    f"{first['b'] / 1e3:.1f}ms "
+                    f"({first['c']} sample(s) in window)"
+                )
+            else:
+                detail = (
+                    f"queueing collapse: queue gauge '{first['tag']}' "
+                    f"depth {first['a']} > bound {first['b']}"
+                )
+            if gauge is not None:
+                detail += (
+                    f"; queue gauge {gauge['tag']}={gauge['a']}"
+                    + (f" (bound {gauge['b']})" if gauge["b"] else "")
+                )
+            detail += f"; {len(trips)} overload trip(s) total"
+            anomalies.append({
+                "ts": aligned(first["ts"]), "proc": label,
+                "kind": "queueing_collapse", "detail": detail,
+                "aligned": off is not None,
+            })
+            info["overload"] = {
+                "trips": len(trips),
+                "first": first["tag"],
+                "gauge": gauge["tag"] if gauge is not None else None,
+            }
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more
@@ -352,6 +404,10 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                 out.instant(f"role:peer{r['code']}", ts, track="raft",
                             pid=pid, role=r["a"], term=r["b"],
                             commit=r["c"])
+            elif t == flightrec.OVERLOAD:
+                out.instant(f"overload:{r['tag']}", ts, track="overload",
+                            pid=pid, kind=r["code"], value=r["a"],
+                            bound=r["b"])
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
                             tag=r["tag"])
@@ -451,6 +507,13 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
                 f"    chaos '{path_tag}': {b['total']} fault(s), "
                 f"max burst {b['max_burst']}/"
                 f"{BURST_WINDOW_US / 1e6:.0f}s"
+            )
+        if "overload" in p:
+            o = p["overload"]
+            add(
+                f"    overload: {o['trips']} trip(s), first saturated: "
+                f"{o['first']}"
+                + (f", queue gauge {o['gauge']}" if o["gauge"] else "")
             )
 
     if analysis["lag"]:

@@ -53,6 +53,7 @@ from .engine_wire import (
     make_mesh,
     route_group,
 )
+from .overload import install_overload_watch
 from .realtime import (
     PumpCadence,
     RealtimeScheduler,
@@ -410,10 +411,11 @@ class EngineKVService:
             t = self.kv.get(g, args.key)
             return EngineCmdReply(err=OK, value=t.value)
 
-        # The caller's request id, captured NOW (handler entry runs on
-        # the dispatch breadcrumb; the generator body runs later, when
-        # _cur_trace belongs to someone else).
+        # The caller's request id + stage clock, captured NOW (handler
+        # entry runs on the dispatch breadcrumb; the generator body
+        # runs later, when _cur_trace belongs to someone else).
         rid = self.obs.current_trace()
+        stages = self.obs.current_stages()
         self.m.inc("kv.writes")
 
         # Write path: generator handler — yields let the pump advance.
@@ -431,12 +433,23 @@ class EngineKVService:
                         command_id=args.command_id,
                     ),
                 )
+                if stages is not None and not stages.engine:
+                    # First submit closes the handler leg; resubmits
+                    # stay inside the engine leg (they ARE the engine's
+                    # latency under leader churn).
+                    stages.engine = True
+                    stages.fold(self.m, "handler")
                 sub_deadline = min(
                     self.sched.now + self.RESUBMIT_S, deadline
                 )
                 while not t.done and self.sched.now < sub_deadline:
                     yield 0.002
                 if t.done and not t.failed:
+                    if stages is not None:
+                        # Commit observed: submit → raft quorum +
+                        # apply.  The durability gate below lands in
+                        # the ack leg (folded at dispatch completion).
+                        stages.fold(self.m, "engine")
                     # Ack only once the apply-time WAL record is
                     # fsynced (absent = pruned = already durable, or
                     # a duplicate applied before this incarnation).
@@ -576,6 +589,9 @@ def serve_engine_kv(
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("EngineKV", svc)
     node.engine_service = svc  # keep reachable for introspection
+    # Overload watch (overload.py): windowed stage-p99 + queue-gauge
+    # bounds → OVERLOAD flight records, while the collapse is live.
+    install_overload_watch(node)
     return node
 
 # Backwards-compatible re-exports: engine_server was the single module

@@ -549,9 +549,10 @@ class EngineShardKVService:
 
             return run_get()
 
-        # Request id captured at handler entry (dispatch breadcrumb —
-        # see EngineKVService.command).
+        # Request id + stage clock captured at handler entry (dispatch
+        # breadcrumb — see EngineKVService.command).
         rid = self.obs.current_trace()
+        stages = self.obs.current_stages()
         self.m.inc("kv.writes")
 
         def run():
@@ -570,6 +571,11 @@ class EngineShardKVService:
                     gid, args.op, args.key, args.value,
                     client_id=args.client_id, command_id=args.command_id,
                 )
+                if stages is not None and not stages.engine:
+                    # First submit closes the handler leg (routing +
+                    # config queries); re-routes stay in the engine leg.
+                    stages.engine = True
+                    stages.fold(self.m, "handler")
                 sub_deadline = min(
                     self.sched.now + self.RESUBMIT_S, deadline
                 )
@@ -577,6 +583,10 @@ class EngineShardKVService:
                     yield 0.002
                 if not t.done or t.failed or t.err == ERR_WRONG_GROUP:
                     continue  # resubmit / re-route; dedup-safe
+                if stages is not None:
+                    # Commit observed; the fsync gate below lands in
+                    # the ack leg (folded at dispatch completion).
+                    stages.fold(self.m, "engine")
                 # Ack gates on the apply-time WAL record being fsynced
                 # (absent = pruned/duplicate = already durable).
                 while self._dur is not None:
@@ -735,4 +745,8 @@ def serve_engine_shardkv(
     svc = sched.run_call(build, timeout=600.0)
     node.add_service("EngineShardKV", svc)
     node.engine_service = svc
+    # Overload watch: stage-p99/queue-gauge bounds → OVERLOAD records.
+    from .overload import install_overload_watch
+
+    install_overload_watch(node)
     return node

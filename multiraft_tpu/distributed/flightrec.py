@@ -94,6 +94,8 @@ ROLE = 10        # code=peer_id a=role b=term c=commit_index
 NODE_CLOSE = 11  # clean shutdown marker      tag=name
 MARK = 12        # free-form harness marker   tag=text
 SANITIZE = 13    # code=kind a=value b=limit  tag=label (sanitize.py)
+OVERLOAD = 14    # code=kind a=value(µs/depth) b=bound c=window_count
+#                  tag=stage-or-gauge name (overload.py watch)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -109,6 +111,7 @@ _TYPE_NAMES = {
     NODE_CLOSE: "node_close",
     MARK: "mark",
     SANITIZE: "sanitize",
+    OVERLOAD: "overload",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.
@@ -117,6 +120,18 @@ CHAOS_KIND_CODES = {"drop": 1, "delay": 2, "block": 3}
 # Runtime-sanitizer violation kinds → compact codes for SANITIZE
 # records (sanitize.py; the postmortem doctor names them back).
 SANITIZE_KIND_CODES = {"lock_order": 1, "queue_bound": 2, "callback_budget": 3}
+
+# Overload-watch trip kinds → compact codes for OVERLOAD records
+# (overload.py; the doctor folds them into "queueing collapse").
+# stage_p99: a windowed stage histogram's p99 crossed its bound
+#            (a=p99_us b=bound_us c=window_count tag=stage name).
+# gauge:     a queue-depth gauge crossed its bound
+#            (a=depth b=bound tag=gauge name).
+# gauge_ctx: the deepest gauge at the moment a stage tripped — context
+#            for the doctor's "first saturated stage + its queue gauge"
+#            naming, recorded even when that gauge is under its own
+#            bound (a=depth b=bound tag=gauge name).
+OVERLOAD_KIND_CODES = {"stage_p99": 1, "gauge": 2, "gauge_ctx": 3}
 
 
 def type_name(etype: int) -> str:

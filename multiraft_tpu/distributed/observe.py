@@ -40,10 +40,14 @@ from ..utils.trace import Tracer
 __all__ = [
     "Observability",
     "ObsControl",
+    "StageClock",
     "install_obs",
     "is_control",
     "now_us",
+    "stageclock_enabled",
+    "stage_metric",
     "CONTROL_PREFIXES",
+    "STAGES",
 ]
 
 # Control-plane RPC prefixes exempt from fault injection everywhere
@@ -58,6 +62,87 @@ def is_control(svc_meth: str) -> bool:
 def now_us() -> float:
     """This process's trace clock (µs, arbitrary epoch, monotonic)."""
     return time.perf_counter() * 1e6
+
+
+# ---------------------------------------------------------------------------
+# Per-stage latency decomposition (the stage clock)
+# ---------------------------------------------------------------------------
+#
+# A tagged request is stamped at each hop of its life and the deltas
+# fold into per-stage log-bucket histograms (Metrics.hists), named
+# ``stage.<name>_s``:
+#
+#   wire     clerk ``call()`` → server socket read.  Both stamps are
+#            CLOCK_MONOTONIC (machine-wide on Linux), so on one box the
+#            delta is exact; across machines it absorbs the clock
+#            offset and the fleet aggregator's min-RTT alignment is the
+#            corrective lens.  Under overload this stage is where the
+#            kernel socket backlog shows up — frames queue in the TCP
+#            buffer while the loop thread is busy pumping.
+#   dispatch socket read → handler dispatch (decode, chaos delay, the
+#            loop's own event backlog).
+#   handler  dispatch → engine submit (engine ops) or handler return
+#            (plain RPCs).
+#   engine   submit → raft commit observed (ticket resolution: tick
+#            batches + quorum + apply).  Engine ops only.
+#   ack      commit → reply enqueued (durability gate: fsync frontier /
+#            checkpoint waits).  Engine ops only.
+#   flush    reply enqueued → vectored write handed to the kernel (the
+#            reply-coalescing wait).
+#
+# Clerk side, ``total`` (call → reply) folds into the CLIENT node's
+# registry — the end-to-end number the load curve plots against the
+# server-side decomposition.
+#
+# ``MRT_STAGECLOCK=0`` compiles the whole plane out (no send stamp, no
+# StageClock allocation, no folds) — the A/B lever for the overhead
+# budget in BENCHMARKS.
+
+STAGES = ("wire", "dispatch", "handler", "engine", "ack", "flush", "total")
+
+_STAGECLOCK = os.environ.get("MRT_STAGECLOCK", "1") not in ("", "0")
+
+
+def stageclock_enabled() -> bool:
+    """True unless MRT_STAGECLOCK=0 (read once at import)."""
+    return _STAGECLOCK
+
+
+def stage_metric(stage: str) -> str:
+    """Histogram name for a stage (``wire`` → ``stage.wire_s``)."""
+    return f"stage.{stage}_s"
+
+
+class StageClock:
+    """Mutable per-request stamp carrier (loop-thread only).
+
+    Created at dispatch from the wire element's ``(rid, t_send)``; each
+    ``fold`` observes now−last into the stage histogram and advances
+    ``last``, so consecutive folds decompose the request's life into
+    adjacent, non-overlapping intervals.  ``engine`` flags that the
+    engine service folded handler/engine stages, so the dispatcher's
+    completion fold knows whether it is closing ``ack`` (engine op) or
+    ``handler`` (plain RPC).
+    """
+
+    __slots__ = ("rid", "last", "engine")
+
+    def __init__(self, rid: str, last: float) -> None:
+        self.rid = rid
+        self.last = last
+        self.engine = False
+
+    def fold(
+        self, metrics: Metrics, stage: str, now: Optional[float] = None
+    ) -> float:
+        if now is None:
+            now = time.perf_counter()
+        dt = now - self.last
+        if dt < 0.0:
+            dt = 0.0
+        metrics.observe(f"stage.{stage}_s", dt)
+        self.last = now
+        return dt
 
 
 class Observability:
@@ -85,6 +170,14 @@ class Observability:
         n = self.node
         return getattr(n, "_cur_trace", None) if n is not None else None
 
+    def current_stages(self) -> Optional[StageClock]:
+        """The stage clock of the RPC being dispatched right now, if any
+        (loop-thread breadcrumb, same discipline as current_trace) —
+        lets the engine service fold handler/engine/ack stages onto the
+        clock the dispatcher started."""
+        n = self.node
+        return getattr(n, "_cur_stages", None) if n is not None else None
+
 
 class ObsControl:
     """The ``"Obs"`` service: scrape verbs over the node's own plane."""
@@ -105,6 +198,7 @@ class ObsControl:
             "pid": os.getpid(),
             "now_us": now_us(),
             "metrics": obs.metrics.snapshot(),
+            "gauges": self.gauges(),
         }
         chaos = getattr(self._node, "chaos", None)
         if chaos is not None:
@@ -113,6 +207,50 @@ class ObsControl:
         if groups is not None:
             out["groups"] = groups
         return out
+
+    def gauges(self, args: Any = None) -> Dict[str, float]:
+        """Live queue-depth / in-flight gauges — saturation visible in
+        a scrape, not only in a postmortem.  Runs on the loop thread
+        (all Obs verbs dispatch there), so reading the loop-thread-only
+        reply queues is safe; engine attributes are getattr-guarded for
+        nodes without an engine service."""
+        node = self._node
+        out: Dict[str, float] = {}
+        outq = getattr(node, "_outq", None)
+        if outq is not None:
+            out["gauge.replyq"] = float(sum(len(v) for v in outq.values()))
+        pending = getattr(node, "_pending", None)
+        if pending is not None:
+            out["gauge.inflight"] = float(len(pending))
+        svc = getattr(node, "engine_service", None)
+        if svc is not None:
+            driver = getattr(getattr(svc, "kv", None), "driver", None)
+            backlog = getattr(driver, "backlog", None)
+            if backlog is not None:
+                out["gauge.backlog"] = float(backlog.sum())
+            ws = getattr(svc, "_write_seqs", None)
+            if ws is not None:
+                out["gauge.wal_unsynced"] = float(len(ws))
+            wal = getattr(getattr(svc, "_dur", None), "wal", None)
+            if wal is not None:
+                out["gauge.wal_pending"] = float(
+                    wal.appended - wal.synced
+                )
+        return out
+
+    def hist(self, args: Any = None) -> Dict[str, Any]:
+        """Cumulative log-bucket histogram dumps + live gauges — the
+        fleet scraper's verb.  Cumulative by design: two scrapes diff
+        into the window between them (Hist.sub), so repeated scrapes
+        are idempotent reads, never destructive drains."""
+        obs = self._node.obs
+        return {
+            "name": obs.name,
+            "pid": os.getpid(),
+            "now_us": now_us(),
+            "hists": obs.metrics.hist_dumps(),
+            "gauges": self.gauges(),
+        }
 
     def groups(self, args: Any = None) -> Optional[Dict[str, Any]]:
         """Per-raft-group introspection (columnar, one entry per group):

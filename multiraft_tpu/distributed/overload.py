@@ -1,0 +1,177 @@
+"""Overload visibility: a periodic watch that turns saturation into
+flight-recorder evidence *while it is happening*.
+
+The closed-loop harness never sees queueing collapse — clerks wait for
+replies, so offered load self-throttles.  Under open-loop traffic
+(benchmarks/openloop.py) an overloaded server grows queues until
+latency diverges; before this module the only witnesses were the
+post-hoc flight recorder ring and whatever Obs.snapshot happened to be
+scraped.  The watch closes that gap: every ``interval`` seconds it
+
+* diffs each ``stage.*_s`` histogram against its previous scrape
+  (``Hist.sub`` — the same windowing the fleet aggregator uses) and
+  checks the WINDOWED p99 against a per-stage bound, and
+* reads the live queue-depth gauges (tcp reply queues, engine dispatch
+  backlog, WAL pending — ObsControl.gauges) against their bounds.
+
+A crossing writes an ``OVERLOAD`` record (flightrec.py) naming the
+stage or gauge, its value, and its bound; a stage trip additionally
+records the deepest queue gauge at that instant (``gauge_ctx``) so the
+postmortem doctor can name the first saturated stage *and* the queue
+it backed up into — the "queueing collapse" anomaly.  Metrics mirror:
+``overload.trips`` counts crossings, ``overload.active`` gauges how
+many names are currently over bound (scrapeable mid-run, e.g. by the
+load-curve sweep).
+
+Bounds (env-tunable):
+
+* ``MRT_OVERLOAD_P99_MS``   windowed stage-p99 bound, ms (default 100)
+* ``MRT_OVERLOAD_REPLYQ``   total queued replies (default 1024)
+* ``MRT_OVERLOAD_BACKLOG``  engine dispatch backlog (default 4096)
+* ``MRT_OVERLOAD_WAL``      WAL appended-but-unsynced (default 4096)
+* ``MRT_OVERLOAD_INTERVAL`` watch period, seconds (default 0.25)
+* ``MRT_OVERLOAD_WATCH=0``  disable the watch entirely
+
+The watch runs on the node's scheduler loop (same thread as dispatch),
+so reading the loop-thread-only reply queues is safe; each tick costs
+a handful of 128-int diffs — far below one pump tick.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..utils.metrics import Hist
+from . import flightrec
+from .observe import ObsControl
+
+__all__ = ["OverloadWatch", "install_overload_watch"]
+
+# Minimum samples in a window before its p99 means anything — a
+# two-sample window's "p99" is just its max.
+_MIN_WINDOW_COUNT = 20
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class OverloadWatch:
+    """Periodic stage-p99 + queue-gauge bound check on one node."""
+
+    def __init__(self, node: Any, interval: Optional[float] = None) -> None:
+        self.node = node
+        self.interval = (
+            interval if interval is not None
+            else _env_f("MRT_OVERLOAD_INTERVAL", 0.25)
+        )
+        self.p99_bound_s = _env_f("MRT_OVERLOAD_P99_MS", 100.0) / 1e3
+        self.gauge_bounds: Dict[str, float] = {
+            "gauge.replyq": _env_f("MRT_OVERLOAD_REPLYQ", 1024.0),
+            "gauge.backlog": _env_f("MRT_OVERLOAD_BACKLOG", 4096.0),
+            "gauge.wal_pending": _env_f("MRT_OVERLOAD_WAL", 4096.0),
+        }
+        self._ctl = ObsControl(node)
+        self._prev: Dict[str, Hist] = {}  # stage hist snapshots, last tick
+        self._stopped = False
+        node.sched.call_after(self.interval, self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # -- one watch tick ---------------------------------------------------
+
+    def _tick(self) -> None:
+        if self._stopped or getattr(self.node, "_closed", False):
+            return
+        try:
+            self.check()
+        except Exception:
+            # The watch must never take the serving loop down.
+            self.node.obs.metrics.inc("overload.watch_errors")
+        self.node.sched.call_after(self.interval, self._tick)
+
+    def check(self) -> int:
+        """Run one bound check; returns the number of crossings."""
+        m = self.node.obs.metrics
+        frec = getattr(self.node, "_frec", None)
+        gauges = self._ctl.gauges()
+        trips = 0
+        stage_tripped = False
+
+        # Windowed stage p99s: cumulative hist minus last tick's copy.
+        for name, h in list(m.hists.items()):
+            if not name.startswith("stage."):
+                continue
+            prev = self._prev.get(name)
+            window = Hist.sub(h, prev) if prev is not None else h
+            # Snapshot for next tick (copy via dump round trip is
+            # wasteful; clone counts directly).
+            snap = Hist()
+            snap.counts = list(h.counts)
+            snap.count = h.count
+            snap.total = h.total
+            snap.vmin = h.vmin
+            snap.vmax = h.vmax
+            self._prev[name] = snap
+            if window.count < _MIN_WINDOW_COUNT:
+                continue
+            p99 = window.percentile(0.99)
+            if p99 is None or p99 <= self.p99_bound_s:
+                continue
+            trips += 1
+            stage_tripped = True
+            m.inc("overload.trips")
+            if frec is not None:
+                frec.record(
+                    flightrec.OVERLOAD,
+                    code=flightrec.OVERLOAD_KIND_CODES["stage_p99"],
+                    a=int(p99 * 1e6), b=int(self.p99_bound_s * 1e6),
+                    c=window.count, tag=name,
+                )
+
+        # Queue gauges against their bounds.
+        for gname, bound in self.gauge_bounds.items():
+            val = gauges.get(gname)
+            if val is None or val <= bound:
+                continue
+            trips += 1
+            m.inc("overload.trips")
+            if frec is not None:
+                frec.record(
+                    flightrec.OVERLOAD,
+                    code=flightrec.OVERLOAD_KIND_CODES["gauge"],
+                    a=int(val), b=int(bound), tag=gname,
+                )
+
+        # Context record: the deepest queue at the moment a stage
+        # tripped, even if under its own bound — the doctor pairs it
+        # with the first saturated stage.
+        if stage_tripped and frec is not None and gauges:
+            deepest = max(gauges, key=lambda k: gauges[k])
+            frec.record(
+                flightrec.OVERLOAD,
+                code=flightrec.OVERLOAD_KIND_CODES["gauge_ctx"],
+                a=int(gauges[deepest]),
+                b=int(self.gauge_bounds.get(deepest, 0)),
+                tag=deepest,
+            )
+        m.set("overload.active", float(trips))
+        return trips
+
+
+def install_overload_watch(
+    node: Any, interval: Optional[float] = None
+) -> Optional[OverloadWatch]:
+    """Attach the watch to a serving node (no-op when
+    ``MRT_OVERLOAD_WATCH=0``).  Returns the watch, kept reachable on
+    ``node.overload_watch``."""
+    if os.environ.get("MRT_OVERLOAD_WATCH", "1") in ("", "0"):
+        return None
+    watch = OverloadWatch(node, interval=interval)
+    node.overload_watch = watch
+    return watch

@@ -72,7 +72,13 @@ from ..sim.scheduler import Future
 from ..transport import codec
 from . import flightrec
 from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
-from .observe import Observability, install_obs, is_control
+from .observe import (
+    Observability,
+    StageClock,
+    install_obs,
+    is_control,
+    stageclock_enabled,
+)
 from .realtime import IoScheduler
 from .sanitize import get_sanitizer
 
@@ -182,6 +188,18 @@ class RpcNode:
         self.obs = Observability(name=name)
         self.obs.node = self
         self._cur_trace: Optional[str] = None
+        # Stage-clock plane (observe.py): tagged requests carry their
+        # send stamp in the rid wire element and every hop folds a
+        # delta into a per-stage histogram.  MRT_STAGECLOCK=0 compiles
+        # it out — no stamp, no StageClock, no folds (the A/B lever
+        # for the overhead budget).
+        self._stageclock = stageclock_enabled()
+        self._cur_stages: Optional[StageClock] = None
+        # conn → reply-enqueue perf_counter stamps, strictly parallel
+        # to _outq (appended/shed/flushed/closed together), so the
+        # flush fold knows how long each reply coalesced.  LOOP THREAD
+        # ONLY, bounded by _REPLY_Q_CAP like its twin.
+        self._outq_stamps: Dict[int, List[float]] = {}
         install_obs(self)
         # Crash-surviving black box (flightrec.py): fixed-width event
         # records in an mmap ring, shared process-wide, env-gated
@@ -296,6 +314,12 @@ class RpcNode:
         fut = Future()
         m = self.obs.metrics
         m.inc("rpc.calls")
+        if trace_id is not None and self._stageclock:
+            # The clerk-send stamp: the rid element becomes
+            # (rid, t_send).  CLOCK_MONOTONIC is machine-wide, so the
+            # server can fold the wire leg directly on one box; the
+            # fleet aggregator's clock alignment covers the rest.
+            trace_id = (trace_id, time.perf_counter())
         chaos = self.chaos
         if chaos is not None and not is_control(svc_meth):
             act = chaos.decide_out(addr)
@@ -377,6 +401,7 @@ class RpcNode:
             # One malformed frame must never kill the loop — the node
             # would go permanently dark.  Shape errors (IndexError on
             # msg[...]) are as fatal as decode errors.
+            t_read = time.perf_counter() if self._stageclock else None
             m = self.obs.metrics
             m.inc("rpc.frames_in")
             m.inc("rpc.bytes_in", len(payload))
@@ -410,7 +435,7 @@ class RpcNode:
                             act, self._handle_msg, conn, msg
                         )
                         return
-                self._handle_msg(conn, msg)
+                self._handle_msg(conn, msg, t_read)
             except Exception as exc:
                 m.inc("rpc.bad_frames")
                 if self._dbg:
@@ -423,11 +448,13 @@ class RpcNode:
             self._accepted.discard(conn)
             self._on_closed(conn)
 
-    def _handle_msg(self, conn: int, msg: Any) -> None:
+    def _handle_msg(
+        self, conn: int, msg: Any, t_read: Optional[float] = None
+    ) -> None:
         if msg[0] == "req":
             # 4-tuple = untagged (old wire shape); 5th element = trace id.
             trace_id = msg[4] if len(msg) > 4 else None
-            self._dispatch(conn, msg[1], msg[2], msg[3], trace_id)
+            self._dispatch(conn, msg[1], msg[2], msg[3], trace_id, t_read)
         elif msg[0] == "rep":
             _, req_id, value = msg
             self._complete(req_id, value)
@@ -460,6 +487,13 @@ class RpcNode:
             _, fut, svc_meth, t0, trace_id = entry
             dt = time.perf_counter() - t0
             self.obs.metrics.observe("rpc.client.call_s", dt)
+            if type(trace_id) is tuple:
+                # Stage-clocked call: rid element is (rid, t_send).
+                # Fold the end-to-end leg on the CLIENT's registry —
+                # the number the load curve plots against the
+                # server-side decomposition.
+                trace_id = trace_id[0]
+                self.obs.metrics.observe("stage.total_s", dt)
             fr = self._frec
             if fr is not None and not is_control(svc_meth):
                 fr.record(
@@ -478,6 +512,7 @@ class RpcNode:
         # Mid-stream loss drops queued-but-unflushed replies with the
         # connection — same contract as bytes lost in the kernel buffer.
         self._outq.pop(conn, None)
+        self._outq_stamps.pop(conn, None)
         self._peer_caps.pop(conn, None)
         self._hello_sent.discard(conn)
         with self._lock:
@@ -503,6 +538,7 @@ class RpcNode:
         svc_meth: str,
         args: Any,
         trace_id: Optional[str] = None,
+        t_read: Optional[float] = None,
     ) -> None:
         # Runs on the scheduler loop.  Control replies bypass reply
         # chaos (same exemption as the inbound path).
@@ -510,6 +546,23 @@ class RpcNode:
         obs = self.obs
         obs.metrics.inc("rpc.handled")
         t0 = time.perf_counter()
+
+        # Stage clock: a tuple rid element is (rid, t_send) from a
+        # stage-clocked caller.  Fold the wire leg (send → socket read)
+        # and the dispatch leg (read → here: decode, chaos delay, loop
+        # backlog), then hand the clock to the handler via the
+        # loop-thread breadcrumb.
+        st = None
+        if type(trace_id) is tuple:
+            rid, t_send = trace_id
+            trace_id = rid
+            if self._stageclock:
+                st = StageClock(rid, t_send)
+                st.fold(
+                    obs.metrics, "wire",
+                    t_read if t_read is not None else t0,
+                )
+                st.fold(obs.metrics, "dispatch", t0)
 
         # Span dicts are only built when someone will read them: a
         # tagged request (cross-process follow-the-id) or a trace-dir
@@ -521,6 +574,11 @@ class RpcNode:
         def _done(conn_, req_id_, value):
             dt = time.perf_counter() - t0
             obs.metrics.observe("rpc.handle_s", dt)
+            if st is not None:
+                # Engine handlers folded handler/engine themselves and
+                # this closes the ack leg (commit → reply enqueue);
+                # plain handlers close their whole body as handler.
+                st.fold(obs.metrics, "ack" if st.engine else "handler")
             if frec is not None and not is_control(svc_meth):
                 frec.record(
                     flightrec.RPC_HANDLE, a=int(dt * 1e6),
@@ -551,6 +609,7 @@ class RpcNode:
             # service code can tag downstream spans with it.
             self._cur_conn = conn
             self._cur_trace = trace_id
+            self._cur_stages = st
             result = handler(args)
         except Exception:
             obs.metrics.inc("rpc.handler_errors")
@@ -597,6 +656,14 @@ class RpcNode:
                 q.pop(0)  # shed-oldest: that caller already retried
                 self.obs.metrics.inc("rpc.reply_shed")
             q.append((req_id, value))
+            if self._stageclock:
+                # Parallel enqueue stamp for the flush-stage fold;
+                # shed/flushed/closed in lockstep with q above, so the
+                # reply cap bounds this list too.
+                sq = self._outq_stamps.setdefault(conn, [])
+                if len(sq) >= len(q):
+                    sq.pop(0)  # twin of the shed above
+                sq.append(time.perf_counter())  # graftlint: disable=unbounded-queue
             if self._san is not None:
                 self._san.guard_queue("rpc.outq", len(q), _REPLY_Q_CAP)
             # Bulk blob replies (a firehose frame's results) gate a
@@ -641,7 +708,16 @@ class RpcNode:
         ):
             return
         self._outq = {}
+        stamps_by_conn, self._outq_stamps = self._outq_stamps, {}
         m = self.obs.metrics
+        if stamps_by_conn:
+            # Flush-stage fold: how long each reply coalesced between
+            # enqueue and this vectored write (stat-only; folded even
+            # for a failed send — the reply left the queue either way).
+            t_flush = time.perf_counter()
+            for stamps in stamps_by_conn.values():
+                for ts in stamps:
+                    m.observe("stage.flush_s", t_flush - ts)
         for conn, pairs in q.items():
             caps = self._peer_caps.get(conn)
             oob = caps is not None and "oob" in caps

@@ -1,8 +1,10 @@
 """Test harnesses: sim-backend drivers (cluster.py, kv_harness.py,
-ctrler_harness.py), the real-socket nemesis (nemesis.py), and the
-fleet observability scraper (observe.py)."""
+ctrler_harness.py), the real-socket nemesis (nemesis.py), the fleet
+observability scraper (observe.py), and the load-curve aggregator +
+knee finder over open-loop sweeps (loadcurve.py)."""
 
 from .bundle import collect_bundle
+from .loadcurve import build_loadcurve, find_knee, max_sustainable, run_sweep
 from .nemesis import (
     ChaosClient,
     Nemesis,
@@ -17,7 +19,11 @@ __all__ = [
     "FleetObserver",
     "Nemesis",
     "NemesisVerificationError",
+    "build_loadcurve",
     "collect_bundle",
+    "find_knee",
     "make_schedule",
+    "max_sustainable",
     "run_clerk_load",
+    "run_sweep",
 ]

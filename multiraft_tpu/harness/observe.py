@@ -116,6 +116,20 @@ class FleetObserver:
     def drain_trace(self, addr: Addr) -> Optional[Dict[str, Any]]:
         return self.call(addr, "trace", timeout=5.0)
 
+    def hist(self, addr: Addr) -> Optional[Dict[str, Any]]:
+        """One process's CUMULATIVE latency-histogram dumps + live
+        queue gauges (``Obs.hist``).  Cumulative by design: callers
+        window by diffing two scrapes (``Hist.sub``), so the scrape is
+        read-only and concurrent observers can't clobber each other —
+        harness/loadcurve.py is the aggregating caller."""
+        return self.call(addr, "hist", timeout=5.0)
+
+    def hist_all(self) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Scrape ``Obs.hist`` fleet-wide: ``{"host:port": dump}``,
+        with ``None`` for unreachable processes (explicit, same as
+        :meth:`snapshot_all`'s missing markers)."""
+        return {f"{a[0]}:{a[1]}": self.hist(a) for a in self.addrs}
+
     # -- clock alignment ---------------------------------------------------
 
     def clock_offset_us(

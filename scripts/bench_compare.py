@@ -1,13 +1,24 @@
-"""Compare a fresh bench.py result against the recorded trajectory.
+"""Compare a fresh benchmark result against its recorded trajectory.
 
-    python scripts/bench_compare.py FRESH.json [--threshold PCT]
+    python scripts/bench_compare.py FRESH.json [--family NAME]
+                                    [--threshold PCT]
                                     [--history 'BENCH_r*.json'] [--quiet]
 
-``FRESH.json`` is either bench.py's summary object (the ``bench:
-summary {...}`` JSON: ``value`` commits/s, ``p99_commit_latency_ms``,
-``failover_p99_ms``, ...) or a round wrapper (``{"parsed": {...}}``,
-the ``BENCH_r*.json`` shape).  The history is every ``BENCH_r*.json``
-in the repo root (override with ``--history``).
+Three result FAMILIES share one comparison engine, selected with
+``--family`` (default ``bench`` — the CI invocation predates families
+and must keep meaning what it meant):
+
+* ``bench`` — bench.py summaries tracked as ``BENCH_r*.json``
+  (``value`` commits/s, ``p99_commit_latency_ms``, ...);
+* ``serving`` — serving_throughput.py firehose reports tracked as
+  ``SERVING_r*.json`` (socket + in-process ops/s);
+* ``loadcurve`` — benchmarks/openloop.py open-loop sweeps tracked as
+  ``LOADCURVE_r*.json`` (max sustainable rate at the p99 target, knee
+  position, p99 at the knee).
+
+``FRESH.json`` is either the family's raw result object or a round
+wrapper (``{"parsed": {...}}``).  The history is every round file of
+the family in the repo root (override with ``--history``).
 
 Prints one table row per tracked metric: the full round trajectory,
 the fresh value, and the delta against the LATEST round.  Exit status:
@@ -40,12 +51,37 @@ from typing import Any, Dict, List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# (key, label, higher_is_better)
-METRICS: List[Tuple[str, str, bool]] = [
-    ("value", "commits/s", True),
-    ("p99_commit_latency_ms", "p99 commit latency (ms)", False),
-    ("failover_p99_ms", "failover p99 (ms)", False),
-]
+# Per-family metric tables: (key, label, higher_is_better).  Direction
+# matters — throughput regresses DOWN, latency regresses UP; a metric
+# moving the good way never fails the gate.
+FAMILIES: Dict[str, Dict[str, Any]] = {
+    "bench": {
+        "history": "BENCH_r*.json",
+        "strip": "BENCH_",
+        "metrics": [
+            ("value", "commits/s", True),
+            ("p99_commit_latency_ms", "p99 commit latency (ms)", False),
+            ("failover_p99_ms", "failover p99 (ms)", False),
+        ],
+    },
+    "serving": {
+        "history": "SERVING_r*.json",
+        "strip": "SERVING_",
+        "metrics": [
+            ("firehose_sockets_ops_per_sec", "sockets ops/s", True),
+            ("firehose_inprocess_ops_per_sec", "in-process ops/s", True),
+        ],
+    },
+    "loadcurve": {
+        "history": "LOADCURVE_r*.json",
+        "strip": "LOADCURVE_",
+        "metrics": [
+            ("max_sustainable_ops_per_sec", "max sustainable ops/s", True),
+            ("knee_ops_per_sec", "knee offered rate (ops/s)", True),
+            ("p99_at_knee_ms", "p99 at knee (ms)", False),
+        ],
+    },
+}
 
 
 def load_result(path: str) -> Dict[str, Any]:
@@ -99,19 +135,21 @@ def compare(
     fresh: Dict[str, Any],
     history: List[Tuple[str, Dict[str, Any]]],
     threshold_pct: float,
+    family: str = "bench",
 ) -> Tuple[List[str], List[str]]:
     """Returns ``(table_lines, regressions)``; empty regressions means
     every shared metric is within the threshold of the latest round."""
+    fam = FAMILIES[family]
     lines: List[str] = []
     regressions: List[str] = []
     latest_name, latest = history[-1] if history else ("(none)", {})
     lines.append(
         f"{'metric':28s} "
-        + " ".join(f"{name.replace('BENCH_', ''):>10s}"
+        + " ".join(f"{name.replace(fam['strip'], ''):>10s}"
                    for name, _ in history)
         + f" {'fresh':>10s} {'delta':>9s}"
     )
-    for key, label, higher_better in METRICS:
+    for key, label, higher_better in fam["metrics"]:
         fv = _get(fresh, key)
         traj = [_get(doc, key) for _, doc in history]
         lv = _get(latest, key)
@@ -136,18 +174,27 @@ def compare(
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="bench_compare")
-    ap.add_argument("fresh", help="fresh bench.py JSON result")
+    ap.add_argument("fresh", help="fresh benchmark JSON result")
+    ap.add_argument(
+        "--family", choices=sorted(FAMILIES), default="bench",
+        help="result family: picks the metric table and the default "
+             "history glob (default bench)",
+    )
     ap.add_argument(
         "--threshold", type=float, default=5.0,
         help="regression threshold in percent (default 5)",
     )
     ap.add_argument(
-        "--history", default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
-        help="glob of recorded rounds (default repo-root BENCH_r*.json)",
+        "--history", default=None,
+        help="glob of recorded rounds (default: the family's "
+             "<FAMILY>_r*.json in the repo root)",
     )
     ap.add_argument("--quiet", action="store_true",
                     help="print only regressions")
     ns = ap.parse_args(argv)
+    pattern = ns.history or os.path.join(
+        REPO_ROOT, FAMILIES[ns.family]["history"]
+    )
 
     try:
         fresh = load_result(ns.fresh)
@@ -155,15 +202,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"bench_compare: cannot read fresh result: {exc}",
               file=sys.stderr)
         return 2
-    history = load_history(ns.history)
+    history = load_history(pattern)
     if not history:
         print(
-            f"bench_compare: no readable history at {ns.history!r}; "
+            f"bench_compare: no readable history at {pattern!r}; "
             f"nothing to compare against", file=sys.stderr,
         )
         return 2
 
-    lines, regressions = compare(fresh, history, ns.threshold)
+    lines, regressions = compare(fresh, history, ns.threshold, ns.family)
     if not ns.quiet:
         print("\n".join(lines))
     if regressions:

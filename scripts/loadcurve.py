@@ -1,0 +1,120 @@
+"""Run the open-loop load-curve sweep and record the round.
+
+    python scripts/loadcurve.py [--rates 250,500,...] [--step-s 4]
+        [--mode poisson|bursty|diurnal] [--seed 7] [--p99-target-ms 50]
+        [--out PATH] [--no-verify] [--compare]
+
+Drives benchmarks/openloop.py's rate ladder against one served engine
+(per-stage decomposition scraped fleet-wide per step), then:
+
+* writes the report to ``--out``, defaulting to the next free
+  ``LOADCURVE_rNN.json`` in the repo root — the trajectory file the
+  ``loadcurve`` family of scripts/bench_compare.py tracks;
+* with ``--compare``, gates the fresh result against the recorded
+  trajectory BEFORE it becomes a round (exit 1 on regression past the
+  threshold, like CI's bench gate).
+
+The report is the raw sweep object (flat headline keys:
+``max_sustainable_ops_per_sec``, ``knee_ops_per_sec``,
+``p99_at_knee_ms``), so bench_compare reads rounds and fresh results
+identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def next_round_path() -> str:
+    """First unused ``LOADCURVE_rNN.json`` in the repo root."""
+    taken = set()
+    for p in glob.glob(os.path.join(REPO_ROOT, "LOADCURVE_r*.json")):
+        m = re.search(r"LOADCURVE_r(\d+)\.json$", p)
+        if m:
+            taken.add(int(m.group(1)))
+    n = 1
+    while n in taken:
+        n += 1
+    return os.path.join(REPO_ROOT, f"LOADCURVE_r{n:02d}.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="loadcurve")
+    ap.add_argument("--rates", default="",
+                    help="comma-separated offered-rate ladder (ops/s)")
+    ap.add_argument("--step-s", type=float, default=4.0,
+                    help="seconds per rate step (default 4)")
+    ap.add_argument("--mode", default="poisson",
+                    choices=("poisson", "bursty", "diurnal"))
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--p99-target-ms", type=float, default=50.0,
+                    help="p99 target for max sustainable load")
+    ap.add_argument("--out", default="",
+                    help="output path (default: next LOADCURVE_rNN.json)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the porcupine sampler clerks")
+    ap.add_argument("--compare", action="store_true",
+                    help="gate against the recorded LOADCURVE trajectory "
+                         "(exit 1 on regression)")
+    ap.add_argument("--threshold", type=float, default=5.0)
+    ns = ap.parse_args(argv)
+
+    from benchmarks.openloop import DEFAULT_RATES, sweep
+
+    rates = ([float(x) for x in ns.rates.split(",")] if ns.rates
+             else list(DEFAULT_RATES))
+    report = sweep(
+        rates=rates, step_s=ns.step_s, mode=ns.mode, seed=ns.seed,
+        p99_target_ms=ns.p99_target_ms, verify=not ns.no_verify,
+    )
+    rc = 0
+    if ns.compare:
+        # Gate BEFORE the result lands as a round file — once written
+        # into the repo root it would be its own "latest round" and the
+        # comparison would trivially pass.
+        import tempfile
+
+        from bench_compare import main as compare_main
+
+        fd, tmp = tempfile.mkstemp(suffix=".json")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(report, f)
+            rc = compare_main([
+                tmp, "--family", "loadcurve",
+                "--threshold", str(ns.threshold),
+            ])
+        finally:
+            os.unlink(tmp)
+
+    out_path = ns.out or next_round_path()
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    knee = report.get("knee") or {}
+    print(
+        f"loadcurve: {len(report['steps'])} step(s) {ns.mode} -> "
+        f"{out_path}\n"
+        f"  max sustainable @ p99<={ns.p99_target_ms:g}ms: "
+        f"{report.get('max_sustainable_ops_per_sec')} ops/s\n"
+        f"  knee: {knee.get('offered_rate')} offered "
+        f"(p99 {knee.get('client_p99_ms')} ms)\n"
+        f"  porcupine: {report.get('porcupine')} "
+        f"({report.get('verifier_ops')} sampled op(s))",
+        flush=True,
+    )
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """Summarize a Chrome-trace JSON artifact from the observability plane.
 
-    python scripts/trace_summary.py TRACE.json[.gz] [--top N]
+    python scripts/trace_summary.py TRACE.json[.gz] [--top N] [--stages]
 
 Prints, for a trace produced by ``Tracer.save`` / the fleet scraper
 (harness/observe.py) / ``bench.py``:
@@ -10,9 +10,21 @@ Prints, for a trace produced by ``Tracer.save`` / the fleet scraper
   go" view without opening Perfetto;
 * instant/counter event counts and any recorded drop counts.
 
-Exit code 0 when the trace parses and contains at least one event,
-2 on a malformed/empty trace — tests use this as a smoke check that
-emitted artifacts are actually loadable.
+``--stages`` switches to the request-decomposition view: spans are
+grouped by their request id (the ``req`` arg every clerk/server span
+carries), and each request's spans are folded into the stage
+vocabulary the latency histograms use (distributed/observe.py STAGES):
+``total`` from the clerk-side span, ``handler`` from the server's
+dispatch span, and the remainder (both wire directions + queues +
+reply flush) reported as ``wire``.  Coarser than the histogram
+decomposition — spans only exist at two vantage points — but the rows
+share stage names, so the trace view and the ``stage.*_s`` metrics
+line up.
+
+Exit code 0 when the trace parses and contains at least one event
+(for ``--stages``: at least one rid-tagged span), 2 otherwise — tests
+use this as a smoke check that emitted artifacts are actually
+loadable.
 """
 
 from __future__ import annotations
@@ -38,19 +50,7 @@ def summarize(path: str, top: int = 10) -> Dict[str, Any]:
          "tracks": {"pid/tid": {"spans": n, "dur_us": total}},
          "top_spans": [(name, total_dur_us, count), ...]}
     """
-    if os.path.getsize(path) == 0:
-        raise ValueError("empty file (0 bytes)")
-    doc = Tracer.load(path)
-    # Chrome traces come in two shapes: {"traceEvents": [...]} (what
-    # Tracer.save writes) and a bare event array (what other tools
-    # emit) — accept both; anything else is not a trace.
-    if isinstance(doc, list):
-        doc = {"traceEvents": doc}
-    if not isinstance(doc, dict):
-        raise ValueError(f"not a Chrome trace (top-level {type(doc).__name__})")
-    events = doc.get("traceEvents", [])
-    if not isinstance(events, list):
-        raise ValueError("traceEvents is not a list")
+    doc, events = _load_events(path)
     names: Dict[Any, str] = {}
     tracks: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"spans": 0, "dur_us": 0.0}
@@ -101,9 +101,96 @@ def summarize(path: str, top: int = 10) -> Dict[str, Any]:
     }
 
 
+def _load_events(path: str):
+    """Shared loader: ``(doc, events)`` of a catapult JSON.  Chrome
+    traces come in two shapes — ``{"traceEvents": [...]}`` (what
+    Tracer.save writes) and a bare event array (what other tools emit);
+    accept both, reject anything else."""
+    if os.path.getsize(path) == 0:
+        raise ValueError("empty file (0 bytes)")
+    doc = Tracer.load(path)
+    if isinstance(doc, list):
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict):
+        raise ValueError(f"not a Chrome trace (top-level {type(doc).__name__})")
+    events = doc.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
+    return doc, events
+
+
+def summarize_stages(path: str) -> Dict[str, Any]:
+    """Group rid-tagged spans into per-request stage decompositions.
+
+    Per request id: ``total`` = the clerk-side span (track ``clerk``,
+    falling back to the caller's ``rpc-out`` leg), ``handler`` = the
+    server's dispatch span (track ``rpc``), ``wire`` = the remainder
+    (``total − handler``: both wire directions, the dispatch queue,
+    and the reply flush — everything the two span vantage points can't
+    see; the ``stage.*_s`` histograms split it further).  Stage rows
+    report count/mean/p50/p99 across requests via the same log-bucket
+    histogram the metrics plane uses::
+
+        {"rids": N, "tagged_spans": M,
+         "stages": {name: {"count", "mean_ms", "p50_ms", "p99_ms"}}}
+    """
+    from multiraft_tpu.utils.metrics import Hist
+
+    _, events = _load_events(path)
+    # rid -> {"total": us, "handler": us} (first span of each kind wins;
+    # retries re-use the rid, and the first attempt is the one whose
+    # clerk span covers the full wait).
+    per_rid: Dict[str, Dict[str, float]] = {}
+    tagged = 0
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        req = (ev.get("args") or {}).get("req")
+        if not isinstance(req, str):
+            continue
+        tagged += 1
+        rec = per_rid.setdefault(req, {})
+        track = ev.get("tid")
+        dur = float(ev.get("dur", 0.0))
+        if track == "clerk":
+            rec.setdefault("total", dur)
+        elif track == "rpc-out":
+            rec.setdefault("rpc_out", dur)
+        elif track == "rpc":
+            rec.setdefault("handler", dur)
+    hists: Dict[str, Hist] = {
+        "total": Hist(), "handler": Hist(), "wire": Hist(),
+    }
+    for rec in per_rid.values():
+        total = rec.get("total", rec.get("rpc_out"))
+        handler = rec.get("handler")
+        if total is not None:
+            hists["total"].observe(total / 1e6)
+        if handler is not None:
+            hists["handler"].observe(handler / 1e6)
+        if total is not None and handler is not None:
+            hists["wire"].observe(max(total - handler, 0.0) / 1e6)
+    stages: Dict[str, Dict[str, Any]] = {}
+    for name, h in hists.items():
+        if not h.count:
+            continue
+        p50, p99 = h.percentile(0.50), h.percentile(0.99)
+        stages[name] = {
+            "count": h.count,
+            "mean_ms": round(1e3 * h.total / h.count, 3),
+            "p50_ms": round(1e3 * p50, 3) if p50 is not None else None,
+            "p99_ms": round(1e3 * p99, 3) if p99 is not None else None,
+        }
+    return {"rids": len(per_rid), "tagged_spans": tagged, "stages": stages}
+
+
 def main() -> int:
     argv = sys.argv[1:]
     top = 10
+    stages_mode = False
+    if "--stages" in argv:
+        stages_mode = True
+        argv.remove("--stages")
     if "--top" in argv:
         i = argv.index("--top")
         if i + 1 >= len(argv):
@@ -115,6 +202,29 @@ def main() -> int:
         print(__doc__, file=sys.stderr)
         return 2
     path = argv[0]
+    if stages_mode:
+        try:
+            s = summarize_stages(path)
+        except Exception as exc:  # noqa: BLE001 - CLI boundary
+            print(f"error: could not read trace {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if not s["rids"]:
+            print(f"error: trace {path!r} has no rid-tagged spans",
+                  file=sys.stderr)
+            return 2
+        print(f"trace {path}")
+        print(f"  {s['rids']} request(s) from {s['tagged_spans']} "
+              f"rid-tagged span(s)")
+        print(f"  {'stage':10s} {'count':>7s} {'mean ms':>9s} "
+              f"{'p50 ms':>9s} {'p99 ms':>9s}")
+        for name in ("total", "handler", "wire"):
+            st = s["stages"].get(name)
+            if st is None:
+                continue
+            print(f"  {name:10s} {st['count']:7d} {st['mean_ms']:9.3f} "
+                  f"{st['p50_ms']:9.3f} {st['p99_ms']:9.3f}")
+        return 0
     try:
         s = summarize(path, top=top)
     except Exception as exc:  # noqa: BLE001 - CLI boundary

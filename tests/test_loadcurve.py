@@ -1,0 +1,372 @@
+"""Latency-telemetry-plane tests: the fixed log-bucket histogram
+(accuracy vs exact quantiles, exact merge, windowed diffs), the
+deterministic open-loop schedule generator, the knee finder, the
+windowed fleet scrape (pure + over live sockets), and — slow — the
+overload round trip: open-loop traffic past the knee must leave
+OVERLOAD records whose postmortem names "queueing collapse" and the
+first saturated stage."""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import time
+
+import pytest
+
+from benchmarks.openloop import ZipfKeys, gen_schedule, rate_at
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.harness.loadcurve import (
+    build_loadcurve,
+    find_knee,
+    gauge_peaks,
+    max_sustainable,
+    stage_stats,
+    window_hists,
+)
+from multiraft_tpu.utils.metrics import Hist, Metrics
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+# ---------------------------------------------------------------------------
+# Hist: log-bucket streaming histogram
+# ---------------------------------------------------------------------------
+
+
+class TestHist:
+    def test_percentile_accuracy_vs_exact_quantiles(self):
+        """Relative error on a lognormal latency stream stays within
+        one sub-bucket (2^(1/4) ≈ 19% bucket width → mid-point error
+        ≤ ~9.5%) against the exact sorted-sample quantiles."""
+        rng = random.Random(11)
+        vals = [math.exp(rng.gauss(-6.0, 1.0)) for _ in range(20000)]
+        h = Hist()
+        for v in vals:
+            h.observe(v)
+        exact = sorted(vals)
+        for q in (0.10, 0.50, 0.90, 0.99):
+            est = h.percentile(q)
+            ref = exact[min(int(q * len(exact)), len(exact) - 1)]
+            assert est is not None
+            assert abs(est - ref) / ref < 0.10, (q, est, ref)
+
+    def test_min_max_exact_and_clamping(self):
+        h = Hist()
+        for v in (3e-3, 5e-3, 9e-3):
+            h.observe(v)
+        assert h.vmin == 3e-3 and h.vmax == 9e-3
+        # Percentiles stay clamped inside the exact observed range and
+        # land within one sub-bucket of the true extremes.
+        p0, p100 = h.percentile(0.0), h.percentile(1.0)
+        assert 3e-3 <= p0 <= 9e-3 and p0 == pytest.approx(3e-3, rel=0.10)
+        assert 3e-3 <= p100 <= 9e-3 and p100 == pytest.approx(9e-3, rel=0.10)
+
+    def test_merge_is_exact(self):
+        rng = random.Random(5)
+        a, b, both = Hist(), Hist(), Hist()
+        for i in range(3000):
+            v = math.exp(rng.gauss(-7.0, 1.5))
+            (a if i % 2 else b).observe(v)
+            both.observe(v)
+        a.merge(b)
+        assert a.counts == both.counts
+        assert a.count == both.count
+        assert a.vmin == both.vmin and a.vmax == both.vmax
+        assert abs(a.total - both.total) < 1e-9
+
+    def test_dump_roundtrip(self):
+        h = Hist()
+        for v in (1e-4, 2e-3, 2e-3, 0.5):
+            h.observe(v)
+        d = h.dump()
+        back = Hist.from_dump(d)
+        assert back.counts == h.counts
+        assert back.count == h.count and back.vmin == h.vmin
+
+    def test_sub_windows_are_monotone(self):
+        """Cumulative scrapes diff into non-negative windows whose
+        count equals the cumulative delta — the property every
+        windowed consumer (overload watch, load-curve sweep) needs."""
+        h = Hist()
+        for _ in range(40):
+            h.observe(2e-3)
+        snap = Hist.from_dump(h.dump())
+        for _ in range(25):
+            h.observe(8e-3)
+        win = Hist.sub(h, snap)
+        assert win.count == 25
+        assert all(c >= 0 for c in win.counts)
+        assert win.percentile(0.5) == pytest.approx(8e-3, rel=0.15)
+
+    def test_metrics_routes_seconds_names_to_hists(self):
+        m = Metrics()
+        for i in range(100):
+            m.observe("stage.engine_s", 1e-3)
+            m.observe("batch.ops", float(i))
+        assert "stage.engine_s" in m.hists
+        assert "batch.ops" not in m.hists  # reservoir keeps value dists
+        snap = m.snapshot()
+        assert snap["stage.engine_s_count"] == 100
+        assert snap["stage.engine_s_p99"] == pytest.approx(1e-3, rel=0.15)
+
+
+# ---------------------------------------------------------------------------
+# Open-loop schedule generation (pure, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedule:
+    def test_deterministic_under_fixed_seed(self):
+        kw = dict(rate=400.0, duration=3.0, mode="bursty", keyspace=64)
+        assert gen_schedule(seed=9, **kw) == gen_schedule(seed=9, **kw)
+        assert gen_schedule(seed=9, **kw) != gen_schedule(seed=10, **kw)
+
+    @pytest.mark.parametrize("mode", ["poisson", "bursty", "diurnal"])
+    def test_shapes_sorted_bounded_and_mean_preserving(self, mode):
+        dur, rate = 5.0, 600.0
+        sched = gen_schedule(seed=3, rate=rate, duration=dur, mode=mode)
+        ts = [t for t, *_ in sched]
+        assert ts == sorted(ts)
+        assert all(0.0 <= t < dur for t in ts)
+        # All three shapes offer the same MEAN rate (±15% at n≈3000).
+        assert len(sched) / dur == pytest.approx(rate, rel=0.15)
+
+    def test_zipf_skew_hits_hot_keys(self):
+        rng = random.Random(2)
+        zk = ZipfKeys(128, s=1.2)
+        picks = [zk.pick(rng) for _ in range(8000)]
+        hot = sum(1 for k in picks if k == "olk0")
+        assert hot / len(picks) > 0.15  # zipf head dominates uniform 1/128
+
+    def test_bursty_rate_peaks_and_troughs(self):
+        peak = rate_at("bursty", t=0.05, duration=10.0, rate=100.0,
+                       burst_factor=4.0, burst_cycle=1.0, burst_duty=0.2)
+        trough = rate_at("bursty", t=0.5, duration=10.0, rate=100.0,
+                         burst_factor=4.0, burst_cycle=1.0, burst_duty=0.2)
+        assert peak == pytest.approx(400.0)
+        assert trough < 100.0
+        with pytest.raises(ValueError):
+            rate_at("tidal", 0.0, 1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Knee finder + curve assembly (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestKnee:
+    def test_finds_hockey_stick_bend(self):
+        rates = [250, 500, 1000, 2000, 4000, 8000]
+        # Flat-ish then exploding p99: the knee is where it takes off.
+        p99 = [5.0, 5.2, 5.5, 9.0, 80.0, 600.0]
+        i = find_knee(rates, p99)
+        assert i in (3, 4)  # the bend, not the endpoints
+
+    def test_degenerate_inputs(self):
+        assert find_knee([1, 2], [1.0, 2.0]) is None
+        assert find_knee([1, 2, 3], [4.0, 4.0, 4.0]) is None  # flat
+        assert find_knee([2, 2, 2], [1.0, 2.0, 3.0]) is None  # no x span
+
+    def test_max_sustainable_respects_target(self):
+        rates = [100.0, 200.0, 400.0, 800.0]
+        p99 = [4.0, 6.0, 30.0, 900.0]
+        assert max_sustainable(rates, p99, target_ms=50.0) == 400.0
+        assert max_sustainable(rates, p99, target_ms=5.0) == 100.0
+        assert max_sustainable(rates, [None] * 4, target_ms=50.0) is None
+
+    def test_build_loadcurve_report_shape(self):
+        steps = [
+            {"offered_rate": r, "achieved_ops_per_sec": a,
+             "client_p50_ms": p / 2, "client_p99_ms": p}
+            for r, a, p in [
+                (100.0, 99.0, 5.0), (200.0, 198.0, 5.5),
+                (400.0, 390.0, 8.0), (800.0, 640.0, 90.0),
+                (1600.0, 700.0, 800.0),
+            ]
+        ]
+        out = build_loadcurve(steps, p99_target_ms=50.0)
+        assert out["max_sustainable_ops_per_sec"] == 400.0
+        assert out["knee"] is not None
+        assert out["knee_ops_per_sec"] == out["knee"]["offered_rate"]
+        assert out["p99_at_knee_ms"] == out["knee"]["client_p99_ms"]
+        assert len(out["curve"]["offered_rate"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# Windowed fleet scrape folding (pure)
+# ---------------------------------------------------------------------------
+
+
+def _scrape(per_name_obs):
+    """Synthetic scrape_hists() entry for one fake fleet of one proc."""
+    hists = {}
+    for name, values in per_name_obs.items():
+        h = Hist()
+        for v in values:
+            h.observe(v)
+        hists[name] = h
+    return {"proc:1": {"hists": hists, "gauges": {"gauge.replyq": 3.0},
+                       "now_us": 0.0}}
+
+
+class TestWindowFold:
+    def test_window_diff_and_stage_stats(self):
+        before = _scrape({"stage.engine_s": [1e-3] * 50})
+        after = _scrape({"stage.engine_s": [1e-3] * 50 + [20e-3] * 50,
+                         "stage.wire_s": [5e-5] * 10})
+        win = window_hists(before, after)
+        # The window sees ONLY the 50 new slow samples + the new hist.
+        assert win["stage.engine_s"].count == 50
+        assert win["stage.wire_s"].count == 10
+        st = stage_stats(win)
+        assert set(st) == {"engine", "wire"}
+        assert st["engine"]["count"] == 50
+        assert st["engine"]["p50_ms"] == pytest.approx(20.0, rel=0.15)
+
+    def test_missing_process_skipped_and_gauge_peaks(self):
+        after = _scrape({"stage.engine_s": [1e-3]})
+        after["proc:2"] = {"missing": True}
+        win = window_hists({}, after)
+        assert win["stage.engine_s"].count == 1
+        peaks = gauge_peaks(after)
+        assert peaks == {"gauge.replyq": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# Windowed scrape over live sockets: Obs.hist cumulative monotonicity
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    def ping(self, args):
+        return ("pong", args)
+
+
+@needs_native
+@pytest.mark.timeout_s(60)
+def test_obs_hist_scrape_monotone_over_live_node():
+    """Two Obs.hist scrapes around tagged traffic: cumulative bucket
+    counts never decrease, and the Hist.sub window counts exactly the
+    requests fired in between (the load-curve sweep's invariant)."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.loadcurve import scrape_hists
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    server = RpcNode(listen=True)
+    server.add_service("Echo", _Echo())
+    client = RpcNode()
+    obs = None
+    try:
+        end = client.client_end(server.host, server.port)
+
+        def fire(n, tag):
+            for k in range(n):
+                got = client.sched.wait(
+                    end.call("Echo.ping", k, trace=f"{tag}.{k}"), 5.0
+                )
+                assert got == ("pong", k)
+
+        fire(8, "warm")
+        obs = FleetObserver([(server.host, server.port)])
+        s1 = scrape_hists(obs)
+        key = f"{server.host}:{server.port}"
+        h1 = s1[key]["hists"]
+        assert "stage.wire_s" in h1 and "stage.handler_s" in h1
+        assert h1["stage.handler_s"].count >= 8
+        assert "gauge.replyq" in s1[key]["gauges"]
+
+        fire(5, "win")
+        s2 = scrape_hists(obs)
+        h2 = s2[key]["hists"]
+        for name, h in h1.items():
+            later = h2[name]
+            # Cumulative: per-bucket monotone non-decreasing.
+            assert all(b >= a for a, b in zip(h.counts, later.counts)), name
+        win = window_hists(s1, s2)
+        # The Obs.hist scrapes themselves are untagged, so the window
+        # counts exactly the 5 tagged calls.
+        assert win["stage.handler_s"].count == 5
+        assert "handler" in stage_stats(win)
+    finally:
+        if obs is not None:
+            obs.close()
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Slow: open-loop overload leaves a "queueing collapse" postmortem
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@needs_native
+@pytest.mark.timeout_s(300)
+def test_openloop_overload_doctor_names_queueing_collapse(tmp_path):
+    """Drive open-loop traffic at 3x the measured knee with tight
+    overload bounds: the server's OverloadWatch must leave OVERLOAD
+    records in its flight ring, and the postmortem doctor must name
+    the "queueing collapse" anomaly with the first saturated stage."""
+    from benchmarks.openloop import fire_schedule
+    from multiraft_tpu.analysis import postmortem
+    from multiraft_tpu.distributed.engine_cluster import (
+        BlockingEngineClerk, EngineProcessCluster,
+    )
+    from multiraft_tpu.harness.loadcurve import build_loadcurve
+    from multiraft_tpu.harness.observe import FleetObserver
+    from multiraft_tpu.harness.loadcurve import run_sweep
+
+    frec_dir = str(tmp_path / "rings")
+    os.makedirs(frec_dir, exist_ok=True)
+    overrides = {
+        "MRT_FLIGHTREC_DIR": frec_dir,
+        # Tight bounds so a CPU-box overload trips quickly and
+        # unambiguously: 5ms windowed stage p99, fast watch ticks.
+        "MRT_OVERLOAD_P99_MS": "5",
+        "MRT_OVERLOAD_INTERVAL": "0.1",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    cluster = EngineProcessCluster(kind="engine_kv", groups=16, seed=13)
+    obs = None
+    try:
+        cluster.start()
+        warm = BlockingEngineClerk(cluster.port, host=cluster.host)
+        warm.put("warm", "1")
+        warm.close()
+        obs = FleetObserver([(cluster.host, cluster.port)])
+
+        def fire_step(rate):
+            sched = gen_schedule(seed=5 + int(rate), rate=rate,
+                                 duration=1.5, keyspace=64)
+            return fire_schedule(cluster.host, cluster.port, sched,
+                                 duration=1.5, drain_s=1.0)
+
+        steps = run_sweep(obs, fire_step, [300.0, 600.0, 1200.0])
+        curve = build_loadcurve(steps, p99_target_ms=20.0)
+        knee = curve["knee_ops_per_sec"] or 1200.0
+        fire_step(3.0 * knee)
+        time.sleep(0.5)  # a couple more watch ticks past the burst
+    finally:
+        if obs is not None:
+            obs.close()
+        cluster.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    bundle = postmortem.load_bundle(frec_dir)
+    assert bundle["rings"], "server left no flight ring"
+    analysis = postmortem.analyze(bundle)
+    kinds = {a["kind"] for a in analysis["anomalies"]}
+    assert "queueing_collapse" in kinds, kinds
+    report = postmortem.build_report(bundle, analysis)
+    assert "queueing collapse" in report
+    assert "first saturated stage 'stage." in report
+    assert "queue gauge gauge." in report
