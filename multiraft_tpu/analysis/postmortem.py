@@ -48,6 +48,13 @@ Record = Dict[str, Any]
 BURST_WINDOW_US = 1_000_000.0
 BURST_MIN = 5
 
+# Placement thrash: the same group moved ≥ THRASH_MIN times inside
+# THRASH_WINDOW_US (PLACE records, placement.py controller).  A healthy
+# controller's cooldown/hysteresis keeps any one group far below this;
+# hitting it means the planner is oscillating.
+THRASH_WINDOW_US = 30_000_000.0
+THRASH_MIN = 3
+
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
@@ -312,6 +319,33 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                 "first": first["tag"],
                 "gauge": gauge["tag"] if gauge is not None else None,
             }
+        # Placement thrash: PLACE records (the controller's decision
+        # log) grouped by gid; the densest window per gid against the
+        # thrash bound.  The controller's own ring is usually the only
+        # one carrying these.
+        place_ts: Dict[int, List[float]] = {}
+        for r in recs:
+            if r["type"] == flightrec.PLACE:
+                place_ts.setdefault(r["code"], []).append(r["ts"])
+        if place_ts:
+            info["placements"] = {
+                gid: len(ts) for gid, ts in sorted(place_ts.items())
+            }
+        for gid, ts_list in sorted(place_ts.items()):
+            n, t0 = _max_burst(ts_list, THRASH_WINDOW_US)
+            if n >= THRASH_MIN:
+                anomalies.append({
+                    "ts": aligned(t0), "proc": label,
+                    "kind": "placement_thrash",
+                    "detail": (
+                        f"group {gid} moved {n} times within "
+                        f"{THRASH_WINDOW_US / 1e6:.0f}s "
+                        f"({len(ts_list)} move(s) total) — the planner "
+                        f"is oscillating; raise MRT_PLACE_COOLDOWN_S / "
+                        f"MRT_PLACE_MIN_GAIN"
+                    ),
+                    "aligned": off is not None,
+                })
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more
@@ -408,6 +442,12 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                 out.instant(f"overload:{r['tag']}", ts, track="overload",
                             pid=pid, kind=r["code"], value=r["a"],
                             bound=r["b"])
+            elif t == flightrec.PLACE:
+                out.instant(
+                    f"place:g{r['code']}", ts, track="placement",
+                    pid=pid, group=r["code"], src=r["a"], dst=r["b"],
+                    version=r["c"], reason=r["tag"],
+                )
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
                             tag=r["tag"])

@@ -235,6 +235,7 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             data_dir=spec.get("data_dir"),
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
             mesh_devices=spec.get("mesh_devices", 0),
+            spare_slots=spec.get("spare_slots", 0),
         )
     elif kind == "split_kv":
         _pin_platform(spec)

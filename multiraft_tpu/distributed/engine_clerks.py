@@ -451,7 +451,7 @@ class EngineFleetClerk:
     # an unbounded inner loop.
     CONFIG_DEADLINE_S = 30.0
 
-    def __init__(self, sched, ends_by_gid: dict) -> None:
+    def __init__(self, sched, ends_by_gid: dict, make_end=None) -> None:
         self.sched = sched
         self.ends = dict(ends_by_gid)  # gid -> TcpClientEnd
         self._all = list(dict.fromkeys(self.ends.values()))
@@ -459,6 +459,15 @@ class EngineFleetClerk:
         self.command_id = 0
         self._cfg = None  # cached (num, shards, groups)
         self._backoff = Backoff()
+        # Placement awareness (distributed/placement.py): with a
+        # ``make_end`` factory the clerk re-derives its gid→end map from
+        # the fleet's placement view after ErrWrongGroup — a config
+        # re-query alone cannot re-route a gid the controller MOVED to
+        # another process.  Without the factory the static map stands.
+        self._make_end = make_end
+        self._place_ver = 0
+        self._place_stale = False
+        self._ends_by_addr: dict = {}
         # Observability (see EngineClerk): every end shares the
         # process's one node, so any end's plane is THE plane.
         self.obs = _end_obs(self._all[0]) if self._all else _end_obs(None)
@@ -470,6 +479,8 @@ class EngineFleetClerk:
     def _refresh_config(self, deadline=None):
         if deadline is None:
             deadline = self.sched.now + self.CONFIG_DEADLINE_S
+        if self._place_stale:
+            yield from self._refresh_placement()
         while True:
             if self.sched.now >= deadline:
                 raise TimeoutError("config fetch exceeded deadline")
@@ -481,6 +492,37 @@ class EngineFleetClerk:
                     self._backoff.reset()
                     return reply
             yield self._backoff.next_delay()
+
+    def _refresh_placement(self):
+        """Rebuild the gid→end map from any process's placement view
+        (``EngineShardKV.placement``).  Version-gated: only a strictly
+        newer view replaces the map, so a process holding a stale view
+        cannot roll the clerk back mid-migration."""
+        self._place_stale = False
+        if self._make_end is None:
+            return
+        for end in list(self._all):
+            fut = end.call("EngineShardKV.placement", ())
+            reply = yield self.sched.with_timeout(fut, 2.0)
+            if (
+                reply is None or reply is TIMEOUT
+                or not isinstance(reply, tuple) or len(reply) != 2
+            ):
+                continue
+            ver, pmap = reply
+            if ver > self._place_ver and pmap:
+                self._place_ver = ver
+                ends = {}
+                for g, addr in pmap.items():
+                    addr = (addr[0], int(addr[1]))
+                    e = self._ends_by_addr.get(addr)
+                    if e is None:
+                        e = self._make_end(addr[0], addr[1])
+                        self._ends_by_addr[addr] = e
+                    ends[int(g)] = e
+                self.ends = ends
+                self._all = list(dict.fromkeys(self.ends.values()))
+            return
 
     def _command(self, op: str, key: str, value: str = ""):
         from ..engine.shardkv import ERR_WRONG_GROUP
@@ -520,6 +562,7 @@ class EngineFleetClerk:
             reply = yield self.sched.with_timeout(fut, 3.5)
             if reply is None or reply is TIMEOUT:
                 self._cfg = None
+                self._place_stale = True  # the process may be gone
                 m.inc("clerk.retries")
                 delay = self._backoff.next_delay()
                 m.observe("clerk.backoff_s", delay)
@@ -536,6 +579,7 @@ class EngineFleetClerk:
                 return reply.value
             if reply.err == ERR_WRONG_GROUP:
                 self._cfg = None  # stale routing: re-query the config
+                self._place_stale = True  # ...or the gid itself moved
             m.inc("clerk.retries")
             yield self._backoff.next_delay()
 
@@ -640,5 +684,6 @@ class PipelinedFleetClerk(EngineFleetClerk):
             todo = sorted(retry)
             if todo:
                 self._cfg = None  # routing moved: re-query
+                self._place_stale = True  # ...possibly to a new process
                 yield self.sched.sleep(0.02)
         return results

@@ -317,6 +317,7 @@ class EngineFleetCluster:
         checkpoint_every_s: float = 30.0,
         mesh_devices: int = 0,
         chaos_seed: Optional[int] = None,
+        spare_slots: int = 0,
     ) -> None:
         # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
         # codec — admin replies are refused as unregistered otherwise.
@@ -342,6 +343,10 @@ class EngineFleetCluster:
                 "seed": seed + i,
                 "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
             }
+            if spare_slots:
+                # Idle engine groups the placement controller adopts
+                # migrated gids into (harness/fleet.py).
+                spec["spare_slots"] = int(spare_slots)
             if data_dir is not None:
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
                 spec["checkpoint_every_s"] = checkpoint_every_s
@@ -401,7 +406,14 @@ class EngineFleetCluster:
             self._admin_inflight = (op_key, cmd)
         sched = self._admin_node.sched
         deadline = time.monotonic() + timeout
-        for port in self.ports:
+        for i, port in enumerate(self.ports):
+            # Skip processes that are not running: mirroring an admin op
+            # to a killed process would spin until the deadline, and a
+            # placed fleet keeps serving while the controller re-places
+            # the dead process's gids.
+            p = self.procs[i]
+            if p is None or p.poll() is not None:
+                continue
             end = self._admin_node.client_end(self.host, port)
             while True:
                 if time.monotonic() > deadline:
@@ -444,7 +456,12 @@ class BlockingFleetClerk(_BlockingClerkBase):
             g: self.node.client_end(h, p)
             for g, (h, p) in owner_addrs.items()
         }
-        self._clerk = EngineFleetClerk(self.sched, ends)
+        # make_end: the clerk re-derives gid→end from the fleet's
+        # placement view after ErrWrongGroup (a controller may have
+        # MOVED the gid to another process).
+        self._clerk = EngineFleetClerk(
+            self.sched, ends, make_end=self.node.client_end
+        )
 
     @property
     def client_id(self) -> int:

@@ -75,6 +75,9 @@ class EngineShardKVService:
         peers: Optional[dict] = None,  # gid -> TcpClientEnd (remote owners)
         durability: Optional[EngineDurability] = None,
         obs=None,
+        fleet: Optional[bool] = None,
+        make_end=None,  # (host, port) -> TcpClientEnd, for placement pushes
+        placement0: Optional[dict] = None,  # gid -> (host, port), version 0
     ) -> None:
         self.sched = sched
         self.skv = skv
@@ -82,7 +85,16 @@ class EngineShardKVService:
         self._ticks = ticks_per_pump
         self._stopped = False
         self.peers = dict(peers or {})
-        self._fleet = bool(self.peers)
+        # A fleet process whose peer map is momentarily empty (all gids
+        # local, or rebuilt by a placement push) must KEEP answering
+        # ErrWrongGroup for foreign gids — hence the explicit flag.
+        self._fleet = bool(self.peers) if fleet is None else fleet
+        self._make_end = make_end
+        self._ends_by_addr: dict = {}
+        # (version, {gid: (host, port)}) — advanced only by `place`
+        # pushes with a strictly newer version (controller restarts and
+        # reordered pushes are harmless).
+        self._placement = (0, dict(placement0 or {}))
         self._dur = durability
         # Observability plane (see EngineKVService): the owning node's,
         # lazily defaulted via the `obs` property for stub construction.
@@ -290,6 +302,131 @@ class EngineShardKVService:
 
         return run()
 
+    # -- group placement RPCs (distributed/placement.py drives these) -----
+    #
+    # Whole-group migration between fleet processes: the controller
+    # calls pull_group at the source (seal + export), adopt_group at
+    # the destination (spare engine slot), drop_group back at the
+    # source, then pushes the new placement map fleet-wide with
+    # `place`.  All handlers are idempotent so the controller can
+    # retry any leg after a timeout.
+
+    ERR_NO_SLOT = "ErrNoSlot"
+
+    def pull_group(self, args):
+        """Seal ``gid`` and return ``(OK, blob)`` — its frozen applied
+        state (BatchedShardKV.export_group).  Retries return the same
+        blob: the seal stops every mutation."""
+        from ..engine.shardkv import ERR_NOT_READY, ERR_WRONG_GROUP
+        from ..engine.shardkv import OK as SK_OK
+
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+        self.m.inc("place.pulls_served")
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if gid not in self.skv.reps:
+                    return (ERR_WRONG_GROUP,)
+                blob = self.skv.export_group(gid)
+                if blob is not None:
+                    return (SK_OK, blob)
+                yield 0.01  # mid-migration / config in flight: settle
+            return (ERR_NOT_READY,)
+
+        return run()
+
+    def unseal_group(self, args):
+        """Abort leg: only safe while the blob was never dispatched to
+        any destination (see BatchedShardKV.unseal_group)."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+        self.skv.unseal_group(gid)
+        return (SK_OK,)
+
+    def adopt_group(self, args):
+        """Host ``gid`` in a spare engine slot.  ``blob=None`` adopts
+        empty (dead-source failover: the fresh replica re-pulls from
+        whatever live owners remain).  Idempotent: a retried adopt of
+        an already-hosted gid answers OK."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid, blob = args[0], args[1]
+        if gid in self.skv.reps:
+            return (SK_OK,)
+        if self.skv.free_slots() <= 0:
+            return (self.ERR_NO_SLOT,)
+        self.skv.adopt_gid(gid, blob)
+        self.peers.pop(gid, None)  # it's local now
+        self.m.inc("place.adoptions")
+        return (SK_OK,)
+
+    def drop_group(self, args):
+        """Free ``gid``'s slot after the destination adopted it.  Waits
+        for the slot to quiesce (tail applies resolve as WRONG_GROUP
+        no-ops) so slot reuse is safe.  Idempotent: already-dropped
+        answers OK."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+
+        def run():
+            deadline = self.sched.now + self.DEADLINE_S
+            while self.sched.now < deadline:
+                if gid not in self.skv.reps:
+                    return (SK_OK,)
+                if self.skv.group_quiesced(gid):
+                    self.skv.drop_gid(gid)
+                    self._rebuild_peers()  # route it to its new owner
+                    self.m.inc("place.drops")
+                    return (SK_OK,)
+                yield 0.005
+            return (ERR_TIMEOUT,)
+
+        return run()
+
+    def place(self, args):
+        """Placement push from the controller: ``(version, {gid:
+        (host, port)})``.  Only a strictly newer version applies —
+        reordered or replayed pushes are no-ops."""
+        from ..engine.shardkv import OK as SK_OK
+
+        version, pmap = args
+        cur_ver, _ = self._placement
+        if version > cur_ver:
+            self._placement = (
+                int(version),
+                {int(g): (a[0], int(a[1])) for g, a in pmap.items()},
+            )
+            self._rebuild_peers()
+            self.m.inc("place.pushes")
+        return (SK_OK, self._placement[0])
+
+    def placement(self, args=None):
+        """Current placement view ``(version, {gid: (host, port)})`` —
+        the fleet clerk's re-route source after ErrWrongGroup."""
+        ver, pmap = self._placement
+        return (ver, {g: tuple(a) for g, a in pmap.items()})
+
+    def _rebuild_peers(self) -> None:
+        """Re-derive the gid→end peer map from the placement view,
+        skipping locally hosted gids.  Ends are cached per address."""
+        if self._make_end is None:
+            return
+        _, pmap = self._placement
+        peers = {}
+        for g, addr in pmap.items():
+            if g in self.skv.reps:
+                continue
+            addr = (addr[0], int(addr[1]))
+            end = self._ends_by_addr.get(addr)
+            if end is None:
+                end = self._make_end(addr[0], addr[1])
+                self._ends_by_addr[addr] = end
+            peers[g] = end
+        self.peers = peers
+
     def config(self, args):
         """Latest committed config as ``(num, shards, groups)`` — the
         fleet clerk's routing source (shardctrler Query analog)."""
@@ -450,6 +587,8 @@ class EngineShardKVService:
                 gid = cfg.shards[key2shard(a.key)]
                 if gid not in self.skv.reps:
                     return None  # peer-owned (or unassigned) shard
+                if self._fleet and self.skv.is_sealed(gid):
+                    return None  # mid-placement-migration: re-route
                 return self.skv.submit(
                     gid, a.op, a.key, a.value,
                     client_id=a.client_id, command_id=a.command_id,
@@ -567,6 +706,11 @@ class EngineShardKVService:
                         return EngineCmdReply(err=ERR_WRONG_GROUP)
                     yield 0.01  # shard unassigned; config still moving
                     continue
+                if self._fleet and self.skv.is_sealed(gid):
+                    # Mid-placement-migration: every apply would be a
+                    # WRONG_GROUP no-op — tell the clerk NOW so it
+                    # refreshes placement and retries at the adopter.
+                    return EngineCmdReply(err=ERR_WRONG_GROUP)
                 t = self.skv.submit(
                     gid, args.op, args.key, args.value,
                     client_id=args.client_id, command_id=args.command_id,
@@ -662,6 +806,7 @@ def serve_engine_shardkv(
     data_dir: Optional[str] = None,
     checkpoint_every_s: float = 30.0,
     mesh_devices: int = 0,
+    spare_slots: int = 0,
 ) -> RpcNode:
     """The sharded engine behind TCP: BatchedShardKV (replicated config
     + per-shard migration pipeline) on one chip-owning process.
@@ -681,12 +826,25 @@ def serve_engine_shardkv(
     node = RpcNode(listen=True, host=host, port=port)
     sched = node.sched
     local_gids = list(gids) if gids is not None else None
-    G_local = (len(local_gids) + 1) if local_gids is not None else G
+    # spare_slots: extra idle engine groups the placement controller
+    # can adopt migrated gids into (distributed/placement.py).
+    G_local = (
+        (len(local_gids) + 1 + max(0, spare_slots))
+        if local_gids is not None else G
+    )
     peers = {
         g: node.client_end(h, p)
         for g, (h, p) in (peer_addrs or {}).items()
         if local_gids is None or g not in local_gids
     }
+    # Version-0 placement view: the static spec (peer addrs + own gids).
+    placement0 = None
+    if local_gids is not None:
+        placement0 = {
+            int(g): (h, int(p)) for g, (h, p) in (peer_addrs or {}).items()
+        }
+        for g in local_gids:
+            placement0[int(g)] = (host, int(port))
 
     def build():
         mesh = make_mesh(mesh_devices) if mesh_devices else None
@@ -736,7 +894,10 @@ def serve_engine_shardkv(
         if node.tracer is not None:
             driver.tracer = node.tracer  # ticks + RPCs on one timeline
         svc = EngineShardKVService(sched, skv, peers=peers, durability=dur,
-                                   obs=node.obs)
+                                   obs=node.obs,
+                                   fleet=local_gids is not None,
+                                   make_end=node.client_end,
+                                   placement0=placement0)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
             dur.checkpoint()  # fold replay into a fresh checkpoint

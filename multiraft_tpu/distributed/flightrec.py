@@ -96,6 +96,8 @@ MARK = 12        # free-form harness marker   tag=text
 SANITIZE = 13    # code=kind a=value b=limit  tag=label (sanitize.py)
 OVERLOAD = 14    # code=kind a=value(µs/depth) b=bound c=window_count
 #                  tag=stage-or-gauge name (overload.py watch)
+PLACE = 15       # code=gid a=src_proc b=dst_proc c=placement_version
+#                  tag=reason (placement.py controller decisions)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -112,6 +114,7 @@ _TYPE_NAMES = {
     MARK: "mark",
     SANITIZE: "sanitize",
     OVERLOAD: "overload",
+    PLACE: "place",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.

@@ -184,6 +184,20 @@ class ObsControl:
 
     def __init__(self, node: Any) -> None:
         self._node = node
+        # Commit-rate window state for groups(): (now_us, commit list)
+        # of the previous scrape — rates are deltas BETWEEN scrapes, so
+        # the placer reads load directly instead of diffing counters.
+        self._g_prev: Optional[tuple] = None
+
+    def _engine_kv(self):
+        """The engine service's frontier service, whichever attribute
+        it hangs off (``kv`` on EngineKVService, ``skv`` on the sharded
+        services)."""
+        svc = getattr(self._node, "engine_service", None)
+        kv = getattr(svc, "kv", None)
+        if kv is None:
+            kv = getattr(svc, "skv", None)
+        return kv
 
     def ping(self, args: Any = None) -> str:
         return "pong"
@@ -224,7 +238,7 @@ class ObsControl:
             out["gauge.inflight"] = float(len(pending))
         svc = getattr(node, "engine_service", None)
         if svc is not None:
-            driver = getattr(getattr(svc, "kv", None), "driver", None)
+            driver = getattr(self._engine_kv(), "driver", None)
             backlog = getattr(driver, "backlog", None)
             if backlog is not None:
                 out["gauge.backlog"] = float(backlog.sum())
@@ -255,13 +269,17 @@ class ObsControl:
     def groups(self, args: Any = None) -> Optional[Dict[str, Any]]:
         """Per-raft-group introspection (columnar, one entry per group):
         leader replica (−1 = none), max term, commit index, applied
-        index, log length above the snapshot base, and last snapshot
-        index.  ``None`` on nodes without an engine service (pure
-        clients, sim-backend servers).  The postmortem doctor uses the
-        commit/applied columns to compute apply lag at time of death;
-        folded into :meth:`snapshot` so every scrape carries it."""
-        svc = getattr(self._node, "engine_service", None)
-        driver = getattr(getattr(svc, "kv", None), "driver", None)
+        index, log length above the snapshot base, last snapshot index,
+        the GLOBAL gid each local engine slot hosts (``gids``, −1 for
+        the config RSM / spare slots), and a windowed per-group commit
+        RATE (``commit_rate``, commits/s since the previous scrape of
+        this verb — the placement controller's load signal).  ``None``
+        on nodes without an engine service (pure clients, sim-backend
+        servers).  The postmortem doctor uses the commit/applied columns
+        to compute apply lag at time of death; folded into
+        :meth:`snapshot` so every scrape carries it."""
+        kv = self._engine_kv()
+        driver = getattr(kv, "driver", None)
         state = getattr(driver, "state", None)
         if state is None:
             return None
@@ -275,11 +293,33 @@ class ObsControl:
         alive = np.asarray(state.alive).astype(bool)
         lead = (role == LEADER) & alive
         leader = np.where(lead.any(axis=1), lead.argmax(axis=1), -1)
+        G = int(role.shape[0])
+        commit = np.asarray(state.commit).max(axis=1).tolist()
+        now = now_us()
+        rate = [0.0] * G
+        prev = self._g_prev
+        if prev is not None and len(prev[1]) == G:
+            dt_s = (now - prev[0]) / 1e6
+            if dt_s > 0:
+                rate = [
+                    max(0.0, (c - p) / dt_s)
+                    for c, p in zip(commit, prev[1])
+                ]
+        self._g_prev = (now, list(commit))
+        # Local slot → global gid (fleet mode); −1 marks the config RSM
+        # (slot 0) and idle spare slots.
+        l2g = getattr(kv, "_l2g", None)
+        gids = (
+            [l2g.get(g, -1) for g in range(G)]
+            if l2g is not None else list(range(G))
+        )
         return {
-            "G": int(role.shape[0]),
+            "G": G,
+            "gids": gids,
             "leader": leader.tolist(),
             "term": np.asarray(state.term).max(axis=1).tolist(),
-            "commit": np.asarray(state.commit).max(axis=1).tolist(),
+            "commit": commit,
+            "commit_rate": rate,
             "applied": np.asarray(state.applied).max(axis=1).tolist(),
             "log_len": np.asarray(state.log_len).max(axis=1).tolist(),
             "snap_index": np.asarray(state.base).max(axis=1).tolist(),

@@ -1,0 +1,804 @@
+"""Fleet placement controller — elastic cross-host group placement.
+
+ARCHITECTURE §7 ends with "cross-host group placement is the transport
+layer's job, not the kernel's"; this module is that job.  Three layers:
+
+* :class:`PlacementCtrler` — the placement MAP (raft group → mesh
+  process) as its own Raft-replicated state machine, riding the same
+  machinery as :class:`~multiraft_tpu.services.shardctrler.ShardCtrler`
+  so the map survives its own leader dying.  Migrations are two-phase
+  in the map (``Begin`` intent → ``Commit``): a controller that dies
+  mid-migration is resumed by its successor from the replicated intent,
+  which is what makes the idempotent migration legs safe to retry —
+  without the intent, a restarted controller could pull the same sealed
+  source a second time and adopt it at a DIFFERENT destination, forking
+  the group.
+
+* :func:`plan_moves` — the pure planner: weighted minimal-movement
+  rebalance (:func:`~multiraft_tpu.services.shardctrler.
+  rebalance_weighted`) over per-group commit rates scraped from
+  ``Obs.groups``, wrapped in the anti-thrash policy (hysteresis on
+  relative spread gain, per-group cooldown, bounded moves per round).
+  Failover moves — groups on a process that stopped answering
+  ``Obs.ping`` within the deadline — bypass hysteresis and the cap:
+  healing is never rate-limited by politeness knobs.
+
+* :class:`PlacementController` — the real-time loop: scrape → plan →
+  execute → push.  Execution rides the group-migration RPCs on
+  :class:`~.engine_shard_server.EngineShardKVService` (``pull_group``
+  seal+export at the source, ``adopt_group`` into a spare engine slot
+  at the destination, ``drop_group`` back at the source), then pushes
+  the new placement map fleet-wide (``place``) so servers re-derive
+  their peer maps and clerks re-route.
+
+Every knob reads an ``MRT_PLACE_*`` env var (constructor args win):
+
+=====================  =======  ==========================================
+MRT_PLACE_SCRAPE_S     0.5      seconds between controller rounds
+MRT_PLACE_DEAD_S       3.0      no Obs.ping for this long → process dead
+MRT_PLACE_COOLDOWN_S   5.0      a moved group may not move again sooner
+MRT_PLACE_MIN_GAIN     0.25     min relative load-spread reduction to act
+MRT_PLACE_MAX_MOVES    1        voluntary moves per round (failover exempt)
+=====================  =======  ==========================================
+
+Failure-detection semantics: liveness is "answered ``Obs.ping`` within
+``dead_s``", judged on the controller's monotonic clock from its LAST
+successful ping of that process.  A dead process's groups are adopted
+EMPTY at survivors (``blob=None``) and re-pull whatever shards live
+owners still hold — in a non-durable fleet the dead process's own
+shard data is gone (the documented fleet crash model; durable placed
+fleets are future work, see BatchedShardKV.load_state_dict's gid
+guard).  A process declared dead must STAY dead: this module never
+restarts processes, and a zombie that answers pings again after its
+groups were re-placed keeps answering ErrWrongGroup for them (its
+placement view is version-gated forward by the next push).
+
+Every decision emits a PLACE flight record (code=gid, a=src, b=dst,
+c=version, tag=reason) and ``place.*`` tracer spans sharing the stage
+vocabulary (``scripts/trace_summary.py --placements`` renders them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..services.shardctrler import rebalance_weighted
+from ..transport import codec
+
+__all__ = [
+    "PlaceArgs",
+    "PlaceReply",
+    "PlacementCtrler",
+    "PlacementClerk",
+    "LocalPlacementStore",
+    "TcpFleetTransport",
+    "PlacementController",
+    "plan_moves",
+    "place_knobs",
+]
+
+OK = "OK"
+ERR_WRONG_LEADER = "ErrWrongLeader"
+ERR_TIMEOUT = "ErrTimeout"
+
+QUERY = "Query"
+SET = "Set"
+BEGIN = "Begin"
+COMMIT = "Commit"
+ABORT = "Abort"
+
+# Bounded decision history kept in the replicated state (enough for
+# the doctor's thrash window without growing the snapshot unboundedly).
+HISTORY_CAP = 256
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def place_knobs() -> Dict[str, float]:
+    """The MRT_PLACE_* knob set, env-resolved (docs in module header)."""
+    return {
+        "scrape_s": _env_f("MRT_PLACE_SCRAPE_S", 0.5),
+        "dead_s": _env_f("MRT_PLACE_DEAD_S", 3.0),
+        "cooldown_s": _env_f("MRT_PLACE_COOLDOWN_S", 5.0),
+        "min_gain": _env_f("MRT_PLACE_MIN_GAIN", 0.25),
+        "max_moves": int(_env_f("MRT_PLACE_MAX_MOVES", 1)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# The replicated placement map (ShardCtrler pattern)
+# ---------------------------------------------------------------------------
+
+
+@codec.registered
+@dataclasses.dataclass
+class PlaceArgs:
+    """Unified op args (mirrors CtrlerArgs)."""
+
+    op: str = QUERY
+    placement: Dict[int, int] = dataclasses.field(default_factory=dict)
+    gid: int = 0
+    dst: int = 0
+    reason: str = ""
+    client_id: int = 0
+    command_id: int = 0
+
+
+@codec.registered
+@dataclasses.dataclass
+class PlaceReply:
+    err: str = OK
+    version: int = 0
+    placement: Dict[int, int] = dataclasses.field(default_factory=dict)
+    pending: Dict[int, Tuple[int, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # Recent committed moves: (version, gid, src, dst, reason).
+    history: List[Tuple[int, int, int, int, str]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class PlacementCtrler:
+    """Placement-map RSM server (RPC surface ``Placement.command``) —
+    the :class:`~multiraft_tpu.services.shardctrler.ShardCtrler`
+    skeleton verbatim: dedup by (client_id, command_id), waiters keyed
+    on log index, snapshot/restore through the codec.
+
+    State machine ops:
+
+    * ``Set``    — install a whole map (fleet bootstrap), bumps version;
+    * ``Begin``  — record migration INTENT ``gid → dst`` (no version
+      bump: the map still answers the old owner until commit);
+    * ``Commit`` — apply a begun intent: version += 1, map updated,
+      decision appended to the bounded history;
+    * ``Abort``  — drop an intent (destination died before adoption);
+    * ``Query``  — read version, map, pending intents, history.
+    """
+
+    def __init__(
+        self,
+        sched,
+        ends,
+        me: int,
+        persister,
+        maxraftstate: int = -1,
+        seed: int = 0,
+    ) -> None:
+        from ..raft.node import RaftNode
+        from ..sim.scheduler import Future
+        from ..utils.config import settings as _settings
+
+        self.sched = sched
+        self.me = me
+        self.maxraftstate = maxraftstate
+        self._snapshot_threshold = _settings().service.snapshot_threshold
+        self._server_wait = _settings().service.server_wait
+        self.version = 0
+        self.placement: Dict[int, int] = {}
+        self.pending: Dict[int, Tuple[int, str]] = {}
+        self.history: List[Tuple[int, int, int, int, str]] = []
+        self.latest: Dict[int, int] = {}
+        self._waiters: Dict[tuple, Future] = {}
+        self._Future = Future
+        self._killed = False
+        self.rf = RaftNode(sched, ends, me, persister, self._on_apply,
+                           seed=seed)
+        self._install_snapshot(persister.read_snapshot())
+
+    # -- RPC ------------------------------------------------------------
+
+    def command(self, args: PlaceArgs):
+        from ..sim.scheduler import TIMEOUT
+
+        if self._killed:
+            return PlaceReply(err=ERR_WRONG_LEADER)
+        if (
+            args.op != QUERY
+            and self.latest.get(args.client_id, -1) >= args.command_id
+        ):
+            return self._reply()
+        index, term, is_leader = self.rf.start(args)
+        if not is_leader:
+            return PlaceReply(err=ERR_WRONG_LEADER)
+        fut = self._Future()
+        key = (args.client_id, args.command_id, index)
+        self._waiters[key] = fut
+        result = yield self.sched.with_timeout(fut, self._server_wait)
+        self._waiters.pop(key, None)
+        if result is TIMEOUT:
+            return PlaceReply(err=ERR_TIMEOUT)
+        return result
+
+    def _reply(self) -> PlaceReply:
+        return PlaceReply(
+            err=OK,
+            version=self.version,
+            placement=dict(self.placement),
+            pending=dict(self.pending),
+            history=list(self.history),
+        )
+
+    # -- apply ----------------------------------------------------------
+
+    def _on_apply(self, msg) -> None:
+        if self._killed:
+            return
+        if msg.snapshot_valid:
+            self._install_snapshot(msg.snapshot)
+            return
+        if not msg.command_valid:
+            return
+        args: PlaceArgs = msg.command
+        is_dup = self.latest.get(args.client_id, -1) >= args.command_id
+        if args.op != QUERY and not is_dup:
+            if args.op == SET:
+                self.placement = {
+                    int(g): int(p) for g, p in args.placement.items()
+                }
+                self.version += 1
+            elif args.op == BEGIN:
+                self.pending[args.gid] = (args.dst, args.reason)
+            elif args.op == COMMIT:
+                intent = self.pending.pop(args.gid, None)
+                if intent is not None:
+                    dst, reason = intent
+                    src = self.placement.get(args.gid, -1)
+                    self.version += 1
+                    self.placement[args.gid] = dst
+                    self.history.append(
+                        (self.version, args.gid, src, dst, reason)
+                    )
+                    del self.history[:-HISTORY_CAP]
+            elif args.op == ABORT:
+                self.pending.pop(args.gid, None)
+        if not is_dup:
+            self.latest[args.client_id] = args.command_id
+        waiter = self._waiters.get(
+            (args.client_id, args.command_id, msg.command_index)
+        )
+        if waiter is not None:
+            term, is_leader = self.rf.get_state()
+            if is_leader and term == msg.command_term:
+                waiter.resolve(self._reply())
+        self._maybe_snapshot(msg.command_index)
+
+    # -- snapshots -------------------------------------------------------
+
+    def _maybe_snapshot(self, index: int) -> None:
+        if self.maxraftstate < 0:
+            return
+        if self.rf.raft_state_size() >= (
+            self._snapshot_threshold * self.maxraftstate
+        ):
+            blob = codec.encode({
+                "version": self.version,
+                "placement": dict(self.placement),
+                "pending": dict(self.pending),
+                "history": list(self.history),
+                "latest": dict(self.latest),
+            })
+            self.rf.snapshot(index, blob)
+
+    def _install_snapshot(self, data: bytes) -> None:
+        if not data:
+            return
+        blob = codec.decode(data)
+        self.version = blob["version"]
+        self.placement = dict(blob["placement"])
+        self.pending = dict(blob["pending"])
+        self.history = list(blob["history"])
+        self.latest = dict(blob["latest"])
+
+    def kill(self) -> None:
+        self._killed = True
+        self.rf.kill()
+
+
+class PlacementClerk:
+    """Sim-side clerk of the placement RSM (CtrlerClerk pattern:
+    leader-cycling retries, nonce-qualified client id)."""
+
+    _next_client_id = 1 << 21  # distinct from CtrlerClerk's range
+
+    def __init__(self, sched, ends) -> None:
+        from ..utils.ids import unique_client_id
+
+        self.sched = sched
+        self.ends = ends
+        self.leader = 0
+        PlacementClerk._next_client_id += 1
+        self.client_id = unique_client_id(PlacementClerk._next_client_id)
+        self.command_id = 0
+
+    def _command(self, args: PlaceArgs):
+        from ..sim.scheduler import TIMEOUT
+
+        args.client_id = self.client_id
+        self.command_id += 1
+        args.command_id = self.command_id
+        while True:
+            fut = self.ends[self.leader].call("Placement.command", args)
+            reply = yield self.sched.with_timeout(fut, 0.1)
+            if (
+                reply is TIMEOUT
+                or reply is None
+                or reply.err in (ERR_WRONG_LEADER, ERR_TIMEOUT)
+            ):
+                self.leader = (self.leader + 1) % len(self.ends)
+                continue
+            return reply
+
+    def query(self):
+        return (yield from self._command(PlaceArgs(op=QUERY)))
+
+    def set_map(self, placement: Dict[int, int]):
+        return (yield from self._command(
+            PlaceArgs(op=SET, placement=dict(placement))
+        ))
+
+    def begin(self, gid: int, dst: int, reason: str):
+        return (yield from self._command(
+            PlaceArgs(op=BEGIN, gid=gid, dst=dst, reason=reason)
+        ))
+
+    def commit(self, gid: int):
+        return (yield from self._command(PlaceArgs(op=COMMIT, gid=gid)))
+
+    def abort(self, gid: int):
+        return (yield from self._command(PlaceArgs(op=ABORT, gid=gid)))
+
+
+class LocalPlacementStore:
+    """Dict-backed stand-in for the replicated map — unit tests of the
+    controller loop that don't need RSM fault tolerance.  Same verbs
+    as the blocking RSM facade (harness/fleet.py)."""
+
+    def __init__(self, placement: Optional[Dict[int, int]] = None) -> None:
+        self.version = 1 if placement else 0
+        self.placement = dict(placement or {})
+        self.pending: Dict[int, Tuple[int, str]] = {}
+        self.history: List[Tuple[int, int, int, int, str]] = []
+
+    def query(self):
+        return (
+            self.version, dict(self.placement), dict(self.pending),
+            list(self.history),
+        )
+
+    def set_map(self, placement: Dict[int, int]) -> int:
+        self.placement = dict(placement)
+        self.version += 1
+        return self.version
+
+    def begin(self, gid: int, dst: int, reason: str) -> None:
+        self.pending[gid] = (dst, reason)
+
+    def commit(self, gid: int) -> int:
+        dst, reason = self.pending.pop(gid)
+        src = self.placement.get(gid, -1)
+        self.version += 1
+        self.placement[gid] = dst
+        self.history.append((self.version, gid, src, dst, reason))
+        del self.history[:-HISTORY_CAP]
+        return self.version
+
+    def abort(self, gid: int) -> None:
+        self.pending.pop(gid, None)
+
+
+# ---------------------------------------------------------------------------
+# The pure planner
+# ---------------------------------------------------------------------------
+
+
+def plan_moves(
+    placement: Dict[int, int],
+    loads: Dict[int, float],
+    alive: List[int],
+    *,
+    min_gain: float = 0.25,
+    cooldown_s: float = 5.0,
+    last_moved: Optional[Dict[int, float]] = None,
+    now_s: float = 0.0,
+    max_moves: int = 1,
+    exclude: Optional[set] = None,
+) -> List[Tuple[int, Optional[int], int, str]]:
+    """Decide this round's migrations.  Returns
+    ``[(gid, src_or_None, dst, reason), ...]`` — ``src None`` means the
+    source process is dead (adopt empty).
+
+    Policy, in order:
+
+    1. **Failover first, unconditionally**: every group placed on a
+       process not in ``alive`` is re-placed (weighted orphan
+       assignment).  No hysteresis, no cooldown, no cap — a dark group
+       serves nobody.
+    2. **Hysteresis**: voluntary rebalance moves happen only if the
+       planned assignment reduces the per-process load spread
+       (max − min) by at least ``min_gain`` of the current spread.
+    3. **Cooldown**: a group moved within ``cooldown_s`` stays put.
+    4. **Cap**: at most ``max_moves`` voluntary moves per round —
+       bounded concurrent migrations, by construction.
+
+    ``exclude`` gids (migrations already in flight) are pinned where
+    they are and planned around."""
+    last_moved = last_moved or {}
+    exclude = exclude or set()
+    alive = sorted(set(alive))
+    if not alive or not placement:
+        return []
+    # Weights: scraped commit rates; a group with no signal yet gets a
+    # tiny epsilon so orphan assignment still spreads them out.
+    eps = 1e-6
+    weights = {g: max(loads.get(g, 0.0), eps) for g in placement}
+
+    assign = {
+        g: (p if p in set(alive) else None) for g, p in placement.items()
+    }
+    movable = {
+        g: a for g, a in assign.items() if g not in exclude
+    }
+    pinned = {g: a for g, a in assign.items() if g in exclude}
+
+    target, raw_moves = rebalance_weighted(movable, weights, alive)
+
+    def spread(a: Dict[int, Optional[int]]) -> float:
+        load = {p: 0.0 for p in alive}
+        for g, p in a.items():
+            if p in load:
+                load[p] += weights[g]
+        return max(load.values()) - min(load.values())
+
+    failover = []
+    voluntary = []
+    for gid, src, dst in raw_moves:
+        if src is None or src not in set(alive):
+            failover.append((gid, None, dst, "failover"))
+        else:
+            voluntary.append((gid, src, dst, "rebalance"))
+
+    # Hysteresis: judge the voluntary portion of the plan by the spread
+    # it would actually achieve (failovers happen regardless).
+    if voluntary:
+        before = dict(assign)
+        for gid, _, dst, _ in failover:
+            before[gid] = dst  # failovers land either way
+        after = dict(before)
+        for gid, _, dst, _ in voluntary:
+            after[gid] = dst
+        s0, s1 = spread(before), spread(after)
+        if s0 <= 0 or (s0 - s1) < min_gain * s0:
+            voluntary = []
+
+    # Cooldown + cap on the voluntary moves only.
+    voluntary = [
+        m for m in voluntary
+        if now_s - last_moved.get(m[0], -1e18) >= cooldown_s
+    ][:max(0, int(max_moves))]
+    # Pinned gids stay pinned (sanity: planner never touches them).
+    assert not any(m[0] in pinned for m in failover + voluntary)
+    return failover + voluntary
+
+
+# ---------------------------------------------------------------------------
+# Transport (real sockets) + the controller loop
+# ---------------------------------------------------------------------------
+
+
+class TcpFleetTransport:
+    """The controller's view of the fleet over real sockets: one
+    client end per mesh process, Obs scrapes + group-migration RPCs.
+    All calls are synchronous (``sched.wait`` from the controller
+    thread) and timeout-bounded."""
+
+    PING_S = 1.0
+    SCRAPE_S = 2.0
+    MIGRATE_S = 15.0
+    PUSH_S = 5.0
+
+    def __init__(self, node, addrs: List[Tuple[str, int]]) -> None:
+        self.node = node
+        self.sched = node.sched
+        self.addrs = [(h, int(p)) for h, p in addrs]
+        self._ends = [node.client_end(h, p) for h, p in self.addrs]
+
+    @property
+    def n_procs(self) -> int:
+        return len(self.addrs)
+
+    def addr(self, proc: int) -> Tuple[str, int]:
+        return self.addrs[proc]
+
+    def _call(self, proc: int, meth: str, args: Any, timeout: float):
+        from ..sim.scheduler import TIMEOUT
+
+        reply = self.sched.wait(self._ends[proc].call(meth, args), timeout)
+        return None if reply is TIMEOUT else reply
+
+    def ping(self, proc: int) -> bool:
+        return self._call(proc, "Obs.ping", None, self.PING_S) == "pong"
+
+    def groups(self, proc: int) -> Optional[Dict[str, Any]]:
+        r = self._call(proc, "Obs.groups", None, self.SCRAPE_S)
+        return r if isinstance(r, dict) else None
+
+    def pull_group(self, proc: int, gid: int):
+        r = self._call(
+            proc, "EngineShardKV.pull_group", (gid,), self.MIGRATE_S
+        )
+        if isinstance(r, tuple) and r and r[0] == OK:
+            return r[1]
+        return None
+
+    def unseal_group(self, proc: int, gid: int) -> None:
+        self._call(proc, "EngineShardKV.unseal_group", (gid,), self.PUSH_S)
+
+    def adopt_group(self, proc: int, gid: int, blob) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.adopt_group", (gid, blob), self.MIGRATE_S
+        )
+        return isinstance(r, tuple) and bool(r) and r[0] == OK
+
+    def drop_group(self, proc: int, gid: int) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.drop_group", (gid,), self.MIGRATE_S
+        )
+        return isinstance(r, tuple) and bool(r) and r[0] == OK
+
+    def push_placement(
+        self, proc: int, version: int, addr_map: Dict[int, Tuple[str, int]]
+    ) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.place", (version, addr_map), self.PUSH_S
+        )
+        return isinstance(r, tuple) and bool(r) and r[0] == OK
+
+
+class PlacementController:
+    """The scrape → plan → migrate loop (module docstring).  ``store``
+    is the replicated map facade (``query/set_map/begin/commit/abort``
+    — harness/fleet.py's blocking RSM clerk, or
+    :class:`LocalPlacementStore` in unit tests); ``transport`` the
+    fleet view (:class:`TcpFleetTransport` or an in-process fake)."""
+
+    def __init__(
+        self,
+        transport,
+        store,
+        *,
+        scrape_s: Optional[float] = None,
+        dead_s: Optional[float] = None,
+        cooldown_s: Optional[float] = None,
+        min_gain: Optional[float] = None,
+        max_moves: Optional[int] = None,
+        obs=None,
+        recorder=None,
+        clock=time.monotonic,
+    ) -> None:
+        k = place_knobs()
+        self.transport = transport
+        self.store = store
+        self.scrape_s = k["scrape_s"] if scrape_s is None else scrape_s
+        self.dead_s = k["dead_s"] if dead_s is None else dead_s
+        self.cooldown_s = (
+            k["cooldown_s"] if cooldown_s is None else cooldown_s
+        )
+        self.min_gain = k["min_gain"] if min_gain is None else min_gain
+        self.max_moves = (
+            k["max_moves"] if max_moves is None else int(max_moves)
+        )
+        self._clock = clock
+        self._obs = obs
+        if recorder is None:
+            from .flightrec import get_recorder
+
+            recorder = get_recorder("placer")
+        self._rec = recorder
+        t0 = clock()
+        self.last_pong = {p: t0 for p in range(transport.n_procs)}
+        self.last_moved: Dict[int, float] = {}
+        self.loads: Dict[int, float] = {}
+        self.dead: set = set()
+        self.rounds = 0
+        self.moves_done = 0
+        self._pushed_version = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        assert self._thread is None
+        self._thread = threading.Thread(
+            target=self._loop, name="placement-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:
+                # The controller must outlive transient scrape/RPC
+                # failures — the fleet heals by retrying, not by the
+                # healer dying.
+                if self._obs is not None:
+                    self._obs.metrics.inc("place.step_errors")
+            self._stop.wait(self.scrape_s)
+
+    # -- observability helpers ------------------------------------------
+
+    def _record(self, gid: int, src: Optional[int], dst: int,
+                version: int, reason: str) -> None:
+        if self._rec is not None:
+            from .flightrec import PLACE
+
+            self._rec.record(
+                PLACE, code=gid, a=-1 if src is None else src, b=dst,
+                c=version, tag=reason,
+            )
+        if self._obs is not None:
+            self._obs.metrics.inc("place.moves")
+            self._obs.metrics.inc(f"place.moves_{reason}")
+
+    # -- one controller round -------------------------------------------
+
+    def scrape(self) -> None:
+        """Liveness + load: ping every process; fold per-gid commit
+        rates from ``Obs.groups`` of the live ones."""
+        now = self._clock()
+        for p in range(self.transport.n_procs):
+            if p in self.dead:
+                continue  # declared dead: stays dead (module docstring)
+            if not self.transport.ping(p):
+                continue
+            self.last_pong[p] = now
+            g = self.transport.groups(p)
+            if not g or "gids" not in g:
+                continue
+            rates = g.get("commit_rate") or [0.0] * g["G"]
+            for slot, gid in enumerate(g["gids"]):
+                if gid > 0:
+                    self.loads[gid] = float(rates[slot])
+        self.dead |= {
+            p for p in range(self.transport.n_procs)
+            if now - self.last_pong[p] > self.dead_s
+        }
+
+    def step(self) -> int:
+        """One scrape→plan→migrate round; returns moves executed."""
+        self.rounds += 1
+        self.scrape()
+        now = self._clock()
+        alive = [
+            p for p in range(self.transport.n_procs) if p not in self.dead
+        ]
+        if not alive:
+            return 0
+        version, placement, pending, _ = self.store.query()
+        if not placement:
+            return 0  # not bootstrapped yet (harness seeds the map)
+        executed = 0
+        # Resume replicated intents first — a predecessor controller
+        # may have died mid-migration (module docstring).
+        for gid, (dst, reason) in sorted(pending.items()):
+            src = placement.get(gid)
+            if dst in self.dead:
+                # Destination died before the group committed there.
+                # The adopt may or may not have landed — either way that
+                # copy is gone with the process, so unsealing the source
+                # (if it lives) cannot fork the group.
+                if src is not None and src in set(alive):
+                    self.transport.unseal_group(src, gid)
+                self.store.abort(gid)
+                continue
+            if self._execute(gid, src, dst, reason, alive):
+                executed += 1
+        version, placement, pending, _ = self.store.query()
+        moves = plan_moves(
+            placement,
+            self.loads,
+            alive,
+            min_gain=self.min_gain,
+            cooldown_s=self.cooldown_s,
+            last_moved=self.last_moved,
+            now_s=now,
+            max_moves=self.max_moves,
+            exclude=set(pending),
+        )
+        for gid, src, dst, reason in moves:
+            self.store.begin(gid, dst, reason)
+            if self._execute(gid, src, dst, reason, alive):
+                executed += 1
+        self._push(alive)
+        return executed
+
+    def _execute(
+        self, gid: int, src: Optional[int], dst: int, reason: str,
+        alive: List[int],
+    ) -> bool:
+        """Run one begun migration end-to-end.  Every leg is idempotent
+        (seal/export returns the same frozen blob, adopt/drop answer OK
+        on retry), so a False return simply leaves the intent pending
+        for the next round."""
+        from .observe import now_us
+
+        rid = f"mig-{gid}-{self.rounds}"
+        t_all = now_us()
+        src_live = src is not None and src in set(alive)
+        blob = None
+        if src_live:
+            t0 = now_us()
+            blob = self.transport.pull_group(src, gid)
+            self._trace_span("place.pull", t0, rid, gid)
+            if blob is None:
+                return False  # source not sealable yet: retry next round
+        t0 = now_us()
+        adopted = self.transport.adopt_group(dst, gid, blob)
+        self._trace_span("place.adopt", t0, rid, gid)
+        if not adopted:
+            # The adopt RPC may have landed despite the lost reply —
+            # NEVER unseal the source now.  The intent stays pending
+            # and the next round retries the (idempotent) adopt.
+            return False
+        reply_version = self.store.commit(gid)
+        version = (
+            reply_version if isinstance(reply_version, int)
+            else self.store.query()[0]
+        )
+        if src_live:
+            t0 = now_us()
+            self.transport.drop_group(src, gid)  # idempotent; best effort
+            self._trace_span("place.drop", t0, rid, gid)
+        self.last_moved[gid] = self._clock()
+        self.moves_done += 1
+        self._record(gid, src if src_live else None, dst, version, reason)
+        self._trace_span("place.total", t_all, rid, gid)
+        if self._obs is not None:
+            self._obs.tracer.instant(
+                "place", now_us(), track="place", req=rid, group=gid,
+                src=-1 if not src_live else src, dst=dst, reason=reason,
+            )
+        return True
+
+    def _trace_span(self, name: str, t0_us: float, rid: str,
+                    gid: int) -> None:
+        if self._obs is None:
+            return
+        from .observe import now_us
+
+        self._obs.tracer.span(
+            name, t0_us, now_us() - t0_us, track="place", req=rid,
+            group=gid,
+        )
+
+    def _push(self, alive: List[int]) -> None:
+        """Push the committed placement view to every live process so
+        servers re-derive peer maps and clerks can re-route.  Repushed
+        after membership changes even when the version didn't move —
+        a process that missed the last push needs it."""
+        version, placement, _, _ = self.store.query()
+        if version <= 0:
+            return
+        addr_map = {
+            g: self.transport.addr(p) for g, p in placement.items()
+        }
+        for p in alive:
+            self.transport.push_placement(p, version, addr_map)
+        self._pushed_version = version

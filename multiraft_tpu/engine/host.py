@@ -274,7 +274,9 @@ class EngineDriver:
 
     def _mask_partitions(self, mb: Mailbox) -> Mailbox:
         if self._edge_dev is None:
-            self._edge_dev = jnp.asarray(self.edge_up)
+            # copy=True: zero-copy would alias the mutable edge_up
+            # numpy mask into an async dispatch (see restore below).
+            self._edge_dev = jnp.array(self.edge_up, copy=True)
         m = self._edge_dev
         return mask_active(mb, lambda _, a: a & m)
 
@@ -332,7 +334,11 @@ class EngineDriver:
                 else:
                     held.append(item)
             self._delayed = held
-        return Mailbox(**{f: jnp.asarray(v) for f, v in host.items()})
+        # copy=True: this mailbox becomes self.inbox, which downstream
+        # callees DONATE (split flush_staged, run_ticks) — zero-copy
+        # aliasing the host scratch arrays would hand XLA memory it
+        # does not own (see restore below).
+        return Mailbox(**{f: jnp.array(v, copy=True) for f, v in host.items()})
 
     def restart_replica(self, g: int, p: int) -> None:
         """Crash-restart: persistent columns (term/vote/log/base/commit

@@ -167,9 +167,21 @@ class _Replica:
         self.pending_insert: Dict[int, ShardTicket] = {}
         self.pending_delete: Dict[int, ShardTicket] = {}
         self.pending_confirm: Dict[int, ShardTicket] = {}
+        # Tick when the oldest still-live proposal batch went out
+        # (0 = none outstanding) — the _orchestrate stall detector.
+        self.pending_since = 0
+        # Group-migration seal (BatchedShardKV.export_group): a sealed
+        # replica's applied state is frozen — every post-seal apply is a
+        # WRONG_GROUP no-op — so the exported blob is stable across
+        # export retries without draining the log.
+        self.sealed = False
 
     def can_serve(self, shard: int) -> bool:
-        """Challenge 2 gate (mirror of services/shardkv.py:225-232)."""
+        """Challenge 2 gate (mirror of services/shardkv.py:225-232).
+        ``getattr``: checkpoints pickled before the placement layer
+        restore replicas without a ``sealed`` attribute."""
+        if getattr(self, "sealed", False):
+            return False
         return self.cur.shards[shard] == self.gid and self.shards[
             shard
         ].state in (SERVING, GCING)
@@ -300,6 +312,9 @@ class BatchedShardKV(FrontierService):
         blob["ctrl_cmd"] = self._ctrl_cmd
         blob["orchestrate"] = self._orchestrate_enabled
         blob["gids"] = list(self.gids)
+        # After adopt/drop the gid→slot mapping is no longer the
+        # constructor's enumeration order — it must travel too.
+        blob["g2l"] = dict(self._g2l)
         return blob
 
     def load_state_dict(self, blob: Dict[str, Any]) -> None:
@@ -334,14 +349,27 @@ class BatchedShardKV(FrontierService):
         # while peers/routing were built from the new spec (same
         # loud-beats-lucky stance as EngineDriver.restore's mesh check).
         saved_gids = blob.get("gids")
-        if saved_gids is not None and list(saved_gids) != self.gids:
+        if saved_gids is not None and sorted(saved_gids) != sorted(self.gids):
             raise ValueError(
                 f"checkpoint hosts gids {list(saved_gids)} but this "
                 f"instance was built for gids {self.gids}; restart with "
                 "the checkpoint's gid set (or a fresh data dir)"
             )
-        # (After the guard, saved_gids can only equal self.gids — the
-        # constructor's gid→engine-group mapping stands.)
+        # Restore the checkpoint's gid→engine-group mapping: after
+        # adopt/drop (placement layer) it is no longer the constructor's
+        # enumeration order.  Older blobs lack "g2l": the constructor's
+        # mapping stands (and the list-equality guard above kept order).
+        saved_g2l = blob.get("g2l")
+        if saved_g2l is not None:
+            self.gids = list(saved_gids)
+            self._g2l = {int(g): int(l) for g, l in saved_g2l.items()}
+            self._l2g = {l: g for g, l in self._g2l.items()}
+        elif saved_gids is not None and list(saved_gids) != self.gids:
+            raise ValueError(
+                "checkpoint predates the placement layer but its gid "
+                "ORDER diverges from this instance's; restart with the "
+                "checkpoint's gid order"
+            )
 
     # -- client/admin surface ---------------------------------------------
 
@@ -461,6 +489,128 @@ class BatchedShardKV(FrontierService):
         """Device shard→gid routing table for :func:`route_keys`."""
         return self._route
 
+    # -- group placement (whole-group migration between fleet processes) --
+    #
+    # The placement controller (distributed/placement.py) moves a whole
+    # raft group between processes: seal+export at the source, adopt
+    # into a spare engine slot at the destination, drop at the source.
+    # Sealing freezes the replica without draining: every post-seal
+    # apply is a WRONG_GROUP no-op (can_serve is False), unacked, so
+    # clients retry at the destination and the per-shard dedup tables —
+    # which travel inside the blob — keep the retries exactly-once.
+
+    def free_slots(self) -> int:
+        """Spare engine groups available for :meth:`adopt_gid`."""
+        return (self.driver.cfg.G - 1) - len(self._g2l)
+
+    def is_sealed(self, gid: int) -> bool:
+        rep = self.reps.get(gid)
+        return rep is not None and getattr(rep, "sealed", False)
+
+    def export_group(self, gid: int) -> Optional[Dict[str, Any]]:
+        """Seal ``gid`` and return its serialized applied state, or
+        ``None`` if it cannot seal yet (mid-migration, config proposal
+        in flight, or behind the latest config — the caller retries).
+        Idempotent: an already-sealed group returns the same frozen
+        state again (the seal stops every mutation), so a lost reply
+        costs nothing."""
+        rep = self.reps.get(gid)
+        if rep is None:
+            return None
+        if not getattr(rep, "sealed", False):
+            if self._live(rep.pending_config):
+                return None
+            if any(sh.state != SERVING for sh in rep.shards.values()):
+                return None
+            if self.configs[-1].num > rep.cur.num:
+                return None  # catching up; export the settled state
+            rep.sealed = True
+        return {
+            "gid": gid,
+            "cur": rep.cur.clone(),
+            "prev": rep.prev.clone(),
+            "shards": {
+                s: (sh.state, dict(sh.data), dict(sh.latest))
+                for s, sh in rep.shards.items()
+            },
+        }
+
+    def unseal_group(self, gid: int) -> None:
+        """Abort a migration whose blob was NEVER dispatched to a
+        destination — once an adopt RPC may have landed, unsealing would
+        fork the group (two serving copies)."""
+        rep = self.reps.get(gid)
+        if rep is not None:
+            rep.sealed = False
+
+    def adopt_gid(self, gid: int, blob: Optional[Dict[str, Any]] = None) -> int:
+        """Host ``gid`` in a spare engine slot.  ``blob`` is a frozen
+        :meth:`export_group` state; ``None`` adopts EMPTY (dead-source
+        failover): the fresh replica starts AT the latest config with
+        empty SERVING shards rather than replaying the config history —
+        it holds no data to hand off, the historical handoffs happened
+        in the group's previous incarnation (whose peers will never
+        re-run them), and a replay would wedge the leaving-shard slots
+        in BEPULLING forever waiting for delete requests that were
+        already sent and answered.  The group's own shard data died
+        with its process (the non-durable fleet crash model; see the
+        placement module docstring).  Returns the local engine group
+        index."""
+        if gid == 0:
+            raise ValueError("engine group 0 is the config RSM")
+        if gid in self._g2l:
+            raise ValueError(f"gid {gid} already hosted here")
+        used = set(self._g2l.values())
+        free = [l for l in range(1, self.driver.cfg.G) if l not in used]
+        if not free:
+            raise RuntimeError(
+                f"no spare engine slot for gid {gid} "
+                f"(G={self.driver.cfg.G}, hosting {sorted(self._g2l)})"
+            )
+        loc = free[0]
+        rep = _Replica(gid)
+        if blob is not None:
+            rep.cur = blob["cur"].clone()
+            rep.prev = blob["prev"].clone()
+            for s, (state, data, latest) in blob["shards"].items():
+                rep.shards[int(s)] = _ShardSlot(
+                    state=state, data=dict(data), latest=dict(latest)
+                )
+        else:
+            latest = self.query_latest()
+            rep.cur = latest.clone()
+            rep.prev = rep.cur
+        # Bounded by construction: the free-slot check above caps
+        # hosted groups at the engine's fixed G-1 slots.
+        self.gids.append(gid)  # graftlint: disable=unbounded-queue
+        self._g2l[gid] = loc
+        self._l2g[loc] = gid
+        self.reps[gid] = rep
+        return loc
+
+    def group_quiesced(self, gid: int) -> bool:
+        """True when ``gid``'s slot has applied everything committed —
+        the :meth:`drop_gid` gate (a sealed group's tail applies are
+        WRONG_GROUP no-ops, but they must RESOLVE before the slot is
+        reused or their tickets would wedge)."""
+        loc = self._g2l[gid]
+        commit = int(
+            np.asarray(self.driver.last_metrics["commit_index"])[loc]
+        )
+        return self.applied_upto[loc] >= commit
+
+    def drop_gid(self, gid: int) -> None:
+        """Free ``gid``'s engine slot after a migration (or an abandoned
+        adoption).  Callers pump until :meth:`group_quiesced` first.
+        Entries accepted-but-uncommitted in the old log may still commit
+        after the slot is re-adopted — they apply against the NEW gid's
+        replica as WRONG_GROUP no-ops (its config does not assign their
+        shards to it), so slot reuse is safe."""
+        loc = self._g2l.pop(gid)
+        del self._l2g[loc]
+        self.gids.remove(gid)
+        del self.reps[gid]
+
     # -- admin convenience (pump until the ctrler op commits) -------------
 
     def admin_sync(self, kind: str, arg: Any, max_ticks: int = 3000) -> None:
@@ -546,7 +696,11 @@ class BatchedShardKV(FrontierService):
         everything around them per-slice."""
         assert g != 0, "the config RSM's log never carries firehose rows"
         f = sl.frame
-        rep = self.reps[self._l2g[g]]
+        gid = self._l2g.get(g)
+        if gid is None:
+            self._on_evicted(sl)  # slot freed by drop_gid (see _apply)
+            return
+        rep = self.reps[gid]
         errs = np.empty(len(sl.rows), np.uint8)
         ops_l = f.ops_l
         keys = f.keys
@@ -596,7 +750,14 @@ class BatchedShardKV(FrontierService):
         if g == 0:
             self._apply_ctrl(op, now)
         else:
-            self._apply_replica(self.reps[self._l2g[g]], op, now)
+            gid = self._l2g.get(g)
+            if gid is None:
+                # Slot freed by drop_gid: an accepted-but-uncommitted
+                # tail entry committed late.  Its group is gone — fail
+                # the ticket so the caller re-routes.
+                self._on_evicted(op)
+                return
+            self._apply_replica(self.reps[gid], op, now)
 
     def _apply_ctrl(self, op: Any, now: int) -> None:
         if not isinstance(op, _CtrlOp):
@@ -628,9 +789,14 @@ class BatchedShardKV(FrontierService):
             self._apply_client(rep, op, now)
         elif isinstance(op, _ConfigOp):
             # Strictly in-order, never mid-migration
-            # (mirror of services/shardkv.py:459-477).
-            if op.config.num == rep.cur.num + 1 and all(
-                sh.state == SERVING for sh in rep.shards.values()
+            # (mirror of services/shardkv.py:459-477).  A sealed replica
+            # is frozen: its exported blob must not race a config flip.
+            if (
+                not getattr(rep, "sealed", False)
+                and op.config.num == rep.cur.num + 1
+                and all(
+                    sh.state == SERVING for sh in rep.shards.values()
+                )
             ):
                 rep.prev = rep.cur
                 rep.cur = op.config
@@ -710,10 +876,42 @@ class BatchedShardKV(FrontierService):
     def _live(t: Optional[ShardTicket]) -> bool:
         return t is not None and not t.done
 
+    # Ticks a proposal batch may sit unresolved before _orchestrate
+    # abandons and re-proposes it.  Liveness, not correctness: an entry
+    # accepted under a leader that then lost quorum keeps its old term
+    # after the next election, and Raft's commit rule never counts it —
+    # only a NEW current-term entry drags it over the commit line.  An
+    # idle group generates none (payload bindings are index-keyed, so
+    # the kernel cannot inject a leader no-op), and every orchestrate
+    # verb is gated on the live ticket — a deadlock observed as a
+    # revived group stuck one config behind forever.  Re-proposing is
+    # safe: every internal op is config-num/state gated, so the stale
+    # duplicate applies as a no-op and still resolves its ticket.
+    PROPOSAL_STALL_TICKS = 200
+
     def _orchestrate(self) -> None:
         latest = self.configs[-1]
-        for gid in self.gids:
+        for gid in list(self.gids):
             rep = self.reps[gid]
+            if getattr(rep, "sealed", False):
+                continue  # frozen for export: no proposals of any kind
+            pend = [rep.pending_config,
+                    *rep.pending_insert.values(),
+                    *rep.pending_delete.values(),
+                    *rep.pending_confirm.values()]
+            if not any(self._live(t) for t in pend):
+                rep.pending_since = 0
+            elif getattr(rep, "pending_since", 0) == 0:
+                rep.pending_since = self.driver.tick
+            elif (
+                self.driver.tick - rep.pending_since
+                > self.PROPOSAL_STALL_TICKS
+            ):
+                rep.pending_config = None
+                rep.pending_insert.clear()
+                rep.pending_delete.clear()
+                rep.pending_confirm.clear()
+                rep.pending_since = 0
             # (a) config advance — only participating (or about to
             # participate) groups need to track configs.
             if (

@@ -174,7 +174,15 @@ class SplitPeering:
         for g in self.split_gs:
             for p in self._remote[g]:
                 alive[g, p] = False
-        driver.state = driver.state._replace(alive=jnp.asarray(alive))
+        # jnp.array(..., copy=True), NOT jnp.asarray: the CPU backend
+        # may zero-copy the numpy array, and the tick DONATES state —
+        # XLA would then recycle memory it does not own, and the alive
+        # mask reads back as garbage a few ticks later (observed: both
+        # owned columns flipping dead, so the group never elects;
+        # mirror of EngineDriver.restore, host.py).
+        driver.state = driver.state._replace(
+            alive=jnp.array(alive, copy=True)
+        )
         self._g_index = np.asarray(self.split_gs, np.int32)
         self._g_pos = {g: i for i, g in enumerate(self.split_gs)}
         # Per-pump cached device view for term arbitration (ring/base of
@@ -403,10 +411,16 @@ class SplitPeering:
         call per pump (called by the service's pump before the tick)."""
         if not self._stage_dirty:
             return
+        # copy=True: the CPU backend may zero-copy these numpy staging
+        # buffers, and dispatch is async — the ``m[:] = False`` reset
+        # below (and the next pump's stage writes into _stage_vals)
+        # would race the pending read, silently dropping staged
+        # vote/append lanes (observed: split groups never electing when
+        # the executable loads instantly from the persistent cache).
         self.driver.inbox = self._merge_fn(
             self.driver.inbox,
-            {p: jnp.asarray(m) for p, m in self._stage_mask.items()},
-            {f: jnp.asarray(v) for f, v in self._stage_vals.items()},
+            {p: jnp.array(m, copy=True) for p, m in self._stage_mask.items()},
+            {f: jnp.array(v, copy=True) for f, v in self._stage_vals.items()},
         )
         for m in self._stage_mask.values():
             m[:] = False

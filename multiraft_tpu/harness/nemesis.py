@@ -72,6 +72,7 @@ def make_schedule(
     include: Sequence[str] = ("delay", "drop", "partition", "sever"),
     crash_procs: Sequence[int] = (),
     crash_down_s: float = 1.0,
+    kill_procs: Sequence[int] = (),
     fault_s: Tuple[float, float] = (0.6, 1.8),
     quiet_s: Tuple[float, float] = (0.2, 0.8),
 ) -> List[Event]:
@@ -85,7 +86,14 @@ def make_schedule(
     (inbound drops + reply drops — the dedup-exercising case),
     ``partition`` (symmetric pair block, n_procs ≥ 2), ``isolate``
     (one process's inbound fully blocked — the minority case), and
-    ``sever`` (cut every live connection once, mid-stream)."""
+    ``sever`` (cut every live connection once, mid-stream).
+
+    ``kill_procs``: one PERMANENT ``kill_mesh_process`` per entry —
+    unlike ``crash``, the process is never restarted; the placement
+    controller (distributed/placement.py) is what re-places its groups
+    onto survivors.  Keep ``kill_procs`` disjoint from ``crash_procs``
+    (a crash's restart would resurrect a process the placement layer
+    has already declared dead)."""
     rng = random.Random(seed)
     kinds = [k for k in include if k != "partition" or n_procs > 1]
     events: List[Event] = []
@@ -122,6 +130,12 @@ def make_schedule(
         at = round(duration_s * (0.35 + 0.25 * k / max(1, len(crash_procs))), 3)
         events.append((at, "crash", {"proc": int(proc),
                                      "down": float(crash_down_s)}))
+    for k, proc in enumerate(kill_procs):
+        # Permanent kills land mid-run with traffic and chaos live.
+        at = round(
+            duration_s * (0.45 + 0.2 * k / max(1, len(kill_procs))), 3
+        )
+        events.append((at, "kill_mesh_process", {"proc": int(proc)}))
     # The global heal comes strictly after every window has closed —
     # it must be the schedule's last executed action.
     end = max(
@@ -229,6 +243,9 @@ class Nemesis:
         # overlay them on a merged trace without further alignment).
         self.windows: List[Dict[str, Any]] = []
         self._open: Dict[int, Dict[str, Any]] = {}
+        # Procs permanently removed by kill_mesh_process: later windows
+        # targeting them are excused instead of pushed into the void.
+        self._dead: set = set()
         self.t0_us: Optional[float] = None
         self.error: Optional[BaseException] = None
 
@@ -284,8 +301,24 @@ class Nemesis:
 
     # -- actions -----------------------------------------------------------
 
+    @staticmethod
+    def _procs_of(p: Dict[str, Any]) -> List[int]:
+        return [p[k] for k in ("proc", "a", "b") if k in p]
+
     def _start(self, kind: str, p: Dict[str, Any]) -> None:
         self._log("start", kind, p)
+        procs = self._procs_of(p)
+        if (
+            kind not in ("heal", "kill_mesh_process")
+            and any(x in self._dead for x in procs)
+        ):
+            # Target already permanently killed — nothing to fault.
+            w = self._window(kind, p, procs)
+            w["acked"] = True
+            w["excused"] = "target killed (kill_mesh_process)"
+            w["t_stop_us"] = now_us()
+            self._open.pop(id(p), None)
+            return
         if kind == "delay_storm":
             a = self.addrs[p["proc"]]
             w = self._window(kind, p, [p["proc"]])
@@ -326,6 +359,19 @@ class Nemesis:
             w = self._window(kind, p, [p["proc"]])
             self._kill(p["proc"])
             w["acked"] = True  # the kill callback ran
+        elif kind == "kill_mesh_process":
+            # Permanent: no paired stop, no restart.  Recovery is the
+            # placement controller's job, not the nemesis's.
+            if self._kill is None:
+                raise ValueError(
+                    "kill_mesh_process event but no kill callback"
+                )
+            w = self._window(kind, p, [p["proc"]])
+            self._kill(p["proc"])
+            self._dead.add(p["proc"])
+            w["acked"] = True
+            w["t_stop_us"] = now_us()
+            self._open.pop(id(p), None)
         elif kind == "heal":
             self.heal_all()
         else:
@@ -347,6 +393,15 @@ class Nemesis:
     def _stop(self, kind: str, p: Dict[str, Any]) -> None:
         self._log("stop", kind, p)
         w = self._open.pop(id(p), None)
+        if any(x in self._dead for x in self._procs_of(p)):
+            # The window's target died permanently mid-window; there is
+            # no rule state left to tear down.
+            if w is not None:
+                w["t_stop_us"] = now_us()
+                w["excused"] = (
+                    w["excused"] or "target killed (kill_mesh_process)"
+                )
+            return
         if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
             if kind == "partition":
                 aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
@@ -424,7 +479,10 @@ class Nemesis:
             if not w["acked"]:
                 bad.append(f"{tag} — never acknowledged"
                            f" ({w['excused'] or 'no excuse recorded'})")
-            elif w["kind"] in require_hits and w["hits"] < 1:
+            elif (
+                w["kind"] in require_hits and w["hits"] < 1
+                and not w["excused"]
+            ):
                 bad.append(f"{tag} — acked but zero faults applied")
         if bad:
             reason = (

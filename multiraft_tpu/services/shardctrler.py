@@ -39,6 +39,7 @@ __all__ = [
     "ShardCtrler",
     "CtrlerClerk",
     "rebalance",
+    "rebalance_weighted",
     "QUERY",
     "JOIN",
     "LEAVE",
@@ -124,6 +125,88 @@ def rebalance(shards: List[int], groups: Dict[int, List[str]]) -> List[int]:
                 counts[mn] += 1
                 break
     return out
+
+
+def rebalance_weighted(
+    assign: Dict[int, Optional[int]],
+    weights: Dict[int, float],
+    bins: List[int],
+):
+    """Weighted generalization of :func:`rebalance` for the fleet
+    placement controller: ``assign`` maps item (raft group id) to its
+    current bin (mesh process index, or ``None``/a departed bin for
+    orphans), ``weights`` gives each item's load, ``bins`` is the live
+    bin set.  Returns ``(new_assign, moves)`` with ``moves`` a list of
+    ``(item, src_bin, dst_bin)``.
+
+    Same shape as the unweighted rebalancer, so the minimal-movement
+    character carries over:
+
+    1. every item stays where it is if its bin is still live;
+    2. orphans go to the lightest bin;
+    3. while it strictly helps, move the heaviest movable item from the
+       heaviest to the lightest bin — "movable" means ``w < max - min``,
+       which keeps both bins inside the old (min, max) interval, so the
+       potential ``sum(load**2)`` strictly decreases and the loop
+       terminates.
+
+    With uniform weights the movable condition degenerates to
+    ``max - min >= 2`` — exactly the unweighted loop — so the move
+    count never exceeds the unweighted minimal-movement bound (the
+    property test in tests/test_placement.py pins this).
+
+    Deterministic (sorted tie-breaks throughout): it runs inside the
+    controller's replicated apply path, where every replica must plan
+    the identical move set."""
+    bins = sorted(set(bins))
+    if not bins:
+        return dict(assign), []
+    live = set(bins)
+    load = {b: 0.0 for b in bins}
+    out: Dict[int, int] = {}
+    moves = []
+    orphans = []
+    for item in sorted(assign):
+        b = assign[item]
+        if b in live:
+            out[item] = b
+            load[b] += weights.get(item, 0.0)
+        else:
+            orphans.append(item)
+
+    def lightest() -> int:
+        return min(bins, key=lambda b: (load[b], b))
+
+    def heaviest() -> int:
+        return max(bins, key=lambda b: (load[b], -b))
+
+    for item in orphans:
+        b = lightest()
+        out[item] = b
+        load[b] += weights.get(item, 0.0)
+        moves.append((item, assign[item], b))
+
+    # Each move strictly shrinks sum(load**2); the cap is a defensive
+    # bound, not the expected exit.
+    for _ in range(4 * len(out) + 16):
+        hi, lo = heaviest(), lightest()
+        gap = load[hi] - load[lo]
+        best = None
+        for item in sorted(out):
+            if out[item] != hi:
+                continue
+            w = weights.get(item, 0.0)
+            # w > 0: moving a zero-weight item changes no load — churn.
+            if 0 < w < gap and (best is None or w > weights.get(best, 0.0)):
+                best = item
+        if best is None:
+            break
+        out[best] = lo
+        w = weights.get(best, 0.0)
+        load[hi] -= w
+        load[lo] += w
+        moves.append((best, hi, lo))
+    return out, moves
 
 
 @codec.registered

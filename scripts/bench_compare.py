@@ -14,7 +14,12 @@ and must keep meaning what it meant):
   ``SERVING_r*.json`` (socket + in-process ops/s);
 * ``loadcurve`` — benchmarks/openloop.py open-loop sweeps tracked as
   ``LOADCURVE_r*.json`` (max sustainable rate at the p99 target, knee
-  position, p99 at the knee).
+  position, p99 at the knee);
+* ``placement`` — placement_scenario.py controller runs tracked as
+  ``PLACEMENT_r*.json`` (per-process commit-rate spread reduction
+  after rebalancing a hot/cold skew, failover re-place time after a
+  process kill, migrations executed — fewer is better: the planner
+  should fix the skew with minimal movement).
 
 ``FRESH.json`` is either the family's raw result object or a round
 wrapper (``{"parsed": {...}}``).  The history is every round file of
@@ -79,6 +84,15 @@ FAMILIES: Dict[str, Dict[str, Any]] = {
             ("max_sustainable_ops_per_sec", "max sustainable ops/s", True),
             ("knee_ops_per_sec", "knee offered rate (ops/s)", True),
             ("p99_at_knee_ms", "p99 at knee (ms)", False),
+        ],
+    },
+    "placement": {
+        "history": "PLACEMENT_r*.json",
+        "strip": "PLACEMENT_",
+        "metrics": [
+            ("spread_reduction_pct", "load-spread reduction (%)", True),
+            ("failover_replace_s", "failover re-place time (s)", False),
+            ("moves", "migrations executed", False),
         ],
     },
 }

@@ -1,6 +1,7 @@
 """Summarize a Chrome-trace JSON artifact from the observability plane.
 
-    python scripts/trace_summary.py TRACE.json[.gz] [--top N] [--stages]
+    python scripts/trace_summary.py TRACE.json[.gz] [--top N]
+                                    [--stages | --placements]
 
 Prints, for a trace produced by ``Tracer.save`` / the fleet scraper
 (harness/observe.py) / ``bench.py``:
@@ -21,9 +22,17 @@ decomposition — spans only exist at two vantage points — but the rows
 share stage names, so the trace view and the ``stage.*_s`` metrics
 line up.
 
+``--placements`` renders the placement controller's migration
+timelines (distributed/placement.py): ``place.*`` spans and ``place``
+instants are grouped by their migration rid (``mig-<gid>-<round>``)
+and printed one row per migration — group, src → dst, reason, and the
+per-leg durations (``pull`` / ``adopt`` / ``drop`` / ``total``) in the
+same stage-vocabulary style as ``--stages``.
+
 Exit code 0 when the trace parses and contains at least one event
-(for ``--stages``: at least one rid-tagged span), 2 otherwise — tests
-use this as a smoke check that emitted artifacts are actually
+(for ``--stages``: at least one rid-tagged span; for ``--placements``:
+at least one ``place.*`` span or ``place`` instant), 2 otherwise —
+tests use this as a smoke check that emitted artifacts are actually
 loadable.
 """
 
@@ -184,13 +193,67 @@ def summarize_stages(path: str) -> Dict[str, Any]:
     return {"rids": len(per_rid), "tagged_spans": tagged, "stages": stages}
 
 
+def summarize_placements(path: str) -> Dict[str, Any]:
+    """Group ``place.*`` spans / ``place`` instants by migration rid.
+
+    Returns ``{"migrations": [row...], "spans": M}`` with one row per
+    rid, ordered by start time::
+
+        {"rid", "group", "src", "dst", "reason", "ts_us",
+         "legs": {"pull"|"adopt"|"drop"|"total": dur_us}}
+
+    Works on a live controller node's saved trace and on the doctor's
+    ring export alike — the ring's ``place`` instants (track
+    ``placement``) carry the same group/src/dst/reason args."""
+    _, events = _load_events(path)
+    rows: Dict[str, Dict[str, Any]] = {}
+    spans = 0
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        ph = ev.get("ph")
+        if ph == "X" and name.startswith("place."):
+            rid = args.get("req") or f"?-{args.get('group', '?')}"
+            spans += 1
+            row = rows.setdefault(rid, {
+                "rid": rid, "group": args.get("group"),
+                "src": None, "dst": None, "reason": None,
+                "ts_us": float(ev.get("ts", 0.0)), "legs": {},
+            })
+            row["ts_us"] = min(row["ts_us"], float(ev.get("ts", 0.0)))
+            row["legs"][name[len("place."):]] = float(ev.get("dur", 0.0))
+        elif ph == "i" and (
+            name == "place" or name.startswith("place:")
+        ):
+            spans += 1
+            rid = args.get("req") or f"{name}@{ev.get('ts')}"
+            row = rows.setdefault(rid, {
+                "rid": rid, "group": args.get("group"),
+                "src": None, "dst": None, "reason": None,
+                "ts_us": float(ev.get("ts", 0.0)), "legs": {},
+            })
+            for k in ("group", "src", "dst", "reason"):
+                if args.get(k) is not None:
+                    row[k] = args[k]
+    return {
+        "migrations": sorted(rows.values(), key=lambda r: r["ts_us"]),
+        "spans": spans,
+    }
+
+
 def main() -> int:
     argv = sys.argv[1:]
     top = 10
     stages_mode = False
+    placements_mode = False
     if "--stages" in argv:
         stages_mode = True
         argv.remove("--stages")
+    if "--placements" in argv:
+        placements_mode = True
+        argv.remove("--placements")
     if "--top" in argv:
         i = argv.index("--top")
         if i + 1 >= len(argv):
@@ -202,6 +265,35 @@ def main() -> int:
         print(__doc__, file=sys.stderr)
         return 2
     path = argv[0]
+    if placements_mode:
+        try:
+            s = summarize_placements(path)
+        except Exception as exc:  # noqa: BLE001 - CLI boundary
+            print(f"error: could not read trace {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if not s["migrations"]:
+            print(f"error: trace {path!r} has no placement events",
+                  file=sys.stderr)
+            return 2
+        print(f"trace {path}")
+        print(f"  {len(s['migrations'])} migration(s) from "
+              f"{s['spans']} placement event(s)")
+        print(f"  {'rid':18s} {'group':>5s} {'move':>9s} "
+              f"{'reason':10s} {'pull ms':>9s} {'adopt ms':>9s} "
+              f"{'drop ms':>9s} {'total ms':>9s}")
+        for row in s["migrations"]:
+            def leg(name: str) -> str:
+                d = row["legs"].get(name)
+                return f"{d / 1e3:9.3f}" if d is not None else f"{'-':>9s}"
+            src = "dead" if row["src"] in (None, -1) else str(row["src"])
+            dst = "?" if row["dst"] is None else str(row["dst"])
+            print(f"  {row['rid']:18s} {str(row['group']):>5s} "
+                  f"{src + '->' + dst:>9s} "
+                  f"{str(row['reason'] or '?'):10s} "
+                  f"{leg('pull')} {leg('adopt')} {leg('drop')} "
+                  f"{leg('total')}")
+        return 0
     if stages_mode:
         try:
             s = summarize_stages(path)

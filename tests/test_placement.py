@@ -1,0 +1,828 @@
+"""Fleet placement layer (ARCHITECTURE §14): the weighted planner, the
+replicated placement map, the controller loop, and the in-process fleet
+migration path it drives.
+
+Layered like the subsystem itself:
+
+* ``rebalance_weighted`` / ``plan_moves`` — pure planning (property
+  tests: minimal movement, hysteresis, cooldown, failover exemption);
+* ``PlacementMap`` — the Raft-replicated map survives its own leader
+  dying mid-migration (two-phase Begin/Commit intents);
+* ``PlacementController`` against a scripted fake transport — failure
+  detection, intent resume, the never-unseal-after-adopt rule;
+* ``InProcessFleet`` — real BatchedShardKV group migration (seal →
+  export → adopt → drop) preserving data, dedup, and serving; empty
+  adoption after a kill;
+* observability — PLACE flight records, the postmortem doctor's
+  placement-thrash anomaly, ``trace_summary --placements``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import types
+
+import pytest
+
+from multiraft_tpu.distributed.placement import (
+    LocalPlacementStore,
+    PlacementController,
+    place_knobs,
+    plan_moves,
+)
+from multiraft_tpu.services.shardctrler import rebalance_weighted
+
+
+# ---------------------------------------------------------------------------
+# rebalance_weighted: the planner's core
+# ---------------------------------------------------------------------------
+
+
+def unweighted_move_bound(assign, bins):
+    """Minimal-movement count for UNIFORM weights: orphans must move,
+    plus each bin sheds what it holds above its final capacity.  Final
+    counts must all be ``q`` or ``q+1`` (``n = q*B + r``); assigning
+    the ``q+1`` capacities to the currently-heaviest bins minimizes
+    movement."""
+    bins = sorted(set(bins))
+    live = set(bins)
+    counts = {b: 0 for b in bins}
+    orphans = 0
+    for item, b in assign.items():
+        if b in live:
+            counts[b] += 1
+        else:
+            orphans += 1
+    q, r = divmod(len(assign), len(bins))
+    by_count = sorted(counts.values(), reverse=True)
+    caps = [q + 1] * r + [q] * (len(bins) - r)
+    return orphans + sum(
+        max(0, c - cap) for c, cap in zip(by_count, caps)
+    )
+
+
+class TestRebalanceWeighted:
+    def test_uniform_weights_minimal_movement_property(self):
+        """With uniform weights the weighted rebalancer degenerates to
+        the unweighted one, so its move count never exceeds the
+        unweighted minimal-movement bound."""
+        rng = random.Random(7)
+        for trial in range(200):
+            n_bins = rng.randint(1, 5)
+            bins = list(range(n_bins))
+            n_items = rng.randint(0, 12)
+            # Some items on live bins, some orphaned (dead bin / None).
+            assign = {}
+            for g in range(1, n_items + 1):
+                r = rng.random()
+                if r < 0.15:
+                    assign[g] = None
+                elif r < 0.3:
+                    assign[g] = 99  # departed bin
+                else:
+                    assign[g] = rng.choice(bins)
+            weights = {g: 1.0 for g in assign}
+            out, moves = rebalance_weighted(assign, weights, bins)
+            bound = unweighted_move_bound(assign, bins)
+            assert len(moves) <= bound, (trial, assign, moves, bound)
+            # Every item placed on a live bin; balanced within one item.
+            assert set(out) == set(assign)
+            assert all(b in set(bins) for b in out.values())
+            counts = {b: 0 for b in bins}
+            for b in out.values():
+                counts[b] += 1
+            assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_skewed_weights_move_light_item_not_the_heavy_one(self):
+        # The hot bin holds one heavy group and one light one; moving
+        # the heavy group would overshoot (its weight exceeds the gap),
+        # so the planner sheds the light group instead.
+        assign = {1: 0, 2: 0, 3: 1}
+        weights = {1: 10.0, 2: 1.0, 3: 8.0}
+        out, moves = rebalance_weighted(assign, weights, [0, 1])
+        assert out == {1: 0, 2: 1, 3: 1}
+        assert moves == [(2, 0, 1)]
+
+    def test_skew_strictly_reduces_spread_with_bounded_moves(self):
+        assign = {g: 0 for g in range(1, 7)}
+        weights = {g: float(g) for g in assign}
+        out, moves = rebalance_weighted(assign, weights, [0, 1, 2])
+
+        def spread(a):
+            load = {0: 0.0, 1: 0.0, 2: 0.0}
+            for g, b in a.items():
+                load[b] += weights[g]
+            return max(load.values()) - min(load.values())
+
+        assert spread(out) < spread(assign)
+        assert 0 < len(moves) <= len(assign)
+        # Moves report real (src, dst) transitions.
+        assert all(assign[g] == s and out[g] == d for g, s, d in moves)
+
+    def test_orphans_go_to_lightest_bin(self):
+        assign = {1: 0, 2: None, 3: 99}
+        weights = {1: 10.0, 2: 1.0, 3: 1.0}
+        out, moves = rebalance_weighted(assign, weights, [0, 1])
+        assert out[2] == 1 and out[3] == 1
+        assert {(g, s) for g, s, _ in moves} == {(2, None), (3, 99)}
+
+    def test_deterministic(self):
+        rng = random.Random(13)
+        assign = {g: rng.choice([0, 1, 2, None]) for g in range(1, 9)}
+        weights = {g: rng.uniform(0.0, 5.0) for g in assign}
+        a = rebalance_weighted(dict(assign), dict(weights), [0, 1, 2])
+        b = rebalance_weighted(dict(assign), dict(weights), [0, 1, 2])
+        assert a == b
+
+    def test_empty_bins_is_a_noop(self):
+        out, moves = rebalance_weighted({1: 0}, {1: 1.0}, [])
+        assert out == {1: 0} and moves == []
+
+
+# ---------------------------------------------------------------------------
+# plan_moves: policy around the planner
+# ---------------------------------------------------------------------------
+
+
+class TestPlanMoves:
+    def test_failover_bypasses_cooldown_cap_and_hysteresis(self):
+        placement = {1: 0, 2: 0, 3: 0}  # proc 0 is dead
+        moves = plan_moves(
+            placement, {1: 1.0, 2: 1.0, 3: 1.0}, alive=[1, 2],
+            min_gain=10.0,            # hysteresis would veto anything
+            cooldown_s=1e9,           # cooldown would veto anything
+            last_moved={1: 0.0, 2: 0.0, 3: 0.0}, now_s=0.0,
+            max_moves=0,              # cap would veto anything
+        )
+        assert len(moves) == 3
+        assert all(src is None and reason == "failover"
+                   for _, src, _, reason in moves)
+        assert {dst for _, _, dst, _ in moves} <= {1, 2}
+
+    def test_hysteresis_blocks_marginal_gain(self):
+        # 3 vs 2: rebalancing one unit gains only 1/1 of a spread of 1
+        # — but with min_gain past the achievable reduction, no move.
+        placement = {1: 0, 2: 0, 3: 0, 4: 1, 5: 1}
+        loads = {g: 1.0 for g in placement}
+        veto = plan_moves(placement, loads, [0, 1],
+                          min_gain=0.99, cooldown_s=0.0, max_moves=5)
+        assert veto == []
+
+    def test_voluntary_move_when_gain_clears_hysteresis(self):
+        placement = {1: 0, 2: 0, 3: 0, 4: 0}
+        loads = {1: 4.0, 2: 4.0, 3: 4.0, 4: 4.0}
+        moves = plan_moves(placement, loads, [0, 1],
+                           min_gain=0.25, cooldown_s=0.0, max_moves=8)
+        assert moves
+        assert all(r == "rebalance" for *_, r in moves)
+
+    def test_cooldown_pins_recently_moved_groups(self):
+        placement = {1: 0, 2: 0, 3: 0, 4: 0}
+        loads = {g: 4.0 for g in placement}
+        moves = plan_moves(
+            placement, loads, [0, 1], min_gain=0.1, cooldown_s=5.0,
+            last_moved={g: 99.0 for g in placement}, now_s=100.0,
+            max_moves=8,
+        )
+        assert moves == []  # all moved 1s ago, cooldown 5s
+
+    def test_max_moves_caps_voluntary_only(self):
+        placement = {g: 0 for g in range(1, 9)}
+        loads = {g: 1.0 for g in placement}
+        moves = plan_moves(placement, loads, [0, 1],
+                           min_gain=0.1, cooldown_s=0.0, max_moves=1)
+        assert len(moves) == 1
+
+    def test_exclude_pins_inflight_groups(self):
+        placement = {1: 0, 2: 0, 3: 0, 4: 0}
+        loads = {g: 4.0 for g in placement}
+        moves = plan_moves(placement, loads, [0, 1],
+                           min_gain=0.1, cooldown_s=0.0, max_moves=8,
+                           exclude={1, 2, 3, 4})
+        assert moves == []
+
+    def test_no_alive_procs_is_a_noop(self):
+        assert plan_moves({1: 0}, {1: 1.0}, []) == []
+
+    def test_knobs_resolve_from_env(self, monkeypatch):
+        monkeypatch.setenv("MRT_PLACE_MIN_GAIN", "0.5")
+        monkeypatch.setenv("MRT_PLACE_MAX_MOVES", "3")
+        k = place_knobs()
+        assert k["min_gain"] == 0.5 and int(k["max_moves"]) == 3
+        monkeypatch.setenv("MRT_PLACE_MIN_GAIN", "banana")
+        assert place_knobs()["min_gain"] == 0.25  # default on parse error
+
+
+# ---------------------------------------------------------------------------
+# PlacementMap: the replicated placement RSM
+# ---------------------------------------------------------------------------
+
+
+class TestPlacementMap:
+    def test_map_verbs_and_two_phase_intents(self):
+        from multiraft_tpu.harness.fleet import PlacementMap
+
+        pmap = PlacementMap(n=3, seed=5, initial={1: 0, 2: 1})
+        try:
+            version, placement, pending, history = pmap.query()
+            assert placement == {1: 0, 2: 1} and not pending
+            v0 = version
+
+            pmap.begin(2, 0, "rebalance")
+            _, _, pending, _ = pmap.query()
+            assert pending == {2: (0, "rebalance")}
+
+            v1 = pmap.commit(2)
+            version, placement, pending, history = pmap.query()
+            assert v1 > v0
+            assert placement == {1: 0, 2: 0} and not pending
+            assert tuple(history[-1])[1:] == (2, 1, 0, "rebalance")
+
+            pmap.begin(1, 1, "rebalance")
+            pmap.abort(1)
+            version, placement, pending, _ = pmap.query()
+            assert not pending and placement == {1: 0, 2: 0}
+            assert version == v1  # abort bumps nothing
+        finally:
+            pmap.cleanup()
+
+    def test_map_survives_its_own_leader_dying_mid_intent(self):
+        from multiraft_tpu.harness.fleet import PlacementMap
+
+        pmap = PlacementMap(n=3, seed=6, initial={1: 0, 2: 1, 3: 1})
+        try:
+            pmap.begin(3, 0, "rebalance")
+            killed = pmap.kill_leader()
+            assert killed is not None
+            # The intent (and the map) survive the leader: the next
+            # verbs elect a new one and read the same replicated state.
+            _, placement, pending, _ = pmap.query()
+            assert pending == {3: (0, "rebalance")}
+            assert placement == {1: 0, 2: 1, 3: 1}
+            pmap.commit(3)
+            _, placement, pending, _ = pmap.query()
+            assert placement[3] == 0 and not pending
+        finally:
+            pmap.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# PlacementController vs a scripted fake transport
+# ---------------------------------------------------------------------------
+
+
+class FakeTransport:
+    """Dict-backed fleet: ``hosted[proc]`` is the gid set; scripted
+    per-gid loads; knobs to fail adopts and kill processes."""
+
+    def __init__(self, n, hosted, loads=None):
+        self._n = n
+        self.hosted = {p: set(g) for p, g in hosted.items()}
+        self.loads = dict(loads or {})
+        self.down: set = set()
+        self.fail_adopt: set = set()
+        self.calls: list = []
+        self.pushes: list = []
+
+    @property
+    def n_procs(self):
+        return self._n
+
+    def addr(self, proc):
+        return ("fake", proc)
+
+    def ping(self, proc):
+        return proc not in self.down
+
+    def groups(self, proc):
+        if proc in self.down:
+            return None
+        gids = sorted(self.hosted.get(proc, ()))
+        return {
+            "G": len(gids) + 1,
+            "gids": [-1] + gids,
+            "commit_rate": [0.0] + [self.loads.get(g, 0.0) for g in gids],
+        }
+
+    def pull_group(self, proc, gid):
+        self.calls.append(("pull", proc, gid))
+        if proc in self.down or gid not in self.hosted.get(proc, ()):
+            return None
+        return {"gid": gid, "blob": True}
+
+    def unseal_group(self, proc, gid):
+        self.calls.append(("unseal", proc, gid))
+
+    def adopt_group(self, proc, gid, blob):
+        self.calls.append(("adopt", proc, gid))
+        if proc in self.down or gid in self.fail_adopt:
+            return False
+        self.hosted.setdefault(proc, set()).add(gid)
+        return True
+
+    def drop_group(self, proc, gid):
+        self.calls.append(("drop", proc, gid))
+        if proc not in self.down:
+            self.hosted.get(proc, set()).discard(gid)
+        return True
+
+    def push_placement(self, proc, version, addr_map):
+        self.pushes.append((proc, version, dict(addr_map)))
+        return proc not in self.down
+
+
+def make_controller(transport, store, **kw):
+    kw.setdefault("scrape_s", 0.0)
+    kw.setdefault("dead_s", 2.0)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("min_gain", 0.1)
+    kw.setdefault("max_moves", 1)
+    return PlacementController(transport, store, **kw)
+
+
+class TestControllerFakeFleet:
+    def test_skew_triggers_one_bounded_move(self):
+        tr = FakeTransport(2, {0: {1, 2, 3}, 1: set()},
+                           loads={1: 5.0, 2: 5.0, 3: 5.0})
+        store = LocalPlacementStore({1: 0, 2: 0, 3: 0})
+        ctl = make_controller(tr, store)
+        assert ctl.step() == 1  # max_moves bounds the round
+        _, placement, pending, history = store.query()
+        assert not pending
+        moved = [g for g, p in placement.items() if p == 1]
+        assert len(moved) == 1
+        assert history[-1][4] == "rebalance"
+        # seal → adopt → drop, in order, for the moved gid.
+        g = moved[0]
+        assert [c for c in tr.calls if c[2] == g] == [
+            ("pull", 0, g), ("adopt", 1, g), ("drop", 0, g)
+        ]
+        assert tr.pushes and tr.pushes[-1][1] == store.version
+
+    def test_dead_process_failover_is_empty_adoption(self):
+        clock = types.SimpleNamespace(t=100.0)
+        tr = FakeTransport(2, {0: {1}, 1: {2}}, loads={1: 1.0, 2: 1.0})
+        store = LocalPlacementStore({1: 0, 2: 1})
+        ctl = make_controller(tr, store, clock=lambda: clock.t)
+        ctl.step()
+        tr.down.add(0)
+        clock.t += 5.0  # past dead_s
+        ctl.step()
+        assert 0 in ctl.dead
+        _, placement, pending, history = store.query()
+        assert placement == {1: 1, 2: 1} and not pending
+        assert history[-1][4] == "failover"
+        # Dead source: no pull, no drop — adopt-empty only.
+        assert ("pull", 0, 1) not in tr.calls[3:]
+        adopts = [c for c in tr.calls if c[0] == "adopt" and c[2] == 1]
+        assert adopts == [("adopt", 1, 1)]
+
+    def test_failed_adopt_leaves_intent_pending_and_never_unseals(self):
+        tr = FakeTransport(2, {0: {1, 2, 3}, 1: set()},
+                           loads={1: 5.0, 2: 5.0, 3: 5.0})
+        store = LocalPlacementStore({1: 0, 2: 0, 3: 0})
+        ctl = make_controller(tr, store)
+        tr.fail_adopt = {1, 2, 3}
+        assert ctl.step() == 0
+        _, placement, pending, _ = store.query()
+        assert len(pending) == 1  # the begun intent survived
+        (gid, (dst, reason)), = pending.items()
+        assert placement[gid] == 0 and dst == 1
+        # The adopt reply may have been lost, not the adopt — the
+        # controller must NOT unseal the source.
+        assert all(c[0] != "unseal" for c in tr.calls)
+        # Next round: the pending intent resumes and completes.
+        tr.fail_adopt = set()
+        assert ctl.step() >= 1
+        _, placement, pending, _ = store.query()
+        assert placement[gid] == 1 and gid not in pending
+
+    def test_pending_intent_with_dead_dst_unseals_src_and_aborts(self):
+        clock = types.SimpleNamespace(t=100.0)
+        tr = FakeTransport(2, {0: {1, 2}, 1: set()},
+                           loads={1: 1.0, 2: 1.0})
+        store = LocalPlacementStore({1: 0, 2: 0})
+        # A predecessor controller began the migration, then both it
+        # and the destination died before any leg ran.
+        store.begin(1, 1, "rebalance")
+        tr.down.add(1)
+        ctl = make_controller(tr, store, min_gain=10.0,
+                              clock=lambda: clock.t)
+        clock.t += 5.0  # past dead_s: the dst is declared dead
+        ctl.step()
+        _, placement, pending, _ = store.query()
+        assert not pending and placement[1] == 0
+        assert ("unseal", 0, 1) in tr.calls
+
+    def test_dead_stays_dead_even_if_it_answers_again(self):
+        clock = types.SimpleNamespace(t=0.0)
+        tr = FakeTransport(2, {0: {1}, 1: {2}}, loads={1: 1.0, 2: 1.0})
+        store = LocalPlacementStore({1: 0, 2: 1})
+        ctl = make_controller(tr, store, clock=lambda: clock.t)
+        ctl.step()
+        tr.down.add(0)
+        clock.t += 5.0
+        ctl.step()
+        assert 0 in ctl.dead
+        tr.down.discard(0)  # zombie: starts answering pings again
+        clock.t += 1.0
+        ctl.step()
+        assert 0 in ctl.dead  # declared dead is forever
+        _, placement, _, _ = store.query()
+        assert placement == {1: 1, 2: 1}
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet: real group migration through BatchedShardKV
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def two_proc_fleet():
+    from multiraft_tpu.harness.fleet import InProcessFleet
+
+    fleet = InProcessFleet([[1], [2]], spare_slots=1, seed=3)
+    fleet.admin("join", [1])
+    fleet.admin("join", [2])
+    fleet.settle()
+    return fleet
+
+
+class TestInProcessFleetMigration:
+    def test_live_migration_preserves_data_dedup_and_serving(self):
+        from multiraft_tpu.harness.fleet import (
+            InProcessFleet,
+            LocalFleetTransport,
+        )
+
+        fleet = InProcessFleet([[1], [2]], spare_slots=1, seed=1)
+        fleet.admin("join", [1])
+        fleet.admin("join", [2])
+        fleet.settle()
+        clerk = fleet.clerk()
+        clerk.put("a", "1")
+        clerk.append("a", "2")
+        clerk.put("b", "x")
+
+        store = LocalPlacementStore({1: 0, 2: 1})
+        ctl = make_controller(LocalFleetTransport(fleet), store)
+        store.begin(2, 0, "test")
+        assert ctl._execute(2, 1, 0, "test", [0, 1])
+        assert fleet.proc_of(2) == 0
+        # Data moved with the group; dedup state too (same client id
+        # re-appending with a fresh command id still applies once).
+        assert clerk.get("a") == "12"
+        clerk.append("a", "3")
+        assert clerk.get("a") == "123"
+        assert clerk.get("b") == "x"
+        _, placement, pending, _ = store.query()
+        assert placement == {1: 0, 2: 0} and not pending
+
+    def test_empty_adoption_after_kill_serves_immediately(self):
+        from multiraft_tpu.harness.fleet import (
+            InProcessFleet,
+            LocalFleetTransport,
+        )
+
+        fleet = InProcessFleet([[1], [2]], spare_slots=1, seed=2)
+        fleet.admin("join", [1])
+        fleet.admin("join", [2])
+        fleet.settle()
+        clerk = fleet.clerk()
+        clerk.put("a", "keep")     # gid of "a" per config
+        clerk.put("b", "survivor")
+
+        store = LocalPlacementStore({1: 0, 2: 1})
+        ctl = make_controller(LocalFleetTransport(fleet), store)
+        fleet.kill(1)
+        ctl.dead.add(1)
+        # Failover: adopt-empty onto proc 0; the group's data died with
+        # the process (crash model) but the group serves again at the
+        # LATEST config — writes work immediately, no wedged BEPULLING.
+        for _ in range(5):
+            ctl.step()
+            _, placement, pending, _ = store.query()
+            if not pending and placement[2] == 0:
+                break
+        assert placement == {1: 0, 2: 0}
+        cfg = fleet.instances[0].query_latest()
+        from multiraft_tpu.services.shardkv import key2shard
+
+        for key in ("a", "b", "q"):
+            clerk.put(key, f"post-{key}")
+            assert clerk.get(key) == f"post-{key}", (
+                key, cfg.shards[key2shard(key)]
+            )
+
+    def test_controller_loop_rebalances_scraped_skew(self, two_proc_fleet):
+        from multiraft_tpu.harness.fleet import LocalFleetTransport
+
+        fleet = two_proc_fleet
+        clerk = fleet.clerk()
+        cfg = fleet.instances[0].query_latest()
+        from multiraft_tpu.services.shardkv import key2shard
+
+        keys = [f"{chr(ord('a') + i)}{i}" for i in range(26)]
+        by_gid = {}
+        for k in keys:
+            by_gid.setdefault(cfg.shards[key2shard(k)], []).append(k)
+
+        store = LocalPlacementStore({1: 0, 2: 1})
+        tr = LocalFleetTransport(fleet)
+        ctl = make_controller(tr, store, min_gain=0.1)
+        # Both groups start on proc 0 → proc 1 idles.
+        store.begin(2, 0, "setup")
+        assert ctl._execute(2, 1, 0, "setup", [0, 1])
+        # Two scrape windows of real load so rates are fresh deltas.
+        for _ in range(2):
+            for g, ks in by_gid.items():
+                for k in ks:
+                    clerk.append(k, ".")
+            ctl.scrape()
+            time.sleep(0.01)
+        moved = 0
+        for _ in range(4):
+            for g, ks in by_gid.items():
+                for k in ks:
+                    clerk.append(k, ".")
+            moved += ctl.step()
+            if moved:
+                break
+        assert moved >= 1
+        _, placement, _, history = store.query()
+        assert sorted(placement.values()) == [0, 1]  # spread back out
+        assert history[-1][4] == "rebalance"
+        # The transport records the placement push for re-routing.
+        assert tr.last_push[0] == store.version
+
+
+# ---------------------------------------------------------------------------
+# Observability: PLACE records, doctor anomaly, trace summary
+# ---------------------------------------------------------------------------
+
+
+class TestPlaceObservability:
+    def _ring_with_places(self, tmp_path, moves):
+        from multiraft_tpu.distributed import flightrec
+
+        rec = flightrec.FlightRecorder(
+            str(tmp_path / "ctl.ring"), slots=256, name="controller"
+        )
+        for gid, src, dst, version in moves:
+            rec.record(
+                flightrec.PLACE, code=gid, a=src, b=dst, c=version,
+                tag="rebalance",
+            )
+        rec.close()
+        return str(tmp_path / "ctl.ring")
+
+    def test_controller_emits_place_records(self, tmp_path, monkeypatch):
+        from multiraft_tpu.distributed import flightrec
+
+        monkeypatch.setenv("MRT_FLIGHTREC_DIR", str(tmp_path))
+        rec = flightrec.get_recorder(name="ctl")
+        tr = FakeTransport(2, {0: {1, 2, 3}, 1: set()},
+                           loads={1: 5.0, 2: 5.0, 3: 5.0})
+        store = LocalPlacementStore({1: 0, 2: 0, 3: 0})
+        ctl = make_controller(tr, store, recorder=rec)
+        assert ctl.step() == 1
+        rec.close()
+        ring = flightrec.read_ring(rec.path)
+        places = [r for r in ring["records"]
+                  if r["type"] == flightrec.PLACE]
+        assert len(places) == 1
+        r = places[0]
+        assert r["a"] == 0 and r["b"] == 1 and r["tag"] == "rebalance"
+
+    def test_doctor_flags_placement_thrash(self, tmp_path):
+        from multiraft_tpu.analysis import postmortem
+
+        # Group 7 ping-pongs 4 times back-to-back: thrash.  Group 8
+        # moves once: healthy.
+        ring = self._ring_with_places(tmp_path, [
+            (7, 0, 1, 1), (7, 1, 0, 2), (7, 0, 1, 3), (7, 1, 0, 4),
+            (8, 0, 1, 5),
+        ])
+        bundle = postmortem.load_bundle(ring)
+        analysis = postmortem.analyze(bundle)
+        kinds = [a["kind"] for a in analysis["anomalies"]]
+        assert "placement_thrash" in kinds
+        thrash = [a for a in analysis["anomalies"]
+                  if a["kind"] == "placement_thrash"]
+        assert len(thrash) == 1 and "group 7" in thrash[0]["detail"]
+        proc = analysis["procs"][0]
+        assert proc["placements"] == {7: 4, 8: 1}
+
+    def test_doctor_trace_has_placement_instants(self, tmp_path):
+        from multiraft_tpu.analysis import postmortem
+
+        ring = self._ring_with_places(tmp_path, [(7, 0, 1, 1)])
+        bundle = postmortem.load_bundle(ring)
+        tracer = postmortem.rings_to_trace(bundle)
+        inst = [e for e in tracer.events
+                if e.get("ph") == "i" and e["name"].startswith("place:")]
+        assert len(inst) == 1
+        assert inst[0]["args"]["group"] == 7
+        assert inst[0]["args"]["src"] == 0 and inst[0]["args"]["dst"] == 1
+
+    def test_trace_summary_placements(self, tmp_path):
+        from multiraft_tpu.utils.trace import Tracer
+        from scripts.trace_summary import summarize_placements
+
+        tr = Tracer()
+        t0 = 1000.0
+        tr.span("place.pull", t0, 400.0, track="place",
+                req="mig-7-1", group=7)
+        tr.span("place.adopt", t0 + 450, 300.0, track="place",
+                req="mig-7-1", group=7)
+        tr.span("place.total", t0, 900.0, track="place",
+                req="mig-7-1", group=7)
+        tr.instant("place", t0 + 900, track="place", req="mig-7-1",
+                   group=7, src=0, dst=1, reason="rebalance")
+        path = tr.save(str(tmp_path / "place_trace.json"))
+        out = summarize_placements(path)
+        assert len(out["migrations"]) == 1
+        row = out["migrations"][0]
+        assert row["rid"] == "mig-7-1" and row["group"] == 7
+        assert row["src"] == 0 and row["dst"] == 1
+        assert row["reason"] == "rebalance"
+        assert row["legs"] == {"pull": 400.0, "adopt": 300.0,
+                               "total": 900.0}
+
+    def test_trace_summary_placements_empty(self, tmp_path):
+        from multiraft_tpu.utils.trace import Tracer
+        from scripts.trace_summary import summarize_placements
+
+        path = Tracer().save(str(tmp_path / "empty.json"))
+        assert summarize_placements(path)["migrations"] == []
+
+
+# ---------------------------------------------------------------------------
+# Obs.groups: the windowed commit-rate load signal
+# ---------------------------------------------------------------------------
+
+
+class TestObsGroupsRate:
+    def _stub_node(self, commit):
+        import numpy as np
+
+        G, P = len(commit), 3
+        state = types.SimpleNamespace(
+            role=np.zeros((G, P), dtype=np.int32),
+            alive=np.ones((G, P), dtype=bool),
+            term=np.ones((G, P), dtype=np.int64),
+            commit=np.asarray(
+                [[c] * P for c in commit], dtype=np.int64
+            ),
+            applied=np.asarray(
+                [[c] * P for c in commit], dtype=np.int64
+            ),
+            log_len=np.zeros((G, P), dtype=np.int64),
+            base=np.zeros((G, P), dtype=np.int64),
+        )
+        skv = types.SimpleNamespace(
+            driver=types.SimpleNamespace(state=state),
+            _l2g={1: 7, 2: 9},
+        )
+        return types.SimpleNamespace(
+            engine_service=types.SimpleNamespace(skv=skv), state=state
+        )
+
+    def test_rate_is_delta_between_scrapes_keyed_by_gid(self):
+        from multiraft_tpu.distributed.observe import ObsControl
+
+        node = self._stub_node([5, 10, 0])
+        ctl = ObsControl(node)
+        g1 = ctl.groups()
+        assert g1["gids"] == [-1, 7, 9]
+        assert g1["commit_rate"] == [0.0, 0.0, 0.0]  # no window yet
+        node.state.commit[1, :] += 50
+        time.sleep(0.02)
+        g2 = ctl.groups()
+        assert g2["commit_rate"][0] == 0.0
+        assert g2["commit_rate"][1] > 0.0  # gid 7's slot moved
+        assert g2["commit_rate"][2] == 0.0
+        assert g2["commit"][1] == 60
+
+    def test_rate_never_negative_after_restart(self):
+        from multiraft_tpu.distributed.observe import ObsControl
+
+        node = self._stub_node([100, 100, 100])
+        ctl = ObsControl(node)
+        ctl.groups()
+        node.state.commit[:, :] = 1  # counters reset (restart)
+        time.sleep(0.01)
+        g = ctl.groups()
+        assert all(r == 0.0 for r in g["commit_rate"])
+
+
+# ---------------------------------------------------------------------------
+# Nemesis: the kill_mesh_process chaos verb
+# ---------------------------------------------------------------------------
+
+
+class TestNemesisKill:
+    def test_make_schedule_kill_events_deterministic(self):
+        from multiraft_tpu.harness.nemesis import make_schedule
+
+        kw = dict(duration_s=8.0, include=("drop",), kill_procs=[1])
+        a = make_schedule(3, 2, **kw)
+        assert a == make_schedule(3, 2, **kw)
+        kills = [(at, p) for at, k, p in a if k == "kill_mesh_process"]
+        assert kills == [(3.6, {"proc": 1})]  # 0.45 * duration
+        assert a[-1][1] == "heal"
+
+    def test_kill_dispatch_marks_dead_and_excuses_later_windows(self):
+        from multiraft_tpu.harness.nemesis import Nemesis
+
+        killed = []
+        nem = Nemesis([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                      kill=killed.append)
+        nem._start("kill_mesh_process", {"proc": 0})
+        assert killed == [0] and 0 in nem._dead
+        w = nem.windows[-1]
+        assert w["acked"] and w["t_stop_us"] is not None
+
+        # A later fault window targeting the dead proc is excused
+        # without touching the (gone) control plane.
+        nem._start("drop_storm", {"proc": 0, "dur": 1.0, "prob": 0.5})
+        w = nem.windows[-1]
+        assert w["excused"] and w["acked"]
+        nem._stop("drop_storm", {"proc": 0, "dur": 1.0, "prob": 0.5})
+        nem.verify_windows(require_hits=("drop_storm",))  # excused: ok
+
+    def test_kill_without_callback_raises(self):
+        from multiraft_tpu.harness.nemesis import Nemesis
+
+        nem = Nemesis([("127.0.0.1", 1)])
+        with pytest.raises(ValueError, match="no kill callback"):
+            nem._start("kill_mesh_process", {"proc": 0})
+
+
+# ---------------------------------------------------------------------------
+# Full placement chaos: sockets + SIGKILL + porcupine (slow / nightly)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_placement_chaos_kill_mesh_process_replaces_and_serves(tmp_path):
+    """The acceptance scenario over real sockets: a PlacedFleet (fleet
+    processes + replicated map + controller thread) takes clerk load
+    while the nemesis SIGKILLs one mesh process mid-run; every one of
+    the victim's groups is re-placed onto survivors within the
+    failure-detection deadline, the fleet serves afterwards, and the
+    sampled clerk history stays linearizable."""
+    from multiraft_tpu.harness.fleet import PlacedFleet
+    from multiraft_tpu.harness.nemesis import run_clerk_load
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    fleet = PlacedFleet(
+        [[1], [2], [3]], spare_slots=2, seed=17,
+        controller_kwargs=dict(
+            scrape_s=0.3, dead_s=2.0, cooldown_s=5.0,
+            min_gain=0.25, max_moves=1,
+        ),
+    )
+    try:
+        fleet.start()
+        for g in (1, 2, 3):
+            fleet.admin("join", [g])
+        victim = 2
+        _, placement0 = fleet.placement()
+        victim_gids = [g for g, p in placement0.items() if p == victim]
+        assert victim_gids
+
+        t_kill = time.monotonic()
+        fleet.kill_mesh_process(victim)
+        # Controller thread: ping deadline → dead → empty adoption.
+        deadline = t_kill + 120.0
+        while time.monotonic() < deadline:
+            _, placement, pending, _ = fleet.pmap.query()
+            if not pending and all(
+                placement.get(g) not in (None, victim)
+                for g in victim_gids
+            ):
+                break
+            time.sleep(0.25)
+        replace_s = time.monotonic() - t_kill
+        _, placement, pending, history = fleet.pmap.query()
+        assert all(placement[g] != victim for g in victim_gids), (
+            placement, pending
+        )
+        assert replace_s < 120.0
+        assert any(h[4] == "failover" for h in history)
+
+        # Post-failover: the fleet serves, and the history (which
+        # includes ops racing the kill) linearizes.
+        history_ops = run_clerk_load(
+            fleet.clerk, keys=["pa", "pb", "pc"],
+            n_workers=3, ops_per_worker=6, op_timeout=120.0,
+        )
+        assert_linearizable(
+            kv_model, history_ops, timeout=60.0, name="placement-chaos"
+        )
+    finally:
+        fleet.shutdown()
