@@ -55,6 +55,18 @@ BURST_MIN = 5
 THRASH_WINDOW_US = 30_000_000.0
 THRASH_MIN = 3
 
+# Data-loss window for shipped state (stateplane.py): an UNCLEAN death
+# whose last shipment for some group is older than this at the ring's
+# end means writes inside the window died unshipped.  Resolved from the
+# same env knob the shipper uses, so doctor and plane agree.
+def _ship_window_us() -> float:
+    raw = os.environ.get("MRT_SHIP_WINDOW_S")
+    try:
+        return float(raw) * 1e6 if raw is not None else 5e6
+    except ValueError:
+        return 5e6
+
+
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
@@ -346,6 +358,44 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                     ),
                     "aligned": off is not None,
                 })
+        # Shipped-state loss window: only rings that actually shipped
+        # (SHIP records present) are judged — a fleet without the state
+        # plane must not produce false positives.  For each shipped
+        # group, the gap between its last acked shipment and the ring's
+        # end is the data the standbys never saw; on an unclean death a
+        # gap past the shipping window is exactly "data loss window
+        # exceeded".
+        ship_last: Dict[int, Record] = {}
+        n_ship = 0
+        for r in recs:
+            if r["type"] == flightrec.SHIP:
+                ship_last[r["code"]] = r
+                n_ship += 1
+        if ship_last:
+            info["shipments"] = {
+                gid: {"last_frontier": r["c"], "last_kind": r["tag"]}
+                for gid, r in sorted(ship_last.items())
+            }
+            info["ship_records"] = n_ship
+        if ship_last and not ring["clean_close"]:
+            end_ts = recs[-1]["ts"]
+            window = _ship_window_us()
+            for gid, r in sorted(ship_last.items()):
+                gap = end_ts - r["ts"]
+                if gap > window:
+                    anomalies.append({
+                        "ts": aligned(r["ts"]), "proc": label,
+                        "kind": "ship_window_exceeded",
+                        "detail": (
+                            f"data loss window exceeded: group {gid}'s "
+                            f"last shipment ({r['tag']}, frontier "
+                            f"{r['c']}) was {gap / 1e6:.1f}s before "
+                            f"death > window "
+                            f"{window / 1e6:.1f}s — writes in the gap "
+                            f"died unshipped"
+                        ),
+                        "aligned": off is not None,
+                    })
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more
@@ -447,6 +497,12 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                     f"place:g{r['code']}", ts, track="placement",
                     pid=pid, group=r["code"], src=r["a"], dst=r["b"],
                     version=r["c"], reason=r["tag"],
+                )
+            elif t == flightrec.SHIP:
+                out.instant(
+                    f"ship:g{r['code']}", ts, track="ship",
+                    pid=pid, group=r["code"], records=r["a"],
+                    bytes=r["b"], frontier=r["c"], kind=r["tag"],
                 )
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
@@ -554,6 +610,15 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
                 f"    overload: {o['trips']} trip(s), first saturated: "
                 f"{o['first']}"
                 + (f", queue gauge {o['gauge']}" if o["gauge"] else "")
+            )
+        if "shipments" in p:
+            gids = ", ".join(
+                f"g{gid}@{d['last_frontier']}"
+                for gid, d in p["shipments"].items()
+            )
+            add(
+                f"    shipped state: {p['ship_records']} shipment(s), "
+                f"last frontiers {gids}"
             )
 
     if analysis["lag"]:

@@ -236,6 +236,18 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
             mesh_devices=spec.get("mesh_devices", 0),
             spare_slots=spec.get("spare_slots", 0),
+            # State plane (distributed/stateplane.py): the full fleet
+            # roster + own index turn snapshot/tail shipping on.
+            fleet_addrs=(
+                {
+                    int(p): (a[0], int(a[1]))
+                    for p, a in spec["fleet_addrs"].items()
+                }
+                if spec.get("fleet_addrs") else None
+            ),
+            me=spec.get("me"),
+            ship_sync=spec.get("ship_sync"),
+            ship_window_s=spec.get("ship_window_s"),
         )
     elif kind == "split_kv":
         _pin_platform(spec)

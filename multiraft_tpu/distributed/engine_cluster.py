@@ -318,6 +318,9 @@ class EngineFleetCluster:
         mesh_devices: int = 0,
         chaos_seed: Optional[int] = None,
         spare_slots: int = 0,
+        shipping: bool = False,
+        ship_sync: Optional[bool] = None,
+        ship_window_s: Optional[float] = None,
     ) -> None:
         # Registers the wire dataclasses (EngineCmdArgs/Reply) with the
         # codec — admin replies are refused as unregistered otherwise.
@@ -358,6 +361,21 @@ class EngineFleetCluster:
             if chaos_seed is not None:
                 # Distinct per-process streams from one harness seed.
                 spec["chaos_seed"] = int(chaos_seed) + i
+            if shipping:
+                # Durable state plane (distributed/stateplane.py): each
+                # process ships hosted-group snapshots+tails to standby
+                # processes, bounding failover data loss to the shipping
+                # window (MRT_SHIP_WINDOW_S; ship_sync=True → zero
+                # acknowledged-write loss).
+                spec["fleet_addrs"] = {
+                    str(j): [host, self.ports[j]]
+                    for j in range(len(self.assignment))
+                }
+                spec["me"] = i
+                if ship_sync is not None:
+                    spec["ship_sync"] = bool(ship_sync)
+                if ship_window_s is not None:
+                    spec["ship_window_s"] = float(ship_window_s)
             self.specs.append(spec)
         self.procs: List[Optional[subprocess.Popen]] = [None] * len(self.specs)
         self._admin_node: Optional[RpcNode] = None

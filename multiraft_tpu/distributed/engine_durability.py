@@ -61,13 +61,29 @@ class EngineDurability:
         self.state_owner = state_owner
         self.every = checkpoint_every_s
         self._last_ckpt = time.monotonic()
+        # Optional composite ack gate (stateplane.py sync shipping):
+        # when set, an ack additionally waits for the record to be
+        # covered remotely — ``synced`` becomes "fsynced locally AND
+        # shipped to a standby", so a SIGKILL after the ack can never
+        # lose the write even with the local disk gone.
+        self.extra_sync_gate = None  # Callable[[int], bool] | None
 
     def log(self, record) -> int:
         """Append one op record; returns its ack-gate seq."""
         return self.wal.append(codec.encode(record))
 
     def synced(self, seq: int) -> bool:
-        return self.wal.synced >= seq
+        if self.wal.synced < seq:
+            return False
+        gate = self.extra_sync_gate
+        return gate is None or gate(seq)
+
+    def tail_records(self, from_seq: int):
+        """Decoded ``(seq, record)`` pairs past ``from_seq`` from the
+        WAL's bounded retention — the state plane's shipping tail."""
+        return [
+            (s, codec.decode(b)) for s, b in self.wal.tail(from_seq)
+        ]
 
     def replay_records(self):
         for body in self.wal.replay():

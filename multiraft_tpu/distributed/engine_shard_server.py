@@ -78,6 +78,12 @@ class EngineShardKVService:
         fleet: Optional[bool] = None,
         make_end=None,  # (host, port) -> TcpClientEnd, for placement pushes
         placement0: Optional[dict] = None,  # gid -> (host, port), version 0
+        fleet_addrs: Optional[dict] = None,  # proc -> (host, port): the
+        # whole fleet, state-plane ship targets (distributed/stateplane)
+        me: Optional[int] = None,  # this process's index in fleet_addrs
+        ship_rules=None,  # [(regex, ShipSpec)] declarative standby rules
+        ship_sync: Optional[bool] = None,  # acks gate on shipment
+        ship_window_s: Optional[float] = None,
     ) -> None:
         self.sched = sched
         self.skv = skv
@@ -137,6 +143,39 @@ class EngineShardKVService:
             self._deletes: dict = {}
             skv.remote_fetch = self._remote_fetch
             skv.remote_delete = self._remote_delete
+        # Durable state plane (distributed/stateplane.py): ship each
+        # hosted group's snapshot+tail to rule-chosen standbys, and
+        # receive other owners' shipments into a StandbyStore.  Wired
+        # only in fleet mode with the fleet roster known.
+        self._plane = None
+        self._standby = None
+        self._ship_futs: dict = {}  # proc -> in-flight ship Future
+        self._ship_ends: dict = {}
+        self._fleet_addrs = dict(fleet_addrs or {})
+        if self._fleet_addrs and me is not None:
+            from . import flightrec
+            from .stateplane import StandbyStore, StatePlane
+
+            self._standby = StandbyStore(obs=self._obs)
+            self._plane = StatePlane(
+                skv, me=int(me), n_procs=len(self._fleet_addrs),
+                send=self._ship_send, rules=ship_rules,
+                window_s=ship_window_s, sync=ship_sync,
+                wal_seq_fn=(
+                    (lambda: durability.wal.appended)
+                    if durability is not None else None
+                ),
+                obs=self._obs, recorder=flightrec.get_recorder(),
+            )
+            # Attach AFTER the durability on_write hook above, so the
+            # WAL record exists (wal.appended names it) when the plane
+            # captures the write.
+            self._plane.attach()
+            if self._plane.sync and self._dur is not None:
+                # Acks additionally gate on at least one standby having
+                # acked the shipment covering the record (the zero-
+                # acknowledged-write-loss mode of the chaos gate).
+                self._dur.extra_sync_gate = self._plane.covered
         sched.call_soon(self._pump_loop)
 
     @property
@@ -338,11 +377,20 @@ class EngineShardKVService:
 
     def unseal_group(self, args):
         """Abort leg: only safe while the blob was never dispatched to
-        any destination (see BatchedShardKV.unseal_group)."""
+        any destination (see BatchedShardKV.unseal_group).  ``force``
+        (second arg) overrides the post-dispatch refusal — the
+        controller sends it only with the destination provably dead."""
         from ..engine.shardkv import OK as SK_OK
 
-        gid = args[0] if isinstance(args, (tuple, list)) else args
-        self.skv.unseal_group(gid)
+        if isinstance(args, (tuple, list)):
+            gid = args[0]
+            force = bool(args[1]) if len(args) > 1 else False
+        else:
+            gid, force = args, False
+        try:
+            self.skv.unseal_group(gid, force)
+        except RuntimeError:
+            return ("ErrDispatched",)
         return (SK_OK,)
 
     def adopt_group(self, args):
@@ -385,6 +433,92 @@ class EngineShardKVService:
             return (ERR_TIMEOUT,)
 
         return run()
+
+    # -- state-plane RPCs (distributed/stateplane.py) ---------------------
+
+    def ship(self, args):
+        """Ingest one framed shipment into the local StandbyStore;
+        returns the store's ack ``{"ok", "have", "gid"}`` (the shipper
+        treats ``have`` as the authoritative resend frontier)."""
+        payload = args[0] if isinstance(args, (tuple, list)) else args
+        if self._standby is None:
+            return {"ok": False, "have": -1}
+        return self._standby.receive(payload)
+
+    def standby_state(self, args):
+        """Freshness of the local standby state for ``gid`` (None when
+        holding nothing) — the controller's recovery-destination probe."""
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+        if self._standby is None:
+            return None
+        return self._standby.freshness(gid)
+
+    def recover_group(self, args):
+        """Stateful failover: adopt ``gid`` from the LOCAL standby store
+        (snapshot fast-forward + exactly-once tail replay through the
+        group's own log), answering ``(OK, "recovered")``.  With no
+        shipped state here, ``(OK, "empty")`` tells the controller to
+        fall back to explicit empty adoption."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+
+        def run():
+            from .stateplane import iter_replay_tail, recovery_blob
+
+            held = (
+                self._standby.get(gid)
+                if self._standby is not None else None
+            )
+            if held is None:
+                return (SK_OK, "empty")
+            snap, tail = held
+            if gid not in self.skv.reps:
+                blob = recovery_blob(snap, self.skv.query_latest())
+                if blob is None and not tail:
+                    return (SK_OK, "empty")
+                if self.skv.free_slots() <= 0:
+                    return (self.ERR_NO_SLOT,)
+                self.skv.adopt_gid(gid, blob)
+                self.peers.pop(gid, None)  # it's local now
+                self.m.inc("place.adoptions")
+            if tail:
+                yield from iter_replay_tail(self.skv, gid, tail)
+            self._standby.drop(gid)
+            self.m.inc("ship.recoveries")
+            return (SK_OK, "recovered")
+
+        return run()
+
+    def _ship_send(self, proc: int, payload: bytes):
+        """StatePlane delivery hook: ONE in-flight ship RPC per standby,
+        resolved by polling — the pump loop must never block on the
+        network.  Returns the PREVIOUS completed reply (None while one
+        is still flying); correctness rides on the reply's ``have``
+        frontier being authoritative and gid-tagged, not on pairing a
+        reply with the payload it answered."""
+        prev = self._ship_futs.get(proc)
+        reply = None
+        if prev is not None:
+            if not prev.done:
+                return None
+            del self._ship_futs[proc]
+            v = prev.value
+            if isinstance(v, dict):
+                reply = v
+        addr = self._fleet_addrs.get(proc)
+        if addr is None or self._make_end is None:
+            return reply
+        end = self._ship_ends.get(proc)
+        if end is None:
+            end = self._ship_ends[proc] = self._make_end(
+                addr[0], int(addr[1])
+            )
+        self._ship_futs[proc] = self.sched.with_timeout(
+            end.call("EngineShardKV.ship", (payload,)),
+            self.MIGRATE_RPC_S,
+        )
+        return reply
 
     def place(self, args):
         """Placement push from the controller: ``(version, {gid:
@@ -533,6 +667,8 @@ class EngineShardKVService:
                         k: v for k, v in seqs.items()
                         if not self._dur.synced(v)
                     })
+        if self._plane is not None:
+            self._plane.ship_round()
         self.sched.call_after(
             self._cadence.next_delay(service_busy(self.skv)),
             self._pump_loop,
@@ -807,6 +943,11 @@ def serve_engine_shardkv(
     checkpoint_every_s: float = 30.0,
     mesh_devices: int = 0,
     spare_slots: int = 0,
+    fleet_addrs: Optional[dict] = None,  # proc -> (host, port), all procs
+    me: Optional[int] = None,  # this process's index in fleet_addrs
+    ship_rules=None,
+    ship_sync: Optional[bool] = None,
+    ship_window_s: Optional[float] = None,
 ) -> RpcNode:
     """The sharded engine behind TCP: BatchedShardKV (replicated config
     + per-shard migration pipeline) on one chip-owning process.
@@ -897,7 +1038,11 @@ def serve_engine_shardkv(
                                    obs=node.obs,
                                    fleet=local_gids is not None,
                                    make_end=node.client_end,
-                                   placement0=placement0)
+                                   placement0=placement0,
+                                   fleet_addrs=fleet_addrs, me=me,
+                                   ship_rules=ship_rules,
+                                   ship_sync=ship_sync,
+                                   ship_window_s=ship_window_s)
         if dur is not None:
             svc.replay_wal()  # recovery completes before readiness
             dur.checkpoint()  # fold replay into a fresh checkpoint

@@ -98,6 +98,8 @@ OVERLOAD = 14    # code=kind a=value(µs/depth) b=bound c=window_count
 #                  tag=stage-or-gauge name (overload.py watch)
 PLACE = 15       # code=gid a=src_proc b=dst_proc c=placement_version
 #                  tag=reason (placement.py controller decisions)
+SHIP = 16        # code=gid a=n_records b=n_bytes c=acked_frontier
+#                  tag="snap"|"tail" (stateplane.py shipments)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -115,6 +117,7 @@ _TYPE_NAMES = {
     SANITIZE: "sanitize",
     OVERLOAD: "overload",
     PLACE: "place",
+    SHIP: "ship",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.

@@ -88,6 +88,7 @@ ERR_TIMEOUT = "ErrTimeout"
 QUERY = "Query"
 SET = "Set"
 BEGIN = "Begin"
+DISPATCH = "Dispatch"
 COMMIT = "Commit"
 ABORT = "Abort"
 
@@ -139,7 +140,10 @@ class PlaceReply:
     err: str = OK
     version: int = 0
     placement: Dict[int, int] = dataclasses.field(default_factory=dict)
-    pending: Dict[int, Tuple[int, str]] = dataclasses.field(
+    # gid → (dst, reason, dispatched): ``dispatched`` flips once an
+    # adopt RPC may have been sent — from then on the source may only
+    # be force-unsealed with the destination provably dead.
+    pending: Dict[int, Tuple[int, str, bool]] = dataclasses.field(
         default_factory=dict
     )
     # Recent committed moves: (version, gid, src, dst, reason).
@@ -157,12 +161,15 @@ class PlacementCtrler:
     State machine ops:
 
     * ``Set``    — install a whole map (fleet bootstrap), bumps version;
-    * ``Begin``  — record migration INTENT ``gid → dst`` (no version
+    * ``Begin``    — record migration INTENT ``gid → dst`` (no version
       bump: the map still answers the old owner until commit);
-    * ``Commit`` — apply a begun intent: version += 1, map updated,
+    * ``Dispatch`` — mark a begun intent's adopt RPC as (possibly)
+      sent; a successor controller reading the intent knows it may no
+      longer plain-unseal the source;
+    * ``Commit``   — apply a begun intent: version += 1, map updated,
       decision appended to the bounded history;
-    * ``Abort``  — drop an intent (destination died before adoption);
-    * ``Query``  — read version, map, pending intents, history.
+    * ``Abort``    — drop an intent (destination died before adoption);
+    * ``Query``    — read version, map, pending intents, history.
     """
 
     def __init__(
@@ -247,11 +254,17 @@ class PlacementCtrler:
                 }
                 self.version += 1
             elif args.op == BEGIN:
-                self.pending[args.gid] = (args.dst, args.reason)
+                self.pending[args.gid] = (args.dst, args.reason, False)
+            elif args.op == DISPATCH:
+                intent = self.pending.get(args.gid)
+                if intent is not None:
+                    self.pending[args.gid] = (
+                        intent[0], intent[1], True
+                    )
             elif args.op == COMMIT:
                 intent = self.pending.pop(args.gid, None)
                 if intent is not None:
-                    dst, reason = intent
+                    dst, reason = intent[0], intent[1]
                     src = self.placement.get(args.gid, -1)
                     self.version += 1
                     self.placement[args.gid] = dst
@@ -295,7 +308,13 @@ class PlacementCtrler:
         blob = codec.decode(data)
         self.version = blob["version"]
         self.placement = dict(blob["placement"])
-        self.pending = dict(blob["pending"])
+        # Snapshots from before dispatch tracking hold 2-tuples:
+        # normalize to (dst, reason, dispatched=False).
+        self.pending = {
+            int(g): (v[0], v[1],
+                     bool(v[2]) if len(v) > 2 else False)
+            for g, v in blob["pending"].items()
+        }
         self.history = list(blob["history"])
         self.latest = dict(blob["latest"])
 
@@ -351,6 +370,11 @@ class PlacementClerk:
             PlaceArgs(op=BEGIN, gid=gid, dst=dst, reason=reason)
         ))
 
+    def dispatch(self, gid: int):
+        return (yield from self._command(
+            PlaceArgs(op=DISPATCH, gid=gid)
+        ))
+
     def commit(self, gid: int):
         return (yield from self._command(PlaceArgs(op=COMMIT, gid=gid)))
 
@@ -366,7 +390,7 @@ class LocalPlacementStore:
     def __init__(self, placement: Optional[Dict[int, int]] = None) -> None:
         self.version = 1 if placement else 0
         self.placement = dict(placement or {})
-        self.pending: Dict[int, Tuple[int, str]] = {}
+        self.pending: Dict[int, Tuple[int, str, bool]] = {}
         self.history: List[Tuple[int, int, int, int, str]] = []
 
     def query(self):
@@ -381,10 +405,16 @@ class LocalPlacementStore:
         return self.version
 
     def begin(self, gid: int, dst: int, reason: str) -> None:
-        self.pending[gid] = (dst, reason)
+        self.pending[gid] = (dst, reason, False)
+
+    def dispatch(self, gid: int) -> None:
+        intent = self.pending.get(gid)
+        if intent is not None:
+            self.pending[gid] = (intent[0], intent[1], True)
 
     def commit(self, gid: int) -> int:
-        dst, reason = self.pending.pop(gid)
+        intent = self.pending.pop(gid)
+        dst, reason = intent[0], intent[1]
         src = self.placement.get(gid, -1)
         self.version += 1
         self.placement[gid] = dst
@@ -540,8 +570,31 @@ class TcpFleetTransport:
             return r[1]
         return None
 
-    def unseal_group(self, proc: int, gid: int) -> None:
-        self._call(proc, "EngineShardKV.unseal_group", (gid,), self.PUSH_S)
+    def unseal_group(self, proc: int, gid: int,
+                     force: bool = False) -> None:
+        self._call(
+            proc, "EngineShardKV.unseal_group", (gid, force), self.PUSH_S
+        )
+
+    def standby_state(self, proc: int, gid: int) -> Optional[Dict]:
+        """Freshness of ``proc``'s shipped standby state for ``gid``
+        (stateplane.StandbyStore.freshness), None if it holds none."""
+        r = self._call(
+            proc, "EngineShardKV.standby_state", (gid,), self.SCRAPE_S
+        )
+        return r if isinstance(r, dict) else None
+
+    def recover_group(self, proc: int, gid: int) -> Optional[str]:
+        """Ask ``proc`` to adopt ``gid`` from its OWN standby store
+        (snapshot fast-forward + exactly-once tail replay).  Returns
+        ``"recovered"``, ``"empty"`` (no shipped state there — caller
+        falls back to empty adoption), or None on RPC failure."""
+        r = self._call(
+            proc, "EngineShardKV.recover_group", (gid,), self.MIGRATE_S
+        )
+        if isinstance(r, tuple) and len(r) >= 2 and r[0] == OK:
+            return r[1]
+        return None
 
     def adopt_group(self, proc: int, gid: int, blob) -> bool:
         r = self._call(
@@ -697,15 +750,19 @@ class PlacementController:
         executed = 0
         # Resume replicated intents first — a predecessor controller
         # may have died mid-migration (module docstring).
-        for gid, (dst, reason) in sorted(pending.items()):
+        for gid, intent in sorted(pending.items()):
+            dst, reason = int(intent[0]), intent[1]
             src = placement.get(gid)
             if dst in self.dead:
                 # Destination died before the group committed there.
                 # The adopt may or may not have landed — either way that
                 # copy is gone with the process, so unsealing the source
-                # (if it lives) cannot fork the group.
+                # (if it lives) cannot fork the group.  force=True: the
+                # engine refuses a plain unseal once the intent was
+                # dispatched, and the dead destination is exactly the
+                # proof that makes forcing safe.
                 if src is not None and src in set(alive):
-                    self.transport.unseal_group(src, gid)
+                    self.transport.unseal_group(src, gid, force=True)
                 self.store.abort(gid)
                 continue
             if self._execute(gid, src, dst, reason, alive):
@@ -723,11 +780,34 @@ class PlacementController:
             exclude=set(pending),
         )
         for gid, src, dst, reason in moves:
+            if src is None and reason == "failover":
+                # Stateful failover: re-target to the standby holding
+                # the freshest shipped (snapshot, tail) pair BEFORE the
+                # intent is begun, so the replicated intent records the
+                # recovery destination.  No shipped state anywhere →
+                # the planner's load-balanced pick stands (empty adopt).
+                dst = self._freshest_dst(gid, alive, dst)
             self.store.begin(gid, dst, reason)
             if self._execute(gid, src, dst, reason, alive):
                 executed += 1
         self._push(alive)
         return executed
+
+    def _freshest_dst(self, gid: int, alive: List[int],
+                      default: int) -> int:
+        probe = getattr(self.transport, "standby_state", None)
+        if probe is None:
+            return default
+        from .stateplane import pick_freshest
+
+        states = []
+        for p in alive:
+            try:
+                states.append((p, probe(p, gid)))
+            except Exception:
+                states.append((p, None))
+        order = pick_freshest(states)
+        return order[0] if order else default
 
     def _execute(
         self, gid: int, src: Optional[int], dst: int, reason: str,
@@ -743,20 +823,44 @@ class PlacementController:
         t_all = now_us()
         src_live = src is not None and src in set(alive)
         blob = None
+        recovered = False
         if src_live:
             t0 = now_us()
             blob = self.transport.pull_group(src, gid)
             self._trace_span("place.pull", t0, rid, gid)
             if blob is None:
                 return False  # source not sealable yet: retry next round
-        t0 = now_us()
-        adopted = self.transport.adopt_group(dst, gid, blob)
-        self._trace_span("place.adopt", t0, rid, gid)
-        if not adopted:
-            # The adopt RPC may have landed despite the lost reply —
-            # NEVER unseal the source now.  The intent stays pending
-            # and the next round retries the (idempotent) adopt.
-            return False
+        # Mark the intent dispatched BEFORE any adopt/recover RPC can
+        # fly: a successor controller reading the replicated intent
+        # then knows a plain unseal of the source is no longer safe.
+        disp = getattr(self.store, "dispatch", None)
+        if disp is not None:
+            disp(gid)
+        if not src_live:
+            # Dead source: durable recovery first — the destination
+            # adopts from its own standby store (snapshot+tail replay).
+            recover = getattr(self.transport, "recover_group", None)
+            if recover is not None:
+                t0 = now_us()
+                r = recover(dst, gid)
+                self._trace_span("place.recover", t0, rid, gid)
+                if r == "recovered":
+                    recovered = True
+                    if self._obs is not None:
+                        self._obs.metrics.inc("place.recoveries")
+                elif r is None:
+                    return False  # transient RPC failure: retry round
+                # r == "empty": no shipped state at dst — fall through
+                # to the explicit empty-adoption fallback below.
+        if not recovered:
+            t0 = now_us()
+            adopted = self.transport.adopt_group(dst, gid, blob)
+            self._trace_span("place.adopt", t0, rid, gid)
+            if not adopted:
+                # The adopt RPC may have landed despite the lost reply —
+                # NEVER unseal the source now.  The intent stays pending
+                # and the next round retries the (idempotent) adopt.
+                return False
         reply_version = self.store.commit(gid)
         version = (
             reply_version if isinstance(reply_version, int)

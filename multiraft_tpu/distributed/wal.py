@@ -38,6 +38,7 @@ import os
 import struct
 import time
 import zlib
+from collections import deque
 from typing import Iterator, List, Optional, Tuple
 
 from ..utils.metrics import Metrics
@@ -58,6 +59,11 @@ class WriteAheadLog:
     thread only.  ``seq`` numbers are per-incarnation (they gate acks,
     they are not stored).
     """
+
+    #: Bounded retention for :meth:`tail` — the state plane's shipping
+    #: window is seconds, so a few thousand recent records is plenty;
+    #: older records are covered by shipped snapshots.
+    TAIL_RETAIN = 4096
 
     def __init__(
         self,
@@ -90,6 +96,9 @@ class WriteAheadLog:
         # and wedge a quiet server's ack waits forever).
         self.appended = 0  # records appended by this incarnation
         self.synced = 0    # records known durable
+        # Recent (seq, body) pairs for the state plane's WAL tailing —
+        # bounded, survives rotation (seqs are monotonic across it).
+        self._tail: deque = deque(maxlen=self.TAIL_RETAIN)
         # Black-box evidence of durability progress: append seq and
         # fsync frontier land in the crash-surviving ring, so a
         # SIGKILL'd process still shows how far its acks were covered
@@ -173,6 +182,9 @@ class WriteAheadLog:
             _HEADER.pack(_MAGIC, crc, len(body)) + body
         )
         self.appended += 1
+        # deque(maxlen=TAIL_RETAIN) from __init__ — old entries fall
+        # off as new ones land.
+        self._tail.append((self.appended, body))  # graftlint: disable=unbounded-queue
         m = self.metrics
         m.inc("wal.appends")
         m.inc("wal.bytes", _HEADER.size + len(body))
@@ -212,6 +224,17 @@ class WriteAheadLog:
             self._frec.record(
                 flightrec.WAL_FSYNC, a=self.synced, b=int(dt * 1e6)
             )
+
+    # -- tailing (state-plane shipping) -----------------------------------
+
+    def tail(self, from_seq: int) -> List[Tuple[int, bytes]]:
+        """Retained ``(seq, body)`` records with ``seq > from_seq``, in
+        append order — the per-incarnation segment iteration the state
+        plane ships between snapshots.  Retention is bounded
+        (:data:`TAIL_RETAIN`); a caller that has fallen behind the
+        retained window gets a gap (the first returned seq is not
+        ``from_seq + 1``) and must re-base on a snapshot."""
+        return [(s, b) for s, b in self._tail if s > from_seq]
 
     # -- rotation (after a successful checkpoint) -------------------------
 

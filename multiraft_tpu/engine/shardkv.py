@@ -175,6 +175,10 @@ class _Replica:
         # WRONG_GROUP no-op — so the exported blob is stable across
         # export retries without draining the log.
         self.sealed = False
+        # Set the moment an export blob leaves this process: from then
+        # on an adopt RPC MAY have been dispatched, and unsealing would
+        # risk two serving copies (unseal_group enforces this).
+        self.export_dispatched = False
 
     def can_serve(self, shard: int) -> bool:
         """Challenge 2 gate (mirror of services/shardkv.py:225-232).
@@ -525,6 +529,9 @@ class BatchedShardKV(FrontierService):
             if self.configs[-1].num > rep.cur.num:
                 return None  # catching up; export the settled state
             rep.sealed = True
+        # Once the blob is returned it may be handed to an adopt RPC;
+        # from here on only a force-unseal may revive this replica.
+        rep.export_dispatched = True
         return {
             "gid": gid,
             "cur": rep.cur.clone(),
@@ -535,13 +542,53 @@ class BatchedShardKV(FrontierService):
             },
         }
 
-    def unseal_group(self, gid: int) -> None:
-        """Abort a migration whose blob was NEVER dispatched to a
-        destination — once an adopt RPC may have landed, unsealing would
-        fork the group (two serving copies)."""
+    def snapshot_group(self, gid: int) -> Optional[Dict[str, Any]]:
+        """Non-sealing export: a deep-copied :meth:`export_group`-shaped
+        blob of ``gid``'s applied state, or ``None`` while the group is
+        mid-migration / behind config (same stability preconditions as
+        export, so the blob never captures a half-applied handoff).
+        The state-plane shipper calls this on a cadence — the group
+        keeps serving, so the copy is only a point-in-time snapshot and
+        the shipped WAL tail covers the writes after it."""
         rep = self.reps.get(gid)
-        if rep is not None:
-            rep.sealed = False
+        if rep is None or getattr(rep, "sealed", False):
+            return None
+        if self._live(rep.pending_config):
+            return None
+        if any(sh.state != SERVING for sh in rep.shards.values()):
+            return None
+        if self.configs[-1].num > rep.cur.num:
+            return None
+        return {
+            "gid": gid,
+            "cur": rep.cur.clone(),
+            "prev": rep.prev.clone(),
+            "shards": {
+                s: (sh.state, dict(sh.data), dict(sh.latest))
+                for s, sh in rep.shards.items()
+            },
+        }
+
+    def unseal_group(self, gid: int, force: bool = False) -> None:
+        """Abort a migration whose blob was NEVER dispatched to a
+        destination — once an adopt RPC may have been dispatched,
+        unsealing would fork the group (two serving copies), so a
+        post-dispatch unseal raises unless the caller proves the
+        destination can never adopt (``force=True``, the controller's
+        dead-destination resume leg)."""
+        rep = self.reps.get(gid)
+        if rep is None:
+            return
+        if (getattr(rep, "sealed", False)
+                and getattr(rep, "export_dispatched", False)
+                and not force):
+            raise RuntimeError(
+                f"gid {gid}: export blob already dispatched — unsealing "
+                "could fork the group; pass force=True only when the "
+                "destination is provably dead"
+            )
+        rep.sealed = False
+        rep.export_dispatched = False
 
     def adopt_gid(self, gid: int, blob: Optional[Dict[str, Any]] = None) -> int:
         """Host ``gid`` in a spare engine slot.  ``blob`` is a frozen

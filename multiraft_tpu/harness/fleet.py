@@ -107,6 +107,9 @@ class PlacementMap:
     def begin(self, gid: int, dst: int, reason: str) -> None:
         self._run(self._clerk.begin(gid, dst, reason))
 
+    def dispatch(self, gid: int) -> None:
+        self._run(self._clerk.dispatch(gid))
+
     def commit(self, gid: int) -> int:
         return self._run(self._clerk.commit(gid)).version
 
@@ -160,12 +163,29 @@ class PlacedFleet:
         mesh_devices: int = 0,
         chaos_seed: Optional[int] = None,
         controller_kwargs: Optional[dict] = None,
+        shipping: bool = False,
+        ship_sync: Optional[bool] = None,
+        ship_window_s: Optional[float] = None,
+        data_dir: Optional[str] = None,
     ) -> None:
         from ..distributed.engine_cluster import EngineFleetCluster
 
+        # Sync shipping gates acks through EngineDurability's
+        # extra_sync_gate — without a WAL there is no ack gate to hang
+        # it on, and "zero acknowledged-write loss" would silently not
+        # hold.  Provision a data_dir rather than no-op the guarantee.
+        self._own_data_dir = None
+        if ship_sync and data_dir is None:
+            import tempfile
+
+            data_dir = self._own_data_dir = tempfile.mkdtemp(
+                prefix="mrt-placed-fleet-"
+            )
         self.cluster = EngineFleetCluster(
             assignment, host=host, seed=seed, spare_slots=spare_slots,
             mesh_devices=mesh_devices, chaos_seed=chaos_seed,
+            shipping=shipping, ship_sync=ship_sync,
+            ship_window_s=ship_window_s, data_dir=data_dir,
         )
         self.ctrl_replicas = ctrl_replicas
         self.seed = seed
@@ -211,6 +231,11 @@ class PlacedFleet:
             self.pmap.cleanup()
             self.pmap = None
         self.cluster.shutdown()
+        if self._own_data_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._own_data_dir, ignore_errors=True)
+            self._own_data_dir = None
 
     # -- surface ---------------------------------------------------------
 
@@ -263,6 +288,10 @@ class InProcessFleet:
         self.assignment = [list(g) for g in assignment]
         self.instances: List[Any] = []
         self.killed: set = set()
+        # State-plane wiring (enable_shipping): proc -> StatePlane /
+        # StandbyStore.  Empty = shipping off (the default crash model).
+        self.planes: Dict[int, Any] = {}
+        self.standbys: Dict[int, Any] = {}
         for i, gl in enumerate(self.assignment):
             cfg = EngineConfig(
                 G=len(gl) + 1 + spare_slots, P=3, L=64, E=8, INGEST=8
@@ -325,6 +354,46 @@ class InProcessFleet:
             inst.remote_fetch = remote_fetch
             inst.remote_delete = remote_delete
 
+    # -- state plane -----------------------------------------------------
+
+    def enable_shipping(
+        self,
+        rules=None,
+        *,
+        window_s: Optional[float] = None,
+        tail_cap: Optional[int] = None,
+        sync: bool = False,
+        labels: Optional[Dict[int, str]] = None,
+        obs=None,
+    ) -> Dict[int, Any]:
+        """Wire a :class:`~multiraft_tpu.distributed.stateplane.
+        StatePlane` shipper and a ``StandbyStore`` receiver onto every
+        instance; delivery is a direct call into the standby's store
+        (dead standbys answer ``None``, like a dead process).  Shipping
+        runs inside :meth:`pump_all`, so any test that pumps the fleet
+        ships for free."""
+        from ..distributed.stateplane import StandbyStore, StatePlane
+
+        fleet = self
+        self.standbys = {
+            p: StandbyStore(obs=obs) for p in range(len(self.instances))
+        }
+
+        def send(sb: int, payload: bytes):
+            if sb in fleet.killed:
+                return None
+            return fleet.standbys[sb].receive(payload)
+
+        for p, inst in enumerate(self.instances):
+            plane = StatePlane(
+                inst, me=p, n_procs=len(self.instances), send=send,
+                rules=rules, labels=labels, window_s=window_s,
+                tail_cap=tail_cap, sync=sync, obs=obs,
+            )
+            plane.attach()
+            self.planes[p] = plane
+        return self.planes
+
     # -- fleet ops -------------------------------------------------------
 
     def admin(self, kind: str, arg) -> None:
@@ -338,6 +407,9 @@ class InProcessFleet:
         for p, inst in enumerate(self.instances):
             if p not in self.killed:
                 inst.pump(n)
+                plane = self.planes.get(p)
+                if plane is not None:
+                    plane.ship_round()
 
     def settle(self, max_rounds: int = 800) -> None:
         from ..services.shardkv import SERVING
@@ -493,9 +565,10 @@ class LocalFleetTransport:
             return None
         return inst.export_group(gid)
 
-    def unseal_group(self, proc: int, gid: int) -> None:
+    def unseal_group(self, proc: int, gid: int,
+                     force: bool = False) -> None:
         if proc not in self.fleet.killed:
-            self.fleet.instances[proc].unseal_group(gid)
+            self.fleet.instances[proc].unseal_group(gid, force)
 
     def adopt_group(self, proc: int, gid: int, blob) -> bool:
         if proc in self.fleet.killed:
@@ -517,9 +590,56 @@ class LocalFleetTransport:
         for _ in range(400):
             if inst.group_quiesced(gid):
                 inst.drop_gid(gid)
+                plane = self.fleet.planes.get(proc)
+                if plane is not None:
+                    plane.forget_group(gid)
                 return True
             inst.pump(2)
         return False
+
+    # -- state plane (distributed/stateplane.py) -------------------------
+
+    def standby_state(self, proc: int, gid: int):
+        """The standby's shipped-state freshness for ``gid`` (None when
+        the proc is dead, shipping is off, or it holds nothing) — the
+        controller's ``_freshest_dst`` probe."""
+        if proc in self.fleet.killed:
+            return None
+        store = self.fleet.standbys.get(proc)
+        return store.freshness(gid) if store is not None else None
+
+    def recover_group(self, proc: int, gid: int) -> Optional[str]:
+        """Stateful failover leg: adopt ``gid`` on ``proc`` from its
+        shipped snapshot+tail.  Returns ``"recovered"`` on success,
+        ``"empty"`` when no shipped state exists (the controller falls
+        back to explicit empty adoption), ``None`` on transient
+        failure (retry next sweep)."""
+        from ..distributed.stateplane import recovery_blob, replay_tail
+
+        fleet = self.fleet
+        if proc in fleet.killed:
+            return None
+        store = fleet.standbys.get(proc)
+        held = store.get(gid) if store is not None else None
+        if held is None:
+            return "empty"
+        snap, tail = held
+        inst = fleet.instances[proc]
+        if gid not in inst.reps:
+            blob = recovery_blob(snap, inst.query_latest())
+            if blob is None and not tail:
+                return "empty"
+            if inst.free_slots() < 1:
+                return None
+            inst.adopt_gid(gid, blob)
+        if tail:
+            # Re-submit through the group's own log with the original
+            # session ids — dedup (restored from the snapshot) makes a
+            # repeated attempt exactly-once.
+            replay_tail(inst, gid, tail,
+                        pump=lambda: fleet.pump_all(2))
+        store.drop(gid)
+        return "recovered"
 
     def push_placement(self, proc: int, version: int, addr_map) -> bool:
         # In-process routing is live (owner_of), so there is no peer

@@ -93,6 +93,11 @@ FAMILIES: Dict[str, Dict[str, Any]] = {
             ("spread_reduction_pct", "load-spread reduction (%)", True),
             ("failover_replace_s", "failover re-place time (s)", False),
             ("moves", "migrations executed", False),
+            # Durable state plane (r02+; absent in earlier rounds →
+            # shown as n/a, never a regression).
+            ("durable_failover_s", "durable failover time (s)", False),
+            ("lost_acked_writes", "acked writes lost", False),
+            ("ship_tail_records", "tail records shipped", True),
         ],
     },
 }

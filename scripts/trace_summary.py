@@ -1,7 +1,7 @@
 """Summarize a Chrome-trace JSON artifact from the observability plane.
 
     python scripts/trace_summary.py TRACE.json[.gz] [--top N]
-                                    [--stages | --placements]
+                                    [--stages | --placements | --shipments]
 
 Prints, for a trace produced by ``Tracer.save`` / the fleet scraper
 (harness/observe.py) / ``bench.py``:
@@ -29,9 +29,17 @@ and printed one row per migration — group, src → dst, reason, and the
 per-leg durations (``pull`` / ``adopt`` / ``drop`` / ``total``) in the
 same stage-vocabulary style as ``--stages``.
 
+``--shipments`` renders the durable state plane's shipping activity
+(distributed/stateplane.py): ``ship:g<gid>`` instants (track ``ship``,
+emitted by the doctor's ring export of SHIP flight records) are
+grouped per group and printed one row per group — shipment count,
+snapshot vs tail split, bytes shipped, records tailed, and the last
+acked frontier the owner saw before the trace ended.
+
 Exit code 0 when the trace parses and contains at least one event
 (for ``--stages``: at least one rid-tagged span; for ``--placements``:
-at least one ``place.*`` span or ``place`` instant), 2 otherwise —
+at least one ``place.*`` span or ``place`` instant; for
+``--shipments``: at least one ``ship:*`` instant), 2 otherwise —
 tests use this as a smoke check that emitted artifacts are actually
 loadable.
 """
@@ -243,17 +251,76 @@ def summarize_placements(path: str) -> Dict[str, Any]:
     }
 
 
+def summarize_shipments(path: str) -> Dict[str, Any]:
+    """Group ``ship:g<gid>`` instants (track ``ship``) per group.
+
+    Returns ``{"groups": [row...], "events": M}`` with one row per
+    group, ordered by group id::
+
+        {"group", "shipments", "snaps", "tails", "bytes", "records",
+         "last_frontier", "last_kind", "last_ts_us"}
+
+    The instants come from the doctor's ring export (postmortem.py
+    converts SHIP flight records), so this view works on the same
+    artifact the anomaly scan reads."""
+    _, events = _load_events(path)
+    rows: Dict[Any, Dict[str, Any]] = {}
+    n = 0
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "i":
+            continue
+        name = ev.get("name", "")
+        if not name.startswith("ship:"):
+            continue
+        args = ev.get("args") or {}
+        n += 1
+        gid = args.get("group")
+        if gid is None:
+            try:
+                gid = int(name[len("ship:g"):])
+            except ValueError:
+                gid = name
+        ts = float(ev.get("ts", 0.0))
+        row = rows.setdefault(gid, {
+            "group": gid, "shipments": 0, "snaps": 0, "tails": 0,
+            "bytes": 0, "records": 0,
+            "last_frontier": None, "last_kind": None, "last_ts_us": ts,
+        })
+        row["shipments"] += 1
+        kind = args.get("kind")
+        if kind == "snap":
+            row["snaps"] += 1
+        elif kind == "tail":
+            row["tails"] += 1
+        row["bytes"] += int(args.get("bytes") or 0)
+        row["records"] += int(args.get("records") or 0)
+        if ts >= row["last_ts_us"]:
+            row["last_ts_us"] = ts
+            if args.get("frontier") is not None:
+                row["last_frontier"] = args["frontier"]
+            if kind is not None:
+                row["last_kind"] = kind
+    return {
+        "groups": sorted(rows.values(), key=lambda r: str(r["group"])),
+        "events": n,
+    }
+
+
 def main() -> int:
     argv = sys.argv[1:]
     top = 10
     stages_mode = False
     placements_mode = False
+    shipments_mode = False
     if "--stages" in argv:
         stages_mode = True
         argv.remove("--stages")
     if "--placements" in argv:
         placements_mode = True
         argv.remove("--placements")
+    if "--shipments" in argv:
+        shipments_mode = True
+        argv.remove("--shipments")
     if "--top" in argv:
         i = argv.index("--top")
         if i + 1 >= len(argv):
@@ -265,6 +332,31 @@ def main() -> int:
         print(__doc__, file=sys.stderr)
         return 2
     path = argv[0]
+    if shipments_mode:
+        try:
+            s = summarize_shipments(path)
+        except Exception as exc:  # noqa: BLE001 - CLI boundary
+            print(f"error: could not read trace {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+        if not s["groups"]:
+            print(f"error: trace {path!r} has no shipment events",
+                  file=sys.stderr)
+            return 2
+        print(f"trace {path}")
+        print(f"  {len(s['groups'])} group(s) from "
+              f"{s['events']} shipment event(s)")
+        print(f"  {'group':>5s} {'ships':>6s} {'snaps':>6s} "
+              f"{'tails':>6s} {'bytes':>10s} {'records':>8s} "
+              f"{'frontier':>9s} {'last':>5s}")
+        for row in s["groups"]:
+            frontier = ("-" if row["last_frontier"] is None
+                        else str(row["last_frontier"]))
+            print(f"  {str(row['group']):>5s} {row['shipments']:6d} "
+                  f"{row['snaps']:6d} {row['tails']:6d} "
+                  f"{row['bytes']:10d} {row['records']:8d} "
+                  f"{frontier:>9s} {str(row['last_kind'] or '?'):>5s}")
+        return 0
     if placements_mode:
         try:
             s = summarize_placements(path)

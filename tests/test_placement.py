@@ -231,7 +231,11 @@ class TestPlacementMap:
 
             pmap.begin(2, 0, "rebalance")
             _, _, pending, _ = pmap.query()
-            assert pending == {2: (0, "rebalance")}
+            assert pending == {2: (0, "rebalance", False)}
+
+            pmap.dispatch(2)
+            _, _, pending, _ = pmap.query()
+            assert pending == {2: (0, "rebalance", True)}
 
             v1 = pmap.commit(2)
             version, placement, pending, history = pmap.query()
@@ -258,7 +262,7 @@ class TestPlacementMap:
             # The intent (and the map) survive the leader: the next
             # verbs elect a new one and read the same replicated state.
             _, placement, pending, _ = pmap.query()
-            assert pending == {3: (0, "rebalance")}
+            assert pending == {3: (0, "rebalance", False)}
             assert placement == {1: 0, 2: 1, 3: 1}
             pmap.commit(3)
             _, placement, pending, _ = pmap.query()
@@ -311,7 +315,7 @@ class FakeTransport:
             return None
         return {"gid": gid, "blob": True}
 
-    def unseal_group(self, proc, gid):
+    def unseal_group(self, proc, gid, force=False):
         self.calls.append(("unseal", proc, gid))
 
     def adopt_group(self, proc, gid, blob):
@@ -387,7 +391,9 @@ class TestControllerFakeFleet:
         assert ctl.step() == 0
         _, placement, pending, _ = store.query()
         assert len(pending) == 1  # the begun intent survived
-        (gid, (dst, reason)), = pending.items()
+        (gid, (dst, reason, dispatched)), = pending.items()
+        # The adopt RPC flew before it failed — the intent records that.
+        assert dispatched
         assert placement[gid] == 0 and dst == 1
         # The adopt reply may have been lost, not the adopt — the
         # controller must NOT unseal the source.

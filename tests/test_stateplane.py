@@ -1,0 +1,775 @@
+"""Durable state plane (ARCHITECTURE §15): snapshot shipping, WAL
+tailing, and stateful cross-host failover.
+
+Layered like the subsystem itself:
+
+* ``match_ship_rules`` / ``choose_standbys`` — declarative rule
+  resolution (pin, spread, anti-affinity, no-rule fallback);
+* ``frame_blob`` / ``unframe_blob`` — the WAL torn-tail contract on the
+  shipment wire format, brute-force fuzzed (byte flips + truncation);
+* ``StandbyStore`` / ``pick_freshest`` — receive-time validation and
+  freshness ordering across owner incarnations (stale / offline
+  standbys);
+* ``StatePlane`` — capture, cadence, sync-ship ack gate, metrics, SHIP
+  flight records;
+* ``InProcessFleet`` — the full durable-failover path: ship → SIGKILL
+  (crash model) → controller recovery through the adopt path with
+  exactly-once tail replay; empty adoption as the EXPLICIT fallback
+  only; ``unseal_group`` post-dispatch raises;
+* observability — the doctor's ``ship_window_exceeded`` anomaly and
+  ``trace_summary --shipments``;
+* the slow chaos gate: a socket ``PlacedFleet`` with sync shipping
+  loses ZERO acknowledged writes across a mesh-process SIGKILL
+  (porcupine-checked).
+"""
+
+from __future__ import annotations
+
+import time
+import types
+
+import pytest
+
+from multiraft_tpu.distributed.stateplane import (
+    DEFAULT_SPEC,
+    ShipSpec,
+    StandbyStore,
+    StatePlane,
+    choose_standbys,
+    frame_blob,
+    match_ship_rules,
+    pick_freshest,
+    unframe_blob,
+)
+from multiraft_tpu.transport import codec
+
+
+# ---------------------------------------------------------------------------
+# Declarative shipping rules
+# ---------------------------------------------------------------------------
+
+
+class TestShipRules:
+    def test_no_rule_falls_back_to_one_standby_not_the_owner(self):
+        # Unmatched groups are never silently unprotected.
+        assert match_ship_rules([], "gid-7") is DEFAULT_SPEC
+        sbs = choose_standbys(7, owner=1, procs=[0, 1, 2])
+        assert len(sbs) == 1 and sbs[0] != 1
+
+    def test_pin_restricts_standbys_to_named_procs(self):
+        rules = [(r"gid-3", ShipSpec(pin=(2,)))]
+        assert choose_standbys(3, 0, [0, 1, 2, 3], rules) == [2]
+        # A pin naming only the owner is unsatisfiable: no standbys.
+        rules = [(r"gid-3", ShipSpec(pin=(0,)))]
+        assert choose_standbys(3, 0, [0, 1, 2, 3], rules) == []
+
+    def test_anti_affinity_never_picks_avoided_procs(self):
+        rules = [(r".*", ShipSpec(copies=3, avoid=(1, 2)))]
+        for gid in range(1, 9):
+            sbs = choose_standbys(gid, 0, [0, 1, 2, 3, 4], rules)
+            assert sbs and not set(sbs) & {0, 1, 2}
+
+    def test_spread_takes_n_distinct_copies_rotated_by_gid(self):
+        rules = [(r".*", ShipSpec(copies=2))]
+        seen_first = set()
+        for gid in range(1, 7):
+            sbs = choose_standbys(gid, 0, [0, 1, 2, 3], rules)
+            assert len(sbs) == 2 == len(set(sbs)) and 0 not in sbs
+            seen_first.add(sbs[0])
+        # Different gids start at different candidates (deterministic
+        # spread, not everyone hammering the same standby).
+        assert len(seen_first) > 1
+
+    def test_first_match_wins_and_labels_are_matchable(self):
+        rules = [
+            (r"tier=gold", ShipSpec(copies=3)),
+            (r"gid-\d+", ShipSpec(copies=1)),
+        ]
+        assert match_ship_rules(rules, "gid-4 tier=gold").copies == 3
+        assert match_ship_rules(rules, "gid-4").copies == 1
+        gold = choose_standbys(4, 0, [0, 1, 2, 3], rules,
+                               label="tier=gold")
+        assert len(gold) == 3
+
+
+# ---------------------------------------------------------------------------
+# Shipment framing: the WAL torn-tail contract, fuzzed
+# ---------------------------------------------------------------------------
+
+
+class TestShipFraming:
+    def test_round_trip(self):
+        body = codec.encode({"gid": 1, "records": [(1, ("Put",))]})
+        assert unframe_blob(frame_blob(body)) == body
+        assert unframe_blob(frame_blob(b"")) == b""
+
+    def test_bit_flip_at_every_offset_is_discarded(self):
+        # The acceptance invariant, brute-forced: flip a byte at EVERY
+        # offset of a framed shipment; unframe never returns damaged
+        # bytes and never raises (tests/test_flightrec.py style).
+        buf = frame_blob(b"shipment-body-0123456789")
+        for k in range(len(buf)):
+            raw = bytearray(buf)
+            raw[k] ^= 0xA5
+            assert unframe_blob(bytes(raw)) is None, f"offset {k}"
+
+    def test_truncation_at_every_length_is_discarded(self):
+        # A half-received shipment (torn tail) at ANY cut point fails
+        # validation — never stored, never adopted.
+        buf = frame_blob(b"partial-delivery-payload")
+        for n in range(len(buf)):
+            assert unframe_blob(buf[:n]) is None, f"len {n}"
+
+    def test_garbage_and_wrong_magic_rejected(self):
+        assert unframe_blob(b"") is None
+        assert unframe_blob(b"MRWL" + b"\x00" * 20) is None  # WAL magic
+        assert unframe_blob(None) is None
+        assert unframe_blob(b"\xff" * 64) is None
+
+
+# ---------------------------------------------------------------------------
+# Standby store + freshness ordering
+# ---------------------------------------------------------------------------
+
+
+def _payload(gid, token, kind, snap_seq=0, snap=None, records=(), ts=0.0):
+    return frame_blob(codec.encode({
+        "gid": gid, "token": token, "kind": kind, "snap_seq": snap_seq,
+        "snap": snap, "records": list(records), "ts": ts,
+    }))
+
+
+class TestStandbyStore:
+    def test_corrupt_payload_rejected_and_never_stored(self):
+        store = StandbyStore()
+        good = _payload(3, "t1", "snap", snap_seq=2,
+                        snap={"gid": 3}, ts=1.0)
+        bad = bytearray(good)
+        bad[len(bad) // 2] ^= 0xFF
+        r = store.receive(bytes(bad))
+        assert r == {"ok": False, "have": -1}
+        assert store.rejects == 1 and store.freshness(3) is None
+        # The pristine copy still lands.
+        assert store.receive(good)["ok"]
+        assert store.freshness(3)["snap_seq"] == 2
+
+    def test_tail_must_extend_contiguously(self):
+        store = StandbyStore()
+        store.receive(_payload(5, "t1", "snap", snap_seq=0,
+                               snap={"gid": 5}, ts=1.0))
+        ok = store.receive(_payload(
+            5, "t1", "tail", records=[(1, ("Put", "a", "1", 9, 1))],
+            ts=2.0,
+        ))
+        assert ok["ok"] and ok["have"] == 1
+        # A gap (seq 3 without 2) is refused, reporting the frontier so
+        # the shipper resends from there.
+        gap = store.receive(_payload(
+            5, "t1", "tail", records=[(3, ("Put", "c", "3", 9, 3))],
+            ts=3.0,
+        ))
+        assert not gap["ok"] and gap["have"] == 1
+        # Overlap is fine: already-held seqs are skipped.
+        dup = store.receive(_payload(
+            5, "t1", "tail",
+            records=[(1, ("Put", "a", "1", 9, 1)),
+                     (2, ("Put", "b", "2", 9, 2))],
+            ts=4.0,
+        ))
+        assert dup["ok"] and dup["have"] == 2
+        snap, tail = store.get(5)
+        assert [s for s, _r in enumerate(tail, start=1)] == [1, 2]
+
+    def test_midstream_tail_under_new_token_never_clobbers_old_state(self):
+        store = StandbyStore()
+        store.receive(_payload(7, "old", "snap", snap_seq=4,
+                               snap={"gid": 7, "v": 1}, ts=10.0))
+        # A new owner incarnation ships a mid-stream tail first (its
+        # snapshot is still in flight): rejected, old state intact —
+        # it is the freshest recoverable copy until a new base lands.
+        r = store.receive(_payload(
+            7, "new", "tail", records=[(9, ("Put", "x", "9", 1, 9))],
+            ts=20.0,
+        ))
+        assert not r["ok"]
+        f = store.freshness(7)
+        assert f["token"] == "old" and f["snap_seq"] == 4
+        # The new incarnation's SNAPSHOT establishes the token.
+        store.receive(_payload(7, "new", "snap", snap_seq=8,
+                               snap={"gid": 7, "v": 2}, ts=21.0))
+        f = store.freshness(7)
+        assert f["token"] == "new" and f["snap_seq"] == 8
+
+    def test_base1_tail_may_establish_token_without_snapshot(self):
+        store = StandbyStore()
+        r = store.receive(_payload(
+            2, "t1", "tail", records=[(1, ("Put", "a", "1", 3, 1))],
+            ts=1.0,
+        ))
+        assert r["ok"] and r["have"] == 1
+        snap, tail = store.get(2)
+        assert snap is None and len(tail) == 1
+
+    def test_snapshot_folds_covered_tail_records(self):
+        store = StandbyStore()
+        store.receive(_payload(4, "t1", "tail",
+                               records=[(1, ("Put", "a", "1", 3, 1)),
+                                        (2, ("Put", "b", "2", 3, 2))],
+                               ts=1.0))
+        store.receive(_payload(4, "t1", "snap", snap_seq=2,
+                               snap={"gid": 4}, ts=2.0))
+        snap, tail = store.get(4)
+        assert snap == {"gid": 4} and tail == []
+        assert store.freshness(4)["tail_seq"] == 2
+
+
+class TestPickFreshest:
+    def test_offline_and_empty_standbys_excluded(self):
+        f = {"token": "t", "snap_seq": 1, "tail_seq": 3, "ts": 5.0}
+        assert pick_freshest([(0, None), (1, f), (2, None)]) == [1]
+        assert pick_freshest([(0, None), (1, None)]) == []
+
+    def test_stale_incarnation_never_outranks_live_owner(self):
+        # Standby 1 holds a LONG tail from a previous owner; standby 2
+        # holds a short tail from the owner that actually died (fed
+        # most recently).  The live incarnation wins.
+        stale = {"token": "old", "snap_seq": 0, "tail_seq": 99,
+                 "ts": 10.0}
+        live = {"token": "new", "snap_seq": 2, "tail_seq": 3,
+                "ts": 50.0}
+        assert pick_freshest([(1, stale), (2, live)]) == [2, 1]
+
+    def test_within_token_highest_tail_wins(self):
+        a = {"token": "t", "snap_seq": 2, "tail_seq": 5, "ts": 9.0}
+        b = {"token": "t", "snap_seq": 2, "tail_seq": 7, "ts": 8.0}
+        assert pick_freshest([(0, a), (1, b)]) == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# StatePlane unit behavior (fake skv: capture, cadence, sync gate)
+# ---------------------------------------------------------------------------
+
+
+class FakeSkv:
+    def __init__(self, gids=(1,)):
+        self.gids = list(gids)
+        self.on_write = None
+        self.snap_calls = 0
+
+    def snapshot_group(self, gid):
+        self.snap_calls += 1
+        return {"gid": gid, "n": self.snap_calls}
+
+
+def _op(op="Put", key="k", value="v", cid=1, cmd=1):
+    return types.SimpleNamespace(op=op, key=key, value=value,
+                                 client_id=cid, command_id=cmd)
+
+
+class FakeObs:
+    def __init__(self):
+        self.counts = {}
+        self.gauges = {}
+        m = types.SimpleNamespace(
+            inc=lambda k, v=1: self.counts.__setitem__(
+                k, self.counts.get(k, 0) + v
+            ),
+            set=lambda k, v: self.gauges.__setitem__(k, v),
+        )
+        self.metrics = m
+
+
+class TestStatePlaneUnit:
+    def _plane(self, store, skv=None, **kw):
+        skv = skv or FakeSkv()
+        kw.setdefault("window_s", 0.0)
+        plane = StatePlane(
+            skv, me=0, n_procs=2,
+            send=lambda sb, p: store.receive(p), **kw,
+        )
+        return plane, skv
+
+    def test_capture_ships_snapshot_then_tail(self):
+        store = StandbyStore()
+        plane, skv = self._plane(store, window_s=1000.0)
+        plane.note_write(1, _op(cid=9, cmd=1))
+        assert plane.ship_round(now=0.0) >= 1
+        f = store.freshness(1)
+        assert f is not None and f["token"] == plane.token
+        # Writes after the snapshot ship as tail records.
+        plane.note_write(1, _op("Append", "k", "w", cid=9, cmd=2))
+        plane.ship_round(now=0.1)
+        snap, tail = store.get(1)
+        assert snap is not None
+        assert tail == [("Append", "k", "w", 9, 2)]
+
+    def test_reply_for_other_gid_never_folds_in(self):
+        # The async delivery hook can hand back a reply answering a
+        # DIFFERENT group's payload; the frontier must not cross gids.
+        store = StandbyStore()
+        plane, _ = self._plane(store)
+        plane.note_write(1, _op())
+        plane._apply_reply(1, 1, {"ok": True, "have": 50, "gid": 2},
+                           "tail", 1, 10)
+        assert plane._acked_tail.get((1, 1), -1) == -1
+        plane._apply_reply(1, 1, {"ok": False, "have": -1}, "tail", 1, 10)
+        assert plane._acked_tail.get((1, 1), -1) == -1
+
+    def test_sync_gate_opens_only_after_standby_ack(self):
+        store = StandbyStore()
+        wal = types.SimpleNamespace(seq=0)
+        plane, _ = self._plane(
+            store, sync=True, wal_seq_fn=lambda: wal.seq,
+        )
+        wal.seq = 7
+        plane.note_write(1, _op(cid=3, cmd=1))
+        assert not plane.covered(7)   # unshipped: acks must wait
+        assert plane.covered(6)       # earlier wal records unaffected
+        plane.ship_round(now=0.0)     # snapshot covers seq 1 → acked
+        assert plane.covered(7)
+
+    def test_dead_standby_keeps_gate_closed_and_lag_grows(self):
+        wal = types.SimpleNamespace(seq=1)
+        clock = types.SimpleNamespace(t=100.0)
+        skv = FakeSkv()
+        plane = StatePlane(
+            skv, me=0, n_procs=2, send=lambda sb, p: None,  # dead
+            sync=True, wal_seq_fn=lambda: wal.seq, window_s=0.0,
+            clock=lambda: clock.t,
+        )
+        plane.note_write(1, _op())
+        plane.ship_round()
+        assert not plane.covered(1)
+        clock.t += 9.0
+        assert plane.max_lag_s() >= 9.0
+
+    def test_forget_group_releases_sync_obligations(self):
+        wal = types.SimpleNamespace(seq=4)
+        plane, _ = self._plane(
+            StandbyStore(), sync=True, wal_seq_fn=lambda: wal.seq,
+        )
+        plane.note_write(1, _op())
+        assert not plane.covered(4)
+        plane.forget_group(1)  # migrated away: the export blob has it
+        assert plane.covered(4)
+
+    def test_metrics_and_ship_flight_records(self, tmp_path):
+        from multiraft_tpu.distributed import flightrec
+
+        rec = flightrec.FlightRecorder(
+            str(tmp_path / "sp.ring"), slots=64, name="p0"
+        )
+        obs = FakeObs()
+        store = StandbyStore()
+        plane, _ = self._plane(store, obs=obs, recorder=rec,
+                               window_s=1000.0)
+        plane.note_write(1, _op(cid=2, cmd=1))
+        plane.ship_round(now=0.0)
+        plane.note_write(1, _op("Append", "k", "x", cid=2, cmd=2))
+        plane.ship_round(now=0.1)
+        rec.close()
+        assert obs.counts.get("ship.bytes", 0) > 0
+        assert obs.counts.get("ship.tail_records") == 1
+        assert obs.gauges.get("ship.lag_s") == 0.0
+        ring = flightrec.read_ring(rec.path)
+        ships = [r for r in ring["records"]
+                 if r["type"] == flightrec.SHIP]
+        assert [r["tag"] for r in ships] == ["snap", "tail"]
+        assert ships[0]["code"] == 1
+        assert ships[1]["a"] == 1  # one tail record
+
+    def test_standby_restart_rebases_onto_snapshot(self):
+        store = StandbyStore()
+        plane, _ = self._plane(store, window_s=1000.0)
+        plane.note_write(1, _op(cid=5, cmd=1))
+        plane.ship_round(now=0.0)
+        plane.note_write(1, _op("Append", "k", "y", cid=5, cmd=2))
+        plane.ship_round(now=0.1)
+        assert store.freshness(1)["tail_seq"] == 2
+        # The standby restarts (loses everything).  The next tail ship
+        # is rejected with a regressed frontier; the shipper believes
+        # it and re-bases on the snapshot leg until caught up.
+        store.drop(1)
+        plane.note_write(1, _op("Append", "k", "z", cid=5, cmd=3))
+        for i in range(4):
+            plane.ship_round(now=0.2 + i / 10)
+            if (store.freshness(1) or {}).get("tail_seq") == 3:
+                break
+        f = store.freshness(1)
+        assert f is not None and f["tail_seq"] == 3
+
+
+# ---------------------------------------------------------------------------
+# unseal_group post-dispatch: the fork guard (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestUnsealAfterDispatch:
+    def test_unseal_after_export_raises_without_force(self):
+        from multiraft_tpu.harness.fleet import InProcessFleet
+
+        fleet = InProcessFleet([[1]], spare_slots=1, seed=11)
+        fleet.admin("join", [1])
+        fleet.settle()
+        inst = fleet.instances[0]
+        blob = None
+        for _ in range(200):
+            blob = inst.export_group(1)
+            if blob is not None:
+                break
+            fleet.pump_all(2)
+        assert blob is not None and inst.is_sealed(1)
+        # The blob may now sit in an adopt RPC: unsealing could fork
+        # the group.  Only the controller's provably-dead-destination
+        # resume leg (force=True) may revive it.
+        with pytest.raises(RuntimeError, match="dispatched"):
+            inst.unseal_group(1)
+        assert inst.is_sealed(1)
+        inst.unseal_group(1, force=True)
+        assert not inst.is_sealed(1)
+
+
+# ---------------------------------------------------------------------------
+# In-process durable failover: ship → kill → recover
+# ---------------------------------------------------------------------------
+
+
+def _placed_fleet(seed, rules=None, sync=True):
+    from multiraft_tpu.distributed.placement import LocalPlacementStore
+    from multiraft_tpu.harness.fleet import (
+        InProcessFleet,
+        LocalFleetTransport,
+    )
+    from tests.test_placement import make_controller
+
+    fleet = InProcessFleet([[1], [2]], spare_slots=1, seed=seed)
+    fleet.admin("join", [1])
+    fleet.admin("join", [2])
+    fleet.settle()
+    fleet.enable_shipping(rules, window_s=0.0, sync=sync)
+    store = LocalPlacementStore({1: 0, 2: 1})
+    ctl = make_controller(LocalFleetTransport(fleet), store)
+    return fleet, store, ctl
+
+
+def _fail_over(fleet, store, ctl, victim, gids):
+    fleet.kill(victim)
+    ctl.dead.add(victim)
+    for _ in range(8):
+        ctl.step()
+        _, placement, pending, _ = store.query()
+        if not pending and all(
+            placement[g] != victim for g in gids
+        ):
+            break
+    _, placement, pending, history = store.query()
+    assert not pending
+    assert all(placement[g] != victim for g in gids)
+    assert any(h[4] == "failover" for h in history)
+    return placement
+
+
+class TestDurableFailover:
+    def test_ship_kill_recover_preserves_data_exactly_once(self):
+        from multiraft_tpu.services.shardkv import key2shard
+
+        fleet, store, ctl = _placed_fleet(seed=5)
+        clerk = fleet.clerk()
+        clerk.put("a", "1")
+        clerk.append("a", "2")
+        clerk.put("b", "x")
+        fleet.pump_all(4)  # ship rounds run inside pump_all
+
+        cfg = fleet.instances[0].query_latest()
+        gid = cfg.shards[key2shard("a")]
+        victim = fleet.proc_of(gid)
+        survivor = 1 - victim
+        # The standby already holds shipped state for the victim's gid.
+        assert fleet.standbys[survivor].freshness(gid) is not None
+
+        _fail_over(fleet, store, ctl, victim,
+                   [g for g in (1, 2) if fleet.proc_of(g) is None])
+        # Acked writes survived the SIGKILL: recovered, not empty.
+        assert clerk.get("a") == "12"
+        assert ctl._obs is None or True  # controller obs optional
+        # Exactly-once: the tail replayed with original session ids, so
+        # the dedup table is intact — a fresh append lands exactly once.
+        clerk.append("a", "3")
+        assert clerk.get("a") == "123"
+        # Post-recovery the fleet serves every key.
+        for key in ("a", "b", "q"):
+            clerk.put(key, f"post-{key}")
+            assert clerk.get(key) == f"post-{key}"
+
+    def test_no_shipped_state_falls_back_to_explicit_empty_adoption(self):
+        from multiraft_tpu.services.shardkv import key2shard
+
+        # Pin every group's shipments to its OWN owner: unsatisfiable,
+        # so nothing ever ships (the no-standby degenerate case).
+        rules = [
+            (r"gid-1", ShipSpec(pin=(0,))),
+            (r"gid-2", ShipSpec(pin=(1,))),
+        ]
+        fleet, store, ctl = _placed_fleet(seed=6, rules=rules,
+                                          sync=False)
+        clerk = fleet.clerk()
+        clerk.put("a", "doomed")
+        fleet.pump_all(4)
+
+        cfg = fleet.instances[0].query_latest()
+        gid = cfg.shards[key2shard("a")]
+        victim = fleet.proc_of(gid)
+        survivor = 1 - victim
+        assert fleet.standbys[survivor].freshness(gid) is None
+
+        _fail_over(fleet, store, ctl, victim, [gid])
+        # Crash model: the data died with the process — but the group
+        # serves again at the LATEST config via EXPLICIT empty adoption.
+        assert clerk.get("a") == ""
+        clerk.put("a", "reborn")
+        assert clerk.get("a") == "reborn"
+
+    def test_controller_prefers_standby_with_freshest_state(self):
+        from multiraft_tpu.distributed.placement import (
+            LocalPlacementStore,
+        )
+        from multiraft_tpu.harness.fleet import (
+            InProcessFleet,
+            LocalFleetTransport,
+        )
+        from multiraft_tpu.services.shardkv import key2shard
+        from tests.test_placement import make_controller
+
+        # Three procs, two shipping copies per group: when the owner
+        # dies, BOTH survivors hold state, and the controller routes
+        # the failover to the freshest one (here equal — but an offline
+        # standby must be excluded even though it holds state).
+        fleet = InProcessFleet([[1], [2], [3]], spare_slots=2, seed=7)
+        for g in (1, 2, 3):
+            fleet.admin("join", [g])
+        fleet.settle()
+        fleet.enable_shipping([(r".*", ShipSpec(copies=2))],
+                              window_s=0.0, sync=True)
+        store = LocalPlacementStore({1: 0, 2: 1, 3: 2})
+        tr = LocalFleetTransport(fleet)
+        ctl = make_controller(tr, store, max_moves=2)
+        clerk = fleet.clerk()
+        clerk.put("a", "A")
+        clerk.put("b", "B")
+        fleet.pump_all(4)
+
+        cfg = fleet.instances[0].query_latest()
+        gid = cfg.shards[key2shard("a")]
+        victim = fleet.proc_of(gid)
+        others = [p for p in (0, 1, 2) if p != victim]
+        # Kill one standby too: its copy is fresh but OFFLINE — the
+        # controller must pick the live one.
+        dead_standby = others[0]
+        live = others[1]
+        fleet.kill(dead_standby)
+        ctl.dead.add(dead_standby)
+        fleet.kill(victim)
+        ctl.dead.add(victim)
+        for _ in range(12):
+            ctl.step()
+            _, placement, pending, _ = store.query()
+            if not pending and all(
+                placement[g] == live for g in (1, 2, 3)
+            ):
+                break
+        _, placement, pending, _ = store.query()
+        assert all(placement[g] == live for g in placement), placement
+        assert clerk.get("a") == "A"
+        assert clerk.get("b") == "B"
+
+
+# ---------------------------------------------------------------------------
+# Observability: doctor anomaly + trace summary (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+class TestShipObservability:
+    _n = 0
+
+    def _ring(self, tmp_path, ships, extra_gap_s=0.0, clean=False):
+        from multiraft_tpu.distributed import flightrec
+
+        TestShipObservability._n += 1
+        rec = flightrec.FlightRecorder(
+            str(tmp_path / f"so{TestShipObservability._n}.ring"),
+            slots=128, name="p0",
+        )
+        for gid, tag, frontier in ships:
+            rec.record(flightrec.SHIP, code=gid, a=2, b=64,
+                       c=frontier, tag=tag)
+        if extra_gap_s:
+            time.sleep(extra_gap_s)
+            rec.record(flightrec.TICK, a=1)  # death happens later
+        if clean:
+            rec.record(flightrec.NODE_CLOSE, tag="p0")
+        rec.close()
+        return rec.path
+
+    def test_doctor_flags_data_loss_window_exceeded(
+        self, tmp_path, monkeypatch
+    ):
+        from multiraft_tpu.analysis import postmortem
+
+        monkeypatch.setenv("MRT_SHIP_WINDOW_S", "0.05")
+        ring = self._ring(tmp_path, [(3, "tail", 9)], extra_gap_s=0.12)
+        analysis = postmortem.analyze(postmortem.load_bundle(ring))
+        hits = [a for a in analysis["anomalies"]
+                if a["kind"] == "ship_window_exceeded"]
+        assert len(hits) == 1
+        assert "group 3" in hits[0]["detail"]
+        assert "frontier 9" in hits[0]["detail"]
+        proc = analysis["procs"][0]
+        assert proc["shipments"][3]["last_frontier"] == 9
+
+    def test_doctor_quiet_when_within_window_or_clean_or_no_ships(
+        self, tmp_path, monkeypatch
+    ):
+        from multiraft_tpu.analysis import postmortem
+
+        monkeypatch.setenv("MRT_SHIP_WINDOW_S", "30.0")
+        # Unclean death but the last shipment is recent: no anomaly.
+        ring = self._ring(tmp_path, [(3, "snap", 4)], extra_gap_s=0.01)
+        analysis = postmortem.analyze(postmortem.load_bundle(ring))
+        kinds = [a["kind"] for a in analysis["anomalies"]]
+        assert "ship_window_exceeded" not in kinds
+
+        # A fleet that never shipped must not false-positive, even
+        # with a tiny window.
+        monkeypatch.setenv("MRT_SHIP_WINDOW_S", "0.0")
+        ring = self._ring(tmp_path, [], extra_gap_s=0.01)
+        analysis = postmortem.analyze(postmortem.load_bundle(ring))
+        kinds = [a["kind"] for a in analysis["anomalies"]]
+        assert "ship_window_exceeded" not in kinds
+        assert "shipments" not in analysis["procs"][0]
+
+        # Clean close: shutdown is not data loss.
+        monkeypatch.setenv("MRT_SHIP_WINDOW_S", "0.0")
+        ring = self._ring(tmp_path, [(2, "tail", 5)], extra_gap_s=0.01,
+                          clean=True)
+        analysis = postmortem.analyze(postmortem.load_bundle(ring))
+        kinds = [a["kind"] for a in analysis["anomalies"]]
+        assert "ship_window_exceeded" not in kinds
+
+    def test_doctor_trace_has_ship_instants(self, tmp_path):
+        from multiraft_tpu.analysis import postmortem
+
+        ring = self._ring(tmp_path, [(3, "snap", 7), (3, "tail", 11)])
+        tracer = postmortem.rings_to_trace(postmortem.load_bundle(ring))
+        inst = [e for e in tracer.events
+                if e.get("ph") == "i" and e["name"].startswith("ship:")]
+        assert len(inst) == 2
+        assert inst[0]["args"]["kind"] == "snap"
+        assert inst[1]["args"]["frontier"] == 11
+        assert all(e["tid"] == "ship" for e in inst)
+
+    def test_trace_summary_shipments_table(self, tmp_path):
+        from scripts.trace_summary import summarize_shipments
+
+        from multiraft_tpu.analysis import postmortem
+
+        ring = self._ring(tmp_path, [
+            (3, "snap", 7), (3, "tail", 11), (5, "tail", 2),
+        ])
+        tracer = postmortem.rings_to_trace(postmortem.load_bundle(ring))
+        path = tracer.save(str(tmp_path / "ship_trace.json"))
+        s = summarize_shipments(path)
+        assert s["events"] == 3 and len(s["groups"]) == 2
+        g3 = next(r for r in s["groups"] if r["group"] == 3)
+        assert g3["shipments"] == 2
+        assert g3["snaps"] == 1 and g3["tails"] == 1
+        assert g3["last_frontier"] == 11 and g3["last_kind"] == "tail"
+        # A trace without ship events reports none (CLI exits 2 on it).
+        from multiraft_tpu.utils.trace import Tracer
+
+        tr = Tracer()
+        tr.instant("place", 1.0, track="place", group=1)
+        empty = tr.save(str(tmp_path / "no_ships.json"))
+        assert summarize_shipments(empty)["groups"] == []
+
+
+# ---------------------------------------------------------------------------
+# Full durable-failover chaos: sockets + SIGKILL + porcupine (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_durable_failover_chaos_loses_zero_acked_writes(tmp_path):
+    """The durable acceptance scenario over real sockets: a PlacedFleet
+    with SYNC shipping (acks gate on standby coverage) takes clerk load
+    while the nemesis SIGKILLs one mesh process; every acknowledged
+    write from before the kill is still readable after the stateful
+    failover, the fleet serves, and the racing clerk history stays
+    linearizable."""
+    from multiraft_tpu.harness.fleet import PlacedFleet
+    from multiraft_tpu.harness.nemesis import run_clerk_load
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    fleet = PlacedFleet(
+        [[1], [2], [3]], spare_slots=2, seed=23,
+        shipping=True, ship_sync=True, ship_window_s=0.5,
+        controller_kwargs=dict(
+            scrape_s=0.3, dead_s=2.0, cooldown_s=5.0,
+            min_gain=0.25, max_moves=1,
+        ),
+    )
+    try:
+        fleet.start()
+        for g in (1, 2, 3):
+            fleet.admin("join", [g])
+
+        # Phase 1: acknowledged writes that MUST survive the kill.
+        # (Separate key space from the load phase so porcupine's
+        # history stays self-contained.)
+        clerk = fleet.clerk()
+        durable = {f"d{c}": f"v{c}" for c in "abcdef"}
+        for k, v in durable.items():
+            clerk.put(k, v)
+
+        victim = 2
+        _, placement0 = fleet.placement()
+        victim_gids = [g for g, p in placement0.items() if p == victim]
+        assert victim_gids
+
+        t_kill = time.monotonic()
+        fleet.kill_mesh_process(victim)
+        deadline = t_kill + 120.0
+        while time.monotonic() < deadline:
+            _, placement, pending, _ = fleet.pmap.query()
+            if not pending and all(
+                placement.get(g) not in (None, victim)
+                for g in victim_gids
+            ):
+                break
+            time.sleep(0.25)
+        replace_s = time.monotonic() - t_kill
+        _, placement, pending, history = fleet.pmap.query()
+        assert all(placement[g] != victim for g in victim_gids), (
+            placement, pending
+        )
+        assert replace_s < 120.0
+        assert any(h[4] == "failover" for h in history)
+
+        # Phase 2: ZERO acknowledged writes lost — sync shipping means
+        # every acked pre-kill write was standby-covered before its ack.
+        clerk2 = fleet.clerk()
+        for k, v in durable.items():
+            assert clerk2.get(k) == v, f"acked write {k} lost"
+
+        # Phase 3: the fleet serves under load and linearizes.
+        history_ops = run_clerk_load(
+            fleet.clerk, keys=["pa", "pb", "pc"],
+            n_workers=3, ops_per_worker=6, op_timeout=120.0,
+        )
+        assert_linearizable(
+            kv_model, history_ops, timeout=60.0,
+            name="durable-failover-chaos",
+        )
+    finally:
+        fleet.shutdown()
