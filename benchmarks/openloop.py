@@ -169,10 +169,16 @@ def fire_schedule(
     to each arrival's instant, fires the call with a per-rid trace id,
     and moves on — reply timestamps land via done-callbacks (loop
     thread), never blocking the firing line.  Replies that never come
-    (shed under overload) count as ``drops``; the fresh node per step
-    bounds their leaked futures to the step's lifetime."""
+    (starved under overload) count as ``drops``; requests the server's
+    admission layer refused come back fast as ErrBusy and count as
+    ``shed`` — the bounded-latency alternative to a drop.  The latency
+    histogram folds ACCEPTED (OK) replies only: the headline p50/p99 is
+    the latency of requests the server chose to serve, which is exactly
+    the number admission control promises to bound (a sub-millisecond
+    busy reply averaged in would flatter the curve).  The fresh node
+    per step bounds leaked futures to the step's lifetime."""
     from multiraft_tpu.distributed.engine_clerks import EngineClerk
-    from multiraft_tpu.distributed.engine_wire import OK
+    from multiraft_tpu.distributed.engine_wire import ERR_BUSY, OK
     from multiraft_tpu.distributed.engine_wire import EngineCmdArgs
     from multiraft_tpu.distributed.tcp import RpcNode
     from multiraft_tpu.sim.scheduler import TIMEOUT
@@ -187,15 +193,20 @@ def fire_schedule(
         # Indexed by arrival; written only on the loop thread.
         lats: List[Optional[float]] = [None] * n
         oks = [0] * n
+        sheds = [0] * n
         client_id = unique_client_id(next(EngineClerk._next))
 
         def make_done(i: int, t_send: float):
             def _done(f) -> None:
                 lats[i] = time.perf_counter() - t_send
                 r = f.value
-                if r is not None and r is not TIMEOUT and \
-                        getattr(r, "err", None) == OK:
+                err = getattr(r, "err", None) if (
+                    r is not None and r is not TIMEOUT
+                ) else None
+                if err == OK:
                     oks[i] = 1
+                elif err == ERR_BUSY:
+                    sheds[i] = 1
             return _done
 
         def driver():
@@ -229,17 +240,22 @@ def fire_schedule(
             time.sleep(0.05)
 
         h = Hist()
-        for v in lats:
-            if v is not None:
-                h.observe(v)
-        replied = h.count
+        replied = 0
+        for i, v in enumerate(lats):
+            if v is None:
+                continue
+            replied += 1
+            if oks[i]:
+                h.observe(v)  # accepted-request latency only
         ok = sum(oks)
+        shed = sum(sheds)
         p50 = h.percentile(0.50)
         p99 = h.percentile(0.99)
         return {
             "sent": n,
             "replied": replied,
             "ok": ok,
+            "shed": shed,
             "drops": n - replied,
             "wall_s": round(float(wall), 3),
             "achieved_ops_per_sec": round(ok / wall, 1) if wall else 0.0,
@@ -289,7 +305,10 @@ class PorcupineSampler:
         )
         from multiraft_tpu.porcupine.model import Operation
 
-        ck = BlockingEngineClerk(self.port, host=self.host)
+        # Verify lane: admission exempts these clerks, so the
+        # linearizability witness keeps sampling through the very
+        # overload the sweep creates (that's its whole point).
+        ck = BlockingEngineClerk(self.port, host=self.host, lane="verify")
         try:
             j = 0
             while not self._stop.is_set():

@@ -74,6 +74,10 @@ _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 _OVL_STAGE = flightrec.OVERLOAD_KIND_CODES["stage_p99"]
 _OVL_GAUGE = flightrec.OVERLOAD_KIND_CODES["gauge"]
 _OVL_CTX = flightrec.OVERLOAD_KIND_CODES["gauge_ctx"]
+_OVL_BROWNOUT = flightrec.OVERLOAD_KIND_CODES["brownout"]
+
+# Brownout states (overload.py BrownoutMachine) named for the note.
+_BROWNOUT_NAMES = {0: "healthy", 1: "shedding", 2: "brownout"}
 
 
 # -- loading ---------------------------------------------------------------
@@ -290,7 +294,37 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
         # queueing started).  The paired gauge_ctx record supplies the
         # queue the collapse backed up into.
         over = [r for r in recs if r["type"] == flightrec.OVERLOAD]
-        trips = [r for r in over if r["code"] != _OVL_CTX]
+        # Brownout transitions are control decisions, not bound trips —
+        # excluded from the collapse evidence so the two notes stay
+        # distinct: "queueing collapse" = queues diverged; "shedding
+        # engaged" = the admission plane acted on it.
+        trips = [r for r in over
+                 if r["code"] not in (_OVL_CTX, _OVL_BROWNOUT)]
+        browns = [r for r in over if r["code"] == _OVL_BROWNOUT]
+        escalations = [r for r in browns if r["a"] > r["b"]]
+        if escalations:
+            first_up = escalations[0]
+            peak = max(r["a"] for r in browns)
+            detail = (
+                f"shedding engaged: brownout machine "
+                f"{_BROWNOUT_NAMES.get(first_up['b'], first_up['b'])} → "
+                f"{_BROWNOUT_NAMES.get(first_up['a'], first_up['a'])} "
+                f"({first_up['c']} trip(s) that tick); peak state "
+                f"{_BROWNOUT_NAMES.get(peak, peak)}, "
+                f"{len(browns)} transition(s) total — admission "
+                f"tightened; user-lane requests were shed with "
+                f"retry_after hints (this is the overload plane "
+                f"WORKING, distinct from an uncontrolled collapse)"
+            )
+            anomalies.append({
+                "ts": aligned(first_up["ts"]), "proc": label,
+                "kind": "shedding_engaged", "detail": detail,
+                "aligned": off is not None,
+            })
+            info["brownout"] = {
+                "transitions": len(browns),
+                "peak": _BROWNOUT_NAMES.get(peak, str(peak)),
+            }
         if trips:
             first = trips[0]
             gauge = next(

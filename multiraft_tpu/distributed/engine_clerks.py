@@ -12,8 +12,27 @@ import time
 
 from ..sim.scheduler import TIMEOUT, Future
 from ..utils.ids import unique_client_id
-from .engine_wire import OK, EngineCmdArgs
+from .engine_wire import (
+    ERR_BUSY,
+    OK,
+    EngineCmdArgs,
+    EngineCmdReply,
+    retry_after_of,
+)
 from .realtime import Backoff
+
+
+def _busy_delay(backoff: Backoff, reply) -> float:
+    """Delay before retrying a shed (ErrBusy) request: the server's
+    ``retry_after_s`` hint, jittered.  The server hands the SAME hint
+    to every clerk it sheds in a burst — honored verbatim, those
+    clerks would re-offer in one synchronized wave and shed again;
+    equal jitter spreads the wave.  No hint (legacy peer whose reply
+    predates the field) → the ordinary doubling backoff."""
+    hint = retry_after_of(reply)
+    if hint <= 0.0:
+        return backoff.next_delay()
+    return backoff.jittered(hint)
 
 
 def _end_obs(end):
@@ -47,12 +66,20 @@ class EngineClerk:
     # share a client_id and dedup silently drops one's writes.
     _next = itertools.count(1)
 
-    def __init__(self, sched, end, service: str = "EngineKV") -> None:
+    def __init__(
+        self, sched, end, service: str = "EngineKV", lane: str = "",
+    ) -> None:
         self.sched = sched
         self.end = end
         self.service = service
         self.client_id = unique_client_id(next(EngineClerk._next))
         self.command_id = 0
+        # Priority lane: a non-empty lane prefixes every rid, and the
+        # server's admission layer (admission.py) exempts recognized
+        # lanes — the porcupine sampler passes "verify" so the
+        # linearizability witness keeps flowing while user traffic
+        # sheds.
+        self.lane = lane
         # Failed calls that fail FAST (connection refused while the
         # server restarts, a partitioned minority) must not turn the
         # retry loop into a hot spin against the recovering process.
@@ -65,7 +92,8 @@ class EngineClerk:
         self._rid_seq = itertools.count(1)
 
     def _rid(self) -> str:
-        return f"{self.client_id & 0xFFFFFF:06x}.{next(self._rid_seq)}"
+        rid = f"{self.client_id & 0xFFFFFF:06x}.{next(self._rid_seq)}"
+        return f"{self.lane}.{rid}" if self.lane else rid
 
     def _command(self, op: str, key: str, value: str = ""):
         if op != "Get":
@@ -90,9 +118,18 @@ class EngineClerk:
                 or reply is TIMEOUT
                 or reply.err != OK
             ):
-                # lost/timed out/old leader: retry (dedup-safe)
+                # lost/timed out/old leader/shed: retry (dedup-safe)
                 m.inc("clerk.retries")
-                delay = self._backoff.next_delay()
+                if (
+                    reply is not None and reply is not TIMEOUT
+                    and reply.err == ERR_BUSY
+                ):
+                    # Admission shed: the server told us when to come
+                    # back — honor it (jittered) instead of doubling.
+                    m.inc("clerk.busy")
+                    delay = _busy_delay(self._backoff, reply)
+                else:
+                    delay = self._backoff.next_delay()
                 m.observe("clerk.backoff_s", delay)
                 yield delay
                 continue
@@ -155,6 +192,13 @@ class PipelinedClerk(EngineClerk):
                 f"{self.service}.batch", frame, trace=rid
             )
             reply = yield self.sched.with_timeout(fut, 10.0)
+            if isinstance(reply, EngineCmdReply):
+                # The dispatch layer shed the whole frame (ErrBusy)
+                # before the handler saw it — a single reply, not the
+                # per-op list.  Honor the hint and re-ship (dedup-safe).
+                self.obs.metrics.inc("clerk.busy")
+                yield _busy_delay(self._backoff, reply)
+                continue
             if reply is not None and reply is not TIMEOUT and any(
                 r.err.startswith("ErrBatchTooLarge") for r in reply
             ):
@@ -188,8 +232,10 @@ class FirehoseClerk(EngineClerk):
     # would spin forever).
     from ..engine.firehose import MAX_FIREHOSE_ROWS as MAX_FRAME
 
-    def __init__(self, sched, end, service: str = "EngineKV") -> None:
-        super().__init__(sched, end, service)
+    def __init__(
+        self, sched, end, service: str = "EngineKV", lane: str = "",
+    ) -> None:
+        super().__init__(sched, end, service, lane=lane)
         self._G = None
 
     def _topology(self, deadline):
@@ -258,6 +304,12 @@ class FirehoseClerk(EngineClerk):
             if reply is None or reply is TIMEOUT:
                 # whole frame lost: retry whole (dedup-safe)
                 yield self._backoff.next_delay()
+                continue
+            if isinstance(reply, EngineCmdReply):
+                # Shed at dispatch (ErrBusy) — the firehose blob never
+                # reached the handler.  Honor the hint, retry whole.
+                self.obs.metrics.inc("clerk.busy")
+                yield _busy_delay(self._backoff, reply)
                 continue
             if isinstance(reply, tuple) and reply and reply[0] == "err":
                 raise ValueError(reply[1])
@@ -387,8 +439,9 @@ class ShardFirehoseClerk:
                         by_end.setdefault(end, []).append((i, gid))
                 if unrouted:
                     self._cfg = None
-                    yield self.sched.sleep(0.02)
+                    yield self.sched.sleep(self._backoff.jittered(0.03))
                 flights = []
+                busy = None
                 for end, members in by_end.items():
                     idxs = [i for i, _ in members]
                     blob = pack_request(
@@ -408,6 +461,13 @@ class ShardFirehoseClerk:
                     if reply is None or reply is TIMEOUT:
                         retry.extend(idxs)
                         continue
+                    if isinstance(reply, EngineCmdReply):
+                        # Shed at dispatch (ErrBusy): requeue the
+                        # rows; the hint is honored once, after the
+                        # round's other flights resolve.
+                        retry.extend(idxs)
+                        busy = reply
+                        continue
                     if (
                         isinstance(reply, tuple)
                         and reply
@@ -426,8 +486,10 @@ class ShardFirehoseClerk:
                             if err[j] == FH_WRONG_GROUP:
                                 self._cfg = None  # routing moved
                             retry.append(i)
-                if retry and self._cfg is None:
-                    yield self.sched.sleep(0.02)
+                if busy is not None:
+                    yield _busy_delay(self._backoff, busy)
+                elif retry and self._cfg is None:
+                    yield self.sched.sleep(self._backoff.jittered(0.03))
                 todo = sorted(retry)
             remaining = [i for i in remaining if not done[i]]
         return results
@@ -581,7 +643,13 @@ class EngineFleetClerk:
                 self._cfg = None  # stale routing: re-query the config
                 self._place_stale = True  # ...or the gid itself moved
             m.inc("clerk.retries")
-            yield self._backoff.next_delay()
+            if reply.err == ERR_BUSY:
+                # Shed at dispatch: routing is fine, the process is
+                # overloaded — honor its jittered hint and retry there.
+                m.inc("clerk.busy")
+                yield _busy_delay(self._backoff, reply)
+            else:
+                yield self._backoff.next_delay()
 
     def get(self, key: str):
         return self._command("Get", key)
@@ -654,6 +722,7 @@ class PipelinedFleetClerk(EngineFleetClerk):
                 else:
                     by_end.setdefault(end, []).append(i)
             retry = list(unrouted)
+            busy = None
             # Dispatch every process's frame FIRST, then collect:
             # wall-clock is the slowest frame, not the sum.  (Frames
             # are per-process partitions of one ≤MAX_FRAME window, so
@@ -671,6 +740,12 @@ class PipelinedFleetClerk(EngineFleetClerk):
                 if reply is None or reply is TIMEOUT:
                     retry.extend(part)
                     continue
+                if isinstance(reply, EngineCmdReply):
+                    # Shed at dispatch (ErrBusy): one reply for the
+                    # whole frame, not the per-op list.
+                    retry.extend(part)
+                    busy = reply
+                    continue
                 if any(
                     r.err.startswith("ErrBatchTooLarge") for r in reply
                 ):
@@ -683,7 +758,13 @@ class PipelinedFleetClerk(EngineFleetClerk):
                         retry.append(i)
             todo = sorted(retry)
             if todo:
-                self._cfg = None  # routing moved: re-query
-                self._place_stale = True  # ...possibly to a new process
-                yield self.sched.sleep(0.02)
+                if busy is not None:
+                    # Overload, not stale routing: honor the jittered
+                    # hint without burning a config re-query.
+                    self.obs.metrics.inc("clerk.busy")
+                    yield _busy_delay(self._backoff, busy)
+                else:
+                    self._cfg = None  # routing moved: re-query
+                    self._place_stale = True  # ...maybe to a new process
+                    yield self.sched.sleep(self._backoff.jittered(0.03))
         return results

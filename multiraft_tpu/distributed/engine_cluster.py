@@ -110,11 +110,15 @@ class EngineProcessCluster:
             self.proc.kill()
             self.proc.wait()
 
-    def clerk(self) -> "BlockingEngineClerk":
+    def clerk(self, lane: str = "") -> "BlockingEngineClerk":
+        """``lane="verify"`` marks the clerk's traffic for the server's
+        admission exemption (porcupine samplers must keep sampling
+        while user traffic sheds)."""
         return BlockingEngineClerk(
             self.port, host=self.host,
             service="EngineKV" if self.kind == "engine_kv"
             else "EngineShardKV",
+            lane=lane,
         )
 
     def shutdown(self) -> None:
@@ -491,14 +495,14 @@ class BlockingEngineClerk(_BlockingClerkBase):
 
     def __init__(
         self, port: int, host: str = "127.0.0.1",
-        service: str = "EngineKV",
+        service: str = "EngineKV", lane: str = "",
     ) -> None:
         from .engine_server import EngineClerk
 
         self.node = RpcNode()
         self.sched = self.node.sched
         end = self.node.client_end(host, port)
-        self._clerk = EngineClerk(self.sched, end, service=service)
+        self._clerk = EngineClerk(self.sched, end, service=service, lane=lane)
 
     @property
     def client_id(self) -> int:

@@ -53,6 +53,7 @@ from .engine_wire import (
     make_mesh,
     route_group,
 )
+from .admission import install_admission
 from .overload import install_overload_watch
 from .realtime import (
     PumpCadence,
@@ -591,6 +592,9 @@ def serve_engine_kv(
     node.engine_service = svc  # keep reachable for introspection
     # Overload watch (overload.py): windowed stage-p99 + queue-gauge
     # bounds → OVERLOAD flight records, while the collapse is live.
+    # Admission (admission.py): the watch's brownout state drives it,
+    # turning those signals into shed/bounded behavior at dispatch.
+    install_admission(node)
     install_overload_watch(node)
     return node
 

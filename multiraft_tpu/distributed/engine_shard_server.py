@@ -1052,7 +1052,10 @@ def serve_engine_shardkv(
     node.add_service("EngineShardKV", svc)
     node.engine_service = svc
     # Overload watch: stage-p99/queue-gauge bounds → OVERLOAD records.
+    # Admission: the watch's brownout state drives shedding at dispatch.
+    from .admission import install_admission
     from .overload import install_overload_watch
 
+    install_admission(node)
     install_overload_watch(node)
     return node

@@ -17,14 +17,21 @@ from ..transport import codec
 __all__ = [
     "OK",
     "ERR_TIMEOUT",
+    "ERR_BUSY",
     "EngineCmdArgs",
     "EngineCmdReply",
+    "busy_reply",
+    "retry_after_of",
     "route_group",
     "make_mesh",
 ]
 
 OK = "OK"
 ERR_TIMEOUT = "ErrTimeout"
+# Admission-control shed: the dispatch layer refused the request before
+# any handler saw it.  The reply carries a retry_after_s hint; clerks
+# honor it with jitter (engine_clerks._busy_delay) instead of hammering.
+ERR_BUSY = "ErrBusy"
 
 _OPCODE = {"Get": OP_GET, "Put": OP_PUT, "Append": OP_APPEND}
 _OPNAME = {v: k for k, v in _OPCODE.items()}
@@ -45,6 +52,24 @@ class EngineCmdArgs:
 class EngineCmdReply:
     err: str = OK
     value: str = ""
+    # Widened in round 8 (admission control).  Pickle bypasses
+    # __init__, so a reply encoded by a pre-round-8 peer decodes
+    # WITHOUT this attribute — always read it via retry_after_of(),
+    # never reply.retry_after_s directly.
+    retry_after_s: float = 0.0
+
+
+def busy_reply(retry_after_s: float) -> EngineCmdReply:
+    """The shed reply the dispatch layer sends in place of a handler
+    result when admission refuses a request."""
+    return EngineCmdReply(err=ERR_BUSY, retry_after_s=float(retry_after_s))
+
+
+def retry_after_of(reply: Any) -> float:
+    """Decode-compatible read of the retry hint: replies encoded by
+    older peers lack the field entirely (pickle restores __dict__, not
+    dataclass defaults)."""
+    return float(getattr(reply, "retry_after_s", 0.0) or 0.0)
 
 
 def route_group(key: str, G: int) -> int:

@@ -137,7 +137,12 @@ SANITIZE_KIND_CODES = {"lock_order": 1, "queue_bound": 2, "callback_budget": 3}
 #            for the doctor's "first saturated stage + its queue gauge"
 #            naming, recorded even when that gauge is under its own
 #            bound (a=depth b=bound tag=gauge name).
-OVERLOAD_KIND_CODES = {"stage_p99": 1, "gauge": 2, "gauge_ctx": 3}
+# brownout:  the brownout state machine changed state — recorded on
+#            transitions only (a=new_state b=old_state c=trip_count
+#            tag="brownout"); the doctor reports these as "shedding
+#            engaged", distinct from queueing collapse.
+OVERLOAD_KIND_CODES = {"stage_p99": 1, "gauge": 2, "gauge_ctx": 3,
+                       "brownout": 4}
 
 
 def type_name(etype: int) -> str:

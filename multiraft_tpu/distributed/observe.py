@@ -250,6 +250,12 @@ class ObsControl:
                 out["gauge.wal_pending"] = float(
                     wal.appended - wal.synced
                 )
+        adm = getattr(node, "admission", None)
+        if adm is not None:
+            # Admission plane (admission.py): bucket depth plus the
+            # bounded dispatched-unreplied count it enforces.
+            out["gauge.admit_tokens"] = float(adm.tokens())
+            out["gauge.admit_inflight"] = float(adm.inflight_total())
         return out
 
     def hist(self, args: Any = None) -> Dict[str, Any]:

@@ -35,6 +35,25 @@ Bounds (env-tunable):
 The watch runs on the node's scheduler loop (same thread as dispatch),
 so reading the loop-thread-only reply queues is safe; each tick costs
 a handful of 128-int diffs — far below one pump tick.
+
+Round 8 closes the loop: each tick also feeds a trip count into a
+:class:`BrownoutMachine` (healthy → shedding → brownout, with
+hysteresis so a p99 oscillating around the bound cannot flap the
+state) — but only the trips admission can RELIEVE: post-admission
+stage p99s (dispatch/handler/engine/ack/flush) and the queue gauges.
+A tripping ``stage.wire`` is ingress parse backlog upstream of the
+admission check; it is recorded and counted, but shedding harder
+cannot drain it, so letting it drive the machine would pin the node
+in brownout with no latency to show for the lost goodput.  The
+machine's state drives the admission controller
+(admission.py) — tightening the token buckets and the per-connection
+dispatch bound as the node browns out, instead of only emitting
+OVERLOAD flight records.  State *transitions* get their own OVERLOAD
+record (kind "brownout") so the postmortem doctor reports "shedding
+engaged" distinctly from "queueing collapse".
+
+* ``MRT_BROWNOUT_UP``    consecutive tripping ticks to escalate (2)
+* ``MRT_BROWNOUT_DOWN``  consecutive clean ticks to de-escalate (8)
 """
 
 from __future__ import annotations
@@ -46,7 +65,14 @@ from ..utils.metrics import Hist
 from . import flightrec
 from .observe import ObsControl
 
-__all__ = ["OverloadWatch", "install_overload_watch"]
+__all__ = [
+    "OverloadWatch",
+    "BrownoutMachine",
+    "install_overload_watch",
+    "HEALTHY",
+    "SHEDDING",
+    "BROWNOUT",
+]
 
 # Minimum samples in a window before its p99 means anything — a
 # two-sample window's "p99" is just its max.
@@ -58,6 +84,51 @@ def _env_f(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+# -- brownout state machine -------------------------------------------------
+
+HEALTHY, SHEDDING, BROWNOUT = 0, 1, 2
+
+STATE_NAMES = {HEALTHY: "healthy", SHEDDING: "shedding",
+               BROWNOUT: "brownout"}
+
+
+class BrownoutMachine:
+    """Overload trips → admission level, with hysteresis.
+
+    One :meth:`update` per watch tick with that tick's trip count.
+    Escalation needs ``up`` CONSECUTIVE tripping ticks; de-escalation
+    needs ``down`` consecutive clean ones, and each crossing resets the
+    opposite streak — a p99 oscillating around its bound (trip, clean,
+    trip, clean, ...) can therefore neither escalate nor de-escalate:
+    the state holds instead of flapping.  Pure and clock-free so the
+    unit tests drive it tick by tick."""
+
+    def __init__(self, up: Optional[int] = None,
+                 down: Optional[int] = None) -> None:
+        self.up = max(1, int(up if up is not None
+                             else _env_f("MRT_BROWNOUT_UP", 2)))
+        self.down = max(1, int(down if down is not None
+                               else _env_f("MRT_BROWNOUT_DOWN", 8)))
+        self.state = HEALTHY
+        self._over = 0   # consecutive tripping ticks
+        self._under = 0  # consecutive clean ticks
+
+    def update(self, trips: int) -> int:
+        if trips > 0:
+            self._over += 1
+            self._under = 0
+            if self._over >= self.up and self.state < BROWNOUT:
+                self.state += 1
+                self._over = 0
+        else:
+            self._under += 1
+            self._over = 0
+            if self._under >= self.down and self.state > HEALTHY:
+                self.state -= 1
+                self._under = 0
+        return self.state
 
 
 class OverloadWatch:
@@ -77,6 +148,7 @@ class OverloadWatch:
         }
         self._ctl = ObsControl(node)
         self._prev: Dict[str, Hist] = {}  # stage hist snapshots, last tick
+        self.brownout = BrownoutMachine()
         self._stopped = False
         node.sched.call_after(self.interval, self._tick)
 
@@ -101,6 +173,7 @@ class OverloadWatch:
         frec = getattr(self.node, "_frec", None)
         gauges = self._ctl.gauges()
         trips = 0
+        relievable = 0  # trips shedding can actually fix (post-admission)
         stage_tripped = False
 
         # Windowed stage p99s: cumulative hist minus last tick's copy.
@@ -125,6 +198,15 @@ class OverloadWatch:
                 continue
             trips += 1
             stage_tripped = True
+            # The wire stage (client send -> socket read) sits BEFORE
+            # admission: its backlog is ingress parse cost, and
+            # admitting fewer requests cannot drain it — feeding it to
+            # the brownout machine just death-spirals goodput while the
+            # latency stays.  It still trips an OVERLOAD record (it is
+            # how "queueing collapse" gets named); only the
+            # post-admission stages drive shedding.
+            if name != "stage.wire_s":
+                relievable += 1
             m.inc("overload.trips")
             if frec is not None:
                 frec.record(
@@ -140,6 +222,7 @@ class OverloadWatch:
             if val is None or val <= bound:
                 continue
             trips += 1
+            relievable += 1  # queue gauges are all post-admission
             m.inc("overload.trips")
             if frec is not None:
                 frec.record(
@@ -161,6 +244,24 @@ class OverloadWatch:
                 tag=deepest,
             )
         m.set("overload.active", float(trips))
+
+        # Feed the brownout machine and drive admission.  Transitions
+        # (either direction) are flight-recorded; the steady state is
+        # just a gauge.
+        prev_state = self.brownout.state
+        state = self.brownout.update(relievable)
+        if state != prev_state:
+            m.inc("overload.brownout_transitions")
+            if frec is not None:
+                frec.record(
+                    flightrec.OVERLOAD,
+                    code=flightrec.OVERLOAD_KIND_CODES["brownout"],
+                    a=state, b=prev_state, c=trips, tag="brownout",
+                )
+        m.set("overload.state", float(state))
+        adm = getattr(self.node, "admission", None)
+        if adm is not None:
+            adm.set_level(state)
         return trips
 
 

@@ -70,6 +70,14 @@ class Backoff:
         self._cur = min(self.cap, cur * self.factor)
         return cur / 2.0 + self._rng.random() * (cur / 2.0)
 
+    def jittered(self, base: float) -> float:
+        """Equal-jitter a caller-supplied delay — a server's
+        ``retry_after_s`` hint, a fixed config-wait — WITHOUT advancing
+        the doubling state.  The server hands the same hint to every
+        clerk it sheds; a deterministic wait would re-synchronize them
+        into the next thundering herd."""
+        return base / 2.0 + self._rng.random() * (base / 2.0)
+
     def reset(self) -> None:
         self._cur = self.base
 

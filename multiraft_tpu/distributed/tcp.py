@@ -21,6 +21,7 @@ Frames are codec-encoded tuples:
     ("rep", req_id, value)                      callee → caller
     ("repb", [(req_id, value), ...])            coalesced multi-reply
     ("hello", caps)                             capability negotiation
+    ("busy", req_id, retry_after_s)             admission shed (negotiated)
 
 The optional fifth element is a compact trace/request id (Dapper-style)
 appended only when the caller supplies one, so untagged traffic and old
@@ -71,6 +72,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..sim.scheduler import Future
 from ..transport import codec
 from . import flightrec
+from .admission import lane_of
+from .engine_wire import busy_reply
 from .native import EV_ACCEPT, EV_CLOSED, EV_FRAME, NativeTransport
 from .observe import (
     Observability,
@@ -86,9 +89,12 @@ __all__ = ["RpcNode", "TcpClientEnd"]
 
 # Wire capabilities this build understands (hello payload).  "oob" =
 # protocol-5 out-of-band codec segments; "repb" = coalesced multi-reply
-# frames.  Caps only ever UPGRADE encoding — a dropped/severed hello
-# (chaos may eat it) just leaves the connection on the legacy shapes.
-_WIRE_CAPS = ("oob", "repb")
+# frames; "busy" = the peer decodes ("busy", req_id, retry_after_s)
+# admission-shed frames (admission.py) — without it a shed degrades to
+# a silent drop and the caller's own timeout.  Caps only ever UPGRADE
+# encoding — a dropped/severed hello (chaos may eat it) just leaves the
+# connection on the legacy shapes.
+_WIRE_CAPS = ("oob", "repb", "busy")
 # Oldest a queued reply may get before a soft flush (the after-timer
 # call) sends it.  Well above a ticket-resolution burst (microseconds,
 # keeps batching) and below an engine pump tick (milliseconds, must not
@@ -174,6 +180,10 @@ class RpcNode:
         self._outq_since: float = 0.0  # when _outq went non-empty
         # Fault injection (chaos.py ChaosState); None = clean network.
         self.chaos = None
+        # Admission control (admission.py install_admission); None =
+        # every request dispatches.  The hot path pays one `is None`
+        # check per inbound request when admission is off.
+        self.admission = None
         # MRT_WIRE_LEGACY=1: operational kill-switch for the wire fast
         # path — no hello (so peers never negotiate oob/repb) and
         # replies ship immediately per frame instead of through the
@@ -463,6 +473,14 @@ class RpcNode:
             # hello, so the peer knows we decode it).
             for req_id, value in msg[1]:
                 self._complete(req_id, value)
+        elif msg[0] == "busy":
+            # Admission shed at the peer (negotiated "busy" cap):
+            # resolve the pending call NOW with an ErrBusy reply
+            # carrying the retry hint, instead of letting the caller
+            # burn its full timeout on a request the server refused.
+            hint = float(msg[2]) if len(msg) > 2 else 0.0
+            self.obs.metrics.inc("rpc.busy_in")
+            self._complete(msg[1], busy_reply(hint))
         elif msg[0] == "hello":
             # Peer capability offer.  Answer once per connection with
             # ours (the acceptor side of the handshake); the initiator
@@ -515,6 +533,8 @@ class RpcNode:
         self._outq_stamps.pop(conn, None)
         self._peer_caps.pop(conn, None)
         self._hello_sent.discard(conn)
+        if self.admission is not None:
+            self.admission.conn_closed(conn)
         with self._lock:
             for addr, cid in list(self._conns.items()):
                 if cid == conn:
@@ -540,8 +560,19 @@ class RpcNode:
         trace_id: Optional[str] = None,
         t_read: Optional[float] = None,
     ) -> None:
-        # Runs on the scheduler loop.  Control replies bypass reply
-        # chaos (same exemption as the inbound path).
+        # Runs on the scheduler loop.  Admission first: a shed request
+        # must cost decode + one small frame, nothing downstream of
+        # here (no handler, no stage clock, no span).
+        adm = self.admission
+        lane = None
+        if adm is not None:
+            lane = lane_of(svc_meth, trace_id)
+            hint = adm.admit(conn, lane)
+            if hint is not None:
+                self._shed(conn, req_id, hint)
+                return
+        # Control replies bypass reply chaos (same exemption as the
+        # inbound path).
         reply = self._reply if is_control(svc_meth) else self._reply_chaos
         obs = self.obs
         obs.metrics.inc("rpc.handled")
@@ -572,6 +603,10 @@ class RpcNode:
         frec = self._frec
 
         def _done(conn_, req_id_, value):
+            if adm is not None:
+                # Frees this dispatch's slot in the bounded
+                # per-connection queue (pairs with the admit above).
+                adm.release(conn_, lane)
             dt = time.perf_counter() - t0
             obs.metrics.observe("rpc.handle_s", dt)
             if st is not None:
@@ -624,6 +659,27 @@ class RpcNode:
             )
         else:
             _done(conn, req_id, result)
+
+    def _shed(self, conn: int, req_id: int, retry_after_s: float) -> None:
+        """Admission refused the request.  A busy-capable peer gets an
+        immediate ``("busy", ...)`` frame — shed replies must not wait
+        out a coalescing flush; their whole point is a fast hint.  A
+        legacy peer (no hello, or MRT_WIRE_LEGACY) gets nothing: the
+        unknown tag would fall through its ``_handle_msg`` anyway, so
+        the shed degrades to a silent drop and the caller's ordinary
+        timeout + backoff — the pre-round-8 overload behavior."""
+        m = self.obs.metrics
+        m.inc("rpc.shed")
+        caps = self._peer_caps.get(conn)
+        if caps is None or "busy" not in caps:
+            return
+        try:
+            buf = codec.encode(("busy", req_id, retry_after_s))
+            self._tr.send(conn, buf)
+            m.inc("rpc.frames_out")
+            m.inc("rpc.bytes_out", len(buf))
+        except Exception:
+            m.inc("rpc.reply_send_fail")
 
     def _reply_chaos(self, conn: int, req_id: int, value: Any) -> None:
         """Reply path with fault injection: labrpc's dropped-reply case

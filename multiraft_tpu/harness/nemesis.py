@@ -6,7 +6,8 @@ module brings the same discipline to the deployment path.  A
 :func:`make_schedule` call turns ``(seed, n_procs)`` into a
 deterministic timeline of fault windows — delay storms, drop storms
 (requests AND replies), pair partitions, full isolation, mid-stream
-connection severs, crash + restart-from-WAL/checkpoint — and
+connection severs, crash + restart-from-WAL/checkpoint, open-loop
+load surges (the admission-control stressor) — and
 :class:`Nemesis` executes it against a running cluster through the
 servers' ``"Chaos"`` control RPC (distributed/chaos.py), while
 :func:`run_clerk_load` applies concurrent blocking-clerk traffic and
@@ -75,6 +76,9 @@ def make_schedule(
     kill_procs: Sequence[int] = (),
     fault_s: Tuple[float, float] = (0.6, 1.8),
     quiet_s: Tuple[float, float] = (0.2, 0.8),
+    surge_rate: float = 0.0,
+    surge_dur_s: float = 1.5,
+    surge_proc: int = 0,
 ) -> List[Event]:
     """Deterministic fault timeline: alternating fault windows and
     quiet gaps until ``duration_s``, plus one crash+restart per entry
@@ -87,6 +91,14 @@ def make_schedule(
     ``partition`` (symmetric pair block, n_procs ≥ 2), ``isolate``
     (one process's inbound fully blocked — the minority case), and
     ``sever`` (cut every live connection once, mid-stream).
+
+    ``surge_rate`` > 0 adds one ``load_surge`` window mid-run: an
+    open-loop request burst at that offered rate (ops/s) fired at
+    process ``surge_proc`` for ``surge_dur_s`` seconds — the
+    admission-control stressor.  The burst rides the nemesis's own
+    window ledger, so :meth:`Nemesis.verify_windows` can require that
+    the surge demonstrably reached the server (replies came back)
+    while the rest of the schedule's faults were live.
 
     ``kill_procs``: one PERMANENT ``kill_mesh_process`` per entry —
     unlike ``crash``, the process is never restarted; the placement
@@ -130,6 +142,14 @@ def make_schedule(
         at = round(duration_s * (0.35 + 0.25 * k / max(1, len(crash_procs))), 3)
         events.append((at, "crash", {"proc": int(proc),
                                      "down": float(crash_down_s)}))
+    if surge_rate > 0.0:
+        # One open-loop burst, mid-run: overlaps both traffic and any
+        # fault windows scheduled around the 40% mark.
+        events.append((round(duration_s * 0.4, 3), "load_surge", {
+            "proc": int(surge_proc),
+            "rate": float(surge_rate),
+            "dur": round(float(surge_dur_s), 3),
+        }))
     for k, proc in enumerate(kill_procs):
         # Permanent kills land mid-run with traffic and chaos live.
         at = round(
@@ -159,6 +179,7 @@ class ChaosClient:
         self.sched = self.node.sched
         self.addrs = [tuple(a) for a in addrs]
         self.ends = {a: self.node.client_end(*a) for a in self.addrs}
+        self._rng = random.Random(0x0C0A5)
 
     def call(
         self, addr: Addr, meth: str, args: Any = None,
@@ -170,7 +191,10 @@ class ChaosClient:
             )
             if reply is not None and reply is not TIMEOUT:
                 return reply
-            time.sleep(0.05 * (attempt + 1))
+            # Jittered: several ChaosClients retrying against the same
+            # recovering target must not re-arrive in lockstep.
+            base = 0.05 * (attempt + 1)
+            time.sleep(base / 2.0 + self._rng.random() * (base / 2.0))
         return None
 
     def set_rules(self, addr: Addr, wire: Dict[str, Any]) -> Any:
@@ -202,6 +226,21 @@ def _rule(**kw) -> Dict[str, Any]:
     return ChaosRule(**kw).to_wire()
 
 
+def _openloop_surge_fire(
+    host: str, port: int, rate: float, dur: float, seed: int,
+) -> int:
+    """Default ``load_surge`` driver: one open-loop burst from
+    benchmarks/openloop.py (imported lazily — the harness package must
+    stay importable without the benchmarks tree).  Returns the number
+    of requests that got ANY reply (OK, error, or a shed ``ErrBusy``)
+    — the window's proof that the burst actually reached the server."""
+    from benchmarks.openloop import fire_schedule, gen_schedule
+
+    sched = gen_schedule(seed=seed, rate=rate, duration=dur)
+    rep = fire_schedule(host, port, sched, duration=dur, drain_s=1.0)
+    return int(rep.get("replied", 0))
+
+
 class NemesisVerificationError(AssertionError):
     """A scheduled fault window never demonstrably fired — the run was
     a false green (the fleet was never actually under that fault)."""
@@ -227,11 +266,18 @@ class Nemesis:
         addrs: Sequence[Addr],
         kill: Optional[Callable[[int], None]] = None,
         restart: Optional[Callable[[int], None]] = None,
+        surge_fire: Optional[Callable[..., int]] = None,
     ) -> None:
         self.addrs = [tuple(a) for a in addrs]
         self.ctl = ChaosClient(self.addrs)
         self._kill = kill
         self._restart = restart
+        # load_surge burst driver: (host, port, rate, dur, seed) ->
+        # replied count.  Injectable so fast tests swap in a fake; the
+        # default lazy-imports benchmarks/openloop.py (harness modules
+        # must not depend on benchmarks at import time).
+        self._surge_fire = surge_fire or _openloop_surge_fire
+        self._surge_threads: Dict[int, threading.Thread] = {}
         self.applied: List[Tuple[str, str, Dict[str, Any]]] = []
         self._model: Dict[Addr, Dict[str, Any]] = {
             a: {"peers": {}, "all_out": None, "all_in": None, "reply": None}
@@ -346,6 +392,26 @@ class Nemesis:
             self._model[aa]["peers"][f"{ab[0]}:{ab[1]}"] = _rule(block=True)
             self._model[ab]["peers"][f"{aa[0]}:{aa[1]}"] = _rule(block=True)
             self._ack_start(w, [self._push(aa), self._push(ab)])
+        elif kind == "load_surge":
+            a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
+            w["acked"] = True  # the burst thread is ours to run
+            seed = int(p["rate"]) + 1009 * p["proc"]
+
+            def _burst(w=w, a=a, p=p, seed=seed) -> None:
+                try:
+                    w["hits"] = int(self._surge_fire(
+                        a[0], a[1], p["rate"], p["dur"], seed,
+                    ))
+                except Exception as exc:  # noqa: BLE001 - ledgered
+                    w["acked"] = False
+                    w["excused"] = f"surge burst failed: {exc!r}"
+
+            t = threading.Thread(
+                target=_burst, name="nemesis-surge", daemon=True,
+            )
+            self._surge_threads[id(p)] = t
+            t.start()
         elif kind == "sever":
             w = self._window(kind, p, [p["proc"]])
             cut = self.ctl.sever(self.addrs[p["proc"]])
@@ -402,7 +468,18 @@ class Nemesis:
                     w["excused"] or "target killed (kill_mesh_process)"
                 )
             return
-        if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
+        if kind == "load_surge":
+            # The burst fires for exactly p["dur"]; the stop action
+            # lands right as it ends, so the join is a drain wait.
+            t = self._surge_threads.pop(id(p), None)
+            if t is not None:
+                t.join(timeout=p["dur"] + 15.0)
+            if w is not None:
+                w["t_stop_us"] = now_us()
+                if t is not None and t.is_alive():
+                    w["acked"] = False
+                    w["excused"] = "surge burst never finished"
+        elif kind in ("delay_storm", "drop_storm", "isolate", "partition"):
             if kind == "partition":
                 aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
                 self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
@@ -525,7 +602,8 @@ class Nemesis:
         silently missed (see :meth:`verify_windows`)."""
         actions: List[Tuple[float, int, str, str, Dict[str, Any]]] = []
         for n, (at, kind, p) in enumerate(schedule):
-            if kind in ("delay_storm", "drop_storm", "isolate", "partition"):
+            if kind in ("delay_storm", "drop_storm", "isolate",
+                        "partition", "load_surge"):
                 actions.append((at, n, "start", kind, p))
                 actions.append((at + p["dur"], n, "stop", kind, p))
             elif kind == "crash":

@@ -14,7 +14,11 @@ and must keep meaning what it meant):
   ``SERVING_r*.json`` (socket + in-process ops/s);
 * ``loadcurve`` — benchmarks/openloop.py open-loop sweeps tracked as
   ``LOADCURVE_r*.json`` (max sustainable rate at the p99 target, knee
-  position, p99 at the knee);
+  position, and latency at the SHARED operating point: the fresh
+  round's p99 is read off its curve at the incumbent round's knee
+  rate, so a round that moves the knee outward — admission control
+  flattening the curve — is not penalized for measuring its own knee
+  further up the ladder);
 * ``placement`` — placement_scenario.py controller runs tracked as
   ``PLACEMENT_r*.json`` (per-process commit-rate spread reduction
   after rebalancing a hot/cold skew, failover re-place time after a
@@ -140,6 +144,21 @@ def _get(doc: Dict[str, Any], key: str) -> Optional[float]:
     return None
 
 
+def _p99_at_rate(doc: Dict[str, Any], rate: float) -> Optional[float]:
+    """Client p99 of the sweep step at exactly ``rate`` offered ops/s,
+    from a loadcurve result's ``curve`` arrays (None if the round
+    didn't sweep that rate)."""
+    curve = doc.get("curve")
+    if not isinstance(curve, dict):
+        return None
+    rates = curve.get("offered_rate") or []
+    p99s = curve.get("client_p99_ms") or []
+    for r, p in zip(rates, p99s):
+        if r == rate and isinstance(p, (int, float)):
+            return float(p)
+    return None
+
+
 def _fmt(v: Optional[float]) -> str:
     if v is None:
         return "n/a"
@@ -172,6 +191,20 @@ def compare(
         fv = _get(fresh, key)
         traj = [_get(doc, key) for _, doc in history]
         lv = _get(latest, key)
+        if key == "p99_at_knee_ms":
+            # "p99 at the knee" is only comparable when both rounds
+            # knee at the same rate.  A round that moves the knee OUT
+            # (admission control flattening the curve) would otherwise
+            # be penalized for exactly that improvement: its knee p99
+            # is measured further up the ladder.  Gate latency at the
+            # SHARED operating point instead — the incumbent round's
+            # knee rate, whose p99 is by definition what lv holds.
+            shared = _get(latest, "knee_ops_per_sec")
+            if shared is not None:
+                at_shared = _p99_at_rate(fresh, shared)
+                if at_shared is not None:
+                    fv = at_shared
+                    label = f"p99 at {_fmt(shared)} ops/s (ms)"
         if fv is None or lv is None:
             delta_s = "n/a"
         else:

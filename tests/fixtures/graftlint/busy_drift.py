@@ -1,0 +1,31 @@
+"""Fixture: round-8 busy-frame drift (frame-arity).
+
+The shed path ships ``("busy", req_id, retry_after_s)`` — 3 fields.
+This decoder drifted twice: one handler reads a 4th "lane" field
+without a ``len()`` guard, and a compat handler unpacks the frame
+into 2 names.  graftlint must flag both (frame-arity).  The guarded
+hint read is the clean negative.
+"""
+
+from somewhere import codec  # noqa: F401  (never executed)
+
+
+def shed(tr, conn, req_id, retry_after_s):
+    tr.send(conn, codec.encode(("busy", req_id, retry_after_s)))
+
+
+def handle(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        complete(msg[1], busy_reply(msg[2], msg[3]))  # 4th field, no guard
+
+
+def handle_compat(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        _, req_id = msg  # decoder expects 2, encoder packs 3
+        complete(req_id, busy_reply(0.0, ""))
+
+
+def handle_guarded(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        hint = msg[2] if len(msg) > 2 else 0.0  # guarded: clean
+        complete(msg[1], busy_reply(hint, ""))

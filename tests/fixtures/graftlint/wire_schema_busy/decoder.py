@@ -1,0 +1,24 @@
+"""Consumer half of the busy-frame wire-schema fixture.
+
+Reads a 4th "lane" field past the shipped arity and unpacks the frame
+into 2 names — both against the 3-field encoder in encoder.py.  The
+guarded hint read is the clean negative (access past the minimum
+arity, but behind a len() check).
+"""
+
+
+def on_busy(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        complete(msg[1], busy_reply(msg[2], msg[3]))  # BUG: arity is 3
+
+
+def on_busy_compat(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        _, req_id = msg  # BUG: encoder ships 3 fields
+        complete(req_id, busy_reply(0.0, ""))
+
+
+def on_busy_guarded(msg, complete, busy_reply):
+    if msg[0] == "busy":
+        hint = msg[2] if len(msg) > 2 else 0.0  # guarded: clean
+        complete(msg[1], busy_reply(hint, ""))

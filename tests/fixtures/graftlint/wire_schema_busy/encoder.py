@@ -1,0 +1,11 @@
+"""Producer half of the busy-frame wire-schema fixture (round 8).
+
+The dispatch layer's shed path ships ``("busy", req_id,
+retry_after_s)`` — 3 fields — through the shared codec.  The decoder
+lives in decoder.py; the drift is invisible to any single-module
+lexical check (frame-arity), which is the gap wire-schema closes.
+"""
+
+
+def shed(codec, conn, req_id, retry_after_s):
+    codec.encode(("busy", req_id, retry_after_s))

@@ -1,0 +1,477 @@
+"""Admission control and backpressure (round 8): token buckets, the
+brownout state machine's hysteresis, priority-lane classification, the
+ErrBusy wire path end to end over real sockets (shed reply reaches the
+clerk with a usable retry_after_s), and the MRT_WIRE_LEGACY interop
+contract (shed degrades to a silent drop, never a frame error)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from multiraft_tpu.distributed.admission import (
+    LANE_CONTROL,
+    LANE_SYSTEM,
+    LANE_USER,
+    LANE_VERIFY,
+    AdmissionController,
+    TokenBucket,
+    lane_of,
+)
+from multiraft_tpu.distributed.engine_wire import (
+    ERR_BUSY,
+    OK,
+    EngineCmdArgs,
+    EngineCmdReply,
+    busy_reply,
+    retry_after_of,
+)
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.distributed.overload import (
+    BROWNOUT,
+    HEALTHY,
+    SHEDDING,
+    BrownoutMachine,
+)
+from multiraft_tpu.distributed.realtime import Backoff
+from multiraft_tpu.sim.scheduler import TIMEOUT
+from multiraft_tpu.transport import codec
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+class _Clock:
+    """Injectable monotonic clock for bucket tests."""
+
+    def __init__(self) -> None:
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_deficit_hint(self):
+        clk = _Clock()
+        b = TokenBucket(rate=10.0, burst=2.0, now=clk)
+        assert b.take() == 0.0
+        assert b.take() == 0.0
+        wait = b.take()  # bucket empty, no time passed
+        assert wait == pytest.approx(0.1)  # 1 token at 10/s
+
+    def test_refill_restores_admission(self):
+        clk = _Clock()
+        b = TokenBucket(rate=10.0, burst=1.0, now=clk)
+        assert b.take() == 0.0
+        assert b.take() > 0.0
+        clk.t += 0.2  # 2 tokens refilled, capped at burst=1
+        assert b.take() == 0.0
+
+    def test_factor_scales_refill_and_hint(self):
+        clk = _Clock()
+        b = TokenBucket(rate=10.0, burst=1.0, now=clk)
+        assert b.take(factor=0.5) == 0.0
+        wait = b.take(factor=0.5)  # effective rate 5/s
+        assert wait == pytest.approx(0.2)
+        clk.t += 0.1  # only 0.5 tokens at the browned-out rate
+        assert b.take(factor=0.5) > 0.0
+
+    def test_zero_rate_never_admits_after_burst(self):
+        clk = _Clock()
+        b = TokenBucket(rate=0.0, burst=1.0, now=clk)
+        assert b.take() == 0.0
+        clk.t += 1e6
+        assert b.take() == 1.0  # sentinel wait, not a div-by-zero
+
+
+# ---------------------------------------------------------------------------
+# BrownoutMachine: transitions + hysteresis (no flapping)
+# ---------------------------------------------------------------------------
+
+
+class TestBrownoutMachine:
+    def test_escalates_one_level_per_streak(self):
+        bm = BrownoutMachine(up=2, down=3)
+        assert bm.update(1) == HEALTHY       # 1 tripping tick: not yet
+        assert bm.update(1) == SHEDDING      # 2nd consecutive: escalate
+        assert bm.update(1) == SHEDDING      # streak reset on crossing
+        assert bm.update(1) == BROWNOUT
+        assert bm.update(5) == BROWNOUT      # capped at the top
+
+    def test_deescalates_after_down_clean_ticks(self):
+        bm = BrownoutMachine(up=1, down=3)
+        assert bm.update(1) == SHEDDING
+        assert bm.update(0) == SHEDDING
+        assert bm.update(0) == SHEDDING
+        assert bm.update(0) == HEALTHY       # 3rd clean tick
+        assert bm.update(0) == HEALTHY       # floored at the bottom
+
+    def test_oscillation_holds_state_instead_of_flapping(self):
+        """A p99 bouncing around its bound (trip, clean, trip, clean)
+        must neither escalate nor de-escalate: each crossing resets the
+        opposite streak, so the state HOLDS."""
+        bm = BrownoutMachine(up=2, down=2)
+        bm.update(1)
+        bm.update(1)
+        assert bm.state == SHEDDING
+        for _ in range(20):
+            assert bm.update(1) == SHEDDING
+            assert bm.update(0) == SHEDDING
+
+    def test_clean_tick_resets_escalation_streak(self):
+        bm = BrownoutMachine(up=3, down=100)
+        bm.update(1)
+        bm.update(1)
+        bm.update(0)  # streak broken
+        bm.update(1)
+        bm.update(1)
+        assert bm.state == HEALTHY
+        assert bm.update(1) == SHEDDING
+
+
+# ---------------------------------------------------------------------------
+# Lane classification
+# ---------------------------------------------------------------------------
+
+
+def test_lane_of_classification():
+    assert lane_of("Chaos.set_rules", "x.1") == LANE_CONTROL
+    assert lane_of("Obs.snapshot", None) == LANE_CONTROL
+    assert lane_of("EngineKV.config", "c1.1") == LANE_SYSTEM
+    assert lane_of("EngineShardKV.pull_shard", None) == LANE_SYSTEM
+    assert lane_of("EngineKV.command", "verify.c1.3") == LANE_VERIFY
+    assert lane_of("EngineKV.command", ("verify.c1.3", 1.5)) == LANE_VERIFY
+    assert lane_of("EngineKV.command", "c1.3") == LANE_USER
+    assert lane_of("EngineKV.batch", None) == LANE_USER
+    assert lane_of("EngineKV.firehose", ("r7", 0.1)) == LANE_USER
+
+
+# ---------------------------------------------------------------------------
+# Wire schema: busy frame + widened reply
+# ---------------------------------------------------------------------------
+
+
+def test_busy_frame_codec_roundtrip():
+    buf = codec.encode(("busy", 42, 0.25))
+    tag, req_id, hint = codec.decode(buf)
+    assert (tag, req_id) == ("busy", 42)
+    assert hint == pytest.approx(0.25)
+
+
+def test_widened_reply_tolerates_legacy_peer():
+    """Pickle bypasses __init__: a reply encoded by a pre-round-8 peer
+    decodes WITHOUT retry_after_s.  retry_after_of must read it anyway
+    (the exact failure the wire-schema lint fixture guards)."""
+    old = EngineCmdReply.__new__(EngineCmdReply)
+    old.__dict__.update({"err": ERR_BUSY, "value": ""})
+    assert "retry_after_s" not in old.__dict__  # pickle restores __dict__
+    assert retry_after_of(old) == 0.0
+    new = busy_reply(0.125)
+    assert new.err == ERR_BUSY
+    assert retry_after_of(new) == pytest.approx(0.125)
+    rt = codec.decode(codec.encode(new))
+    assert retry_after_of(rt) == pytest.approx(0.125)
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController (no sockets)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionController:
+    def _adm(self, clk, **kw):
+        kw.setdefault("rate", 10.0)
+        kw.setdefault("burst", 2.0)
+        kw.setdefault("session_rate", 0.0)  # session bucket off
+        kw.setdefault("inflight_cap", 4)
+        return AdmissionController(now=clk, **kw)
+
+    def test_admit_then_shed_with_usable_hint(self):
+        clk = _Clock()
+        adm = self._adm(clk)
+        assert adm.admit(1, LANE_USER) is None
+        assert adm.admit(1, LANE_USER) is None
+        hint = adm.admit(1, LANE_USER)
+        assert hint is not None and 0.0 < hint <= 5.0
+        assert hint >= adm.base_hint_s  # the floor beats the raw deficit
+
+    def test_only_user_lane_sheds(self):
+        clk = _Clock()
+        adm = self._adm(clk, rate=0.0, burst=1.0, inflight_cap=1)
+        assert adm.admit(1, LANE_USER) is None  # burst token
+        assert adm.admit(1, LANE_USER) is not None
+        for lane in (LANE_CONTROL, LANE_SYSTEM, LANE_VERIFY):
+            for _ in range(50):
+                assert adm.admit(1, lane) is None
+
+    def test_inflight_cap_bounds_dispatch_queue(self):
+        clk = _Clock()
+        adm = self._adm(clk, rate=1e6, burst=1e6, inflight_cap=2)
+        assert adm.admit(7, LANE_USER) is None
+        assert adm.admit(7, LANE_USER) is None
+        assert adm.admit(7, LANE_USER) is not None  # over the cap
+        assert adm.admit(8, LANE_USER) is None      # per-connection
+        adm.release(7, LANE_USER)
+        assert adm.admit(7, LANE_USER) is None      # slot freed
+
+    def test_session_bucket_isolates_greedy_client(self):
+        clk = _Clock()
+        adm = self._adm(clk, rate=1e6, burst=1e6, session_rate=2.0)
+        # session burst = max(1, 2/2) = 1: one admit, then shed.
+        assert adm.admit(1, LANE_USER) is None
+        assert adm.admit(1, LANE_USER) is not None
+        # A DIFFERENT session is untouched by 1's exhaustion.
+        assert adm.admit(2, LANE_USER) is None
+
+    def test_brownout_level_tightens_admission(self):
+        clk = _Clock()
+        adm = self._adm(clk, rate=10.0, burst=1.0, inflight_cap=100)
+        adm.set_level(BROWNOUT)
+        assert adm.factor == pytest.approx(0.2)
+        assert adm.admit(1, LANE_USER) is None  # burst token
+        hint = adm.admit(1, LANE_USER)
+        # Deficit priced at the browned-out rate (2/s, not 10/s), and
+        # the hint floor grows with the level.
+        assert hint is not None
+        assert hint >= adm.base_hint_s * (1 + BROWNOUT)
+
+    def test_conn_closed_frees_state(self):
+        clk = _Clock()
+        adm = self._adm(clk, rate=1e6, burst=1e6, session_rate=2.0,
+                        inflight_cap=1)
+        assert adm.admit(1, LANE_USER) is None
+        assert adm.inflight_total() == 1
+        adm.conn_closed(1)
+        assert adm.inflight_total() == 0
+        assert adm.admit(1, LANE_USER) is None  # fresh session bucket
+
+
+# ---------------------------------------------------------------------------
+# Clerk backoff: jittered hints
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_jittered_bounds_and_no_doubling():
+    b = Backoff(base=0.02, cap=1.0)
+    draws = [b.jittered(0.2) for _ in range(200)]
+    assert all(0.1 <= d <= 0.2 for d in draws)
+    assert len(set(draws)) > 1  # actually jittered
+    # jittered() must NOT advance the doubling state: the first
+    # next_delay afterwards is still drawn from [base/2, base].
+    assert b.next_delay() <= 0.02
+
+
+def test_busy_delay_honors_hint_else_backoff():
+    from multiraft_tpu.distributed.engine_clerks import _busy_delay
+
+    b = Backoff(base=0.02, cap=1.0)
+    d = _busy_delay(b, busy_reply(0.4))
+    assert 0.2 <= d <= 0.4
+    # Legacy reply without the field → ordinary doubling backoff.
+    old = EngineCmdReply.__new__(EngineCmdReply)
+    old.__dict__.update({"err": ERR_BUSY, "value": ""})
+    d2 = _busy_delay(b, old)
+    assert d2 <= 0.02  # first next_delay draw
+
+
+# ---------------------------------------------------------------------------
+# ErrBusy end to end over real sockets
+# ---------------------------------------------------------------------------
+
+
+class _StubKV:
+    """Minimal EngineKV: answers command with OK so the only failure
+    mode in play is admission shedding."""
+
+    def command(self, args):
+        return EngineCmdReply(err=OK, value=f"v:{args.key}")
+
+
+def _serve_stub(rate: float, burst: float, **kw):
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = RpcNode(listen=True)
+    server.add_service("EngineKV", _StubKV())
+    server.admission = AdmissionController(
+        metrics=server.obs.metrics, rate=rate, burst=burst,
+        session_rate=0.0, **kw,
+    )
+    return server
+
+
+@needs_native
+def test_shed_reply_reaches_caller_as_errbusy():
+    """The acceptance wiring: dispatch sheds → ("busy", req_id, hint)
+    frame → caller's future resolves IMMEDIATELY with ErrBusy carrying
+    a usable retry_after_s (no timeout burned)."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = _serve_stub(rate=0.5, burst=1.0, inflight_cap=64)
+    client = RpcNode()
+    try:
+        end = client.client_end(server.host, server.port)
+        args = EngineCmdArgs(op="Get", key="k", client_id=1, command_id=0)
+        r1 = client.sched.wait(end.call("EngineKV.command", args), 5.0)
+        assert isinstance(r1, EngineCmdReply) and r1.err == OK
+        t0 = time.monotonic()
+        r2 = client.sched.wait(end.call("EngineKV.command", args), 5.0)
+        took = time.monotonic() - t0
+        assert isinstance(r2, EngineCmdReply) and r2.err == ERR_BUSY
+        assert 0.0 < retry_after_of(r2) <= 5.0
+        assert took < 2.0  # the hint frame, not a burned timeout
+        sm = server.obs.metrics.snapshot()
+        assert sm["admit.shed"] >= 1 and sm["rpc.shed"] >= 1
+        assert sm["admit.accepted"] >= 1
+        assert sm["admit.lane.user"] >= 2
+        cm = client.obs.metrics.snapshot()
+        assert cm["rpc.busy_in"] >= 1
+    finally:
+        client.close()
+        server.close()
+
+
+@needs_native
+def test_clerk_retries_through_shed_and_succeeds():
+    """Clerk-level integration: a shed get resolves as ErrBusy, the
+    clerk backs off for the jittered hint and retries until admitted —
+    the caller just sees a slightly slower success."""
+    from multiraft_tpu.distributed.engine_cluster import BlockingEngineClerk
+
+    server = _serve_stub(rate=5.0, burst=1.0, inflight_cap=64)
+    try:
+        ck = BlockingEngineClerk(server.port, host=server.host)
+        try:
+            assert ck.get("a", timeout=30.0) == "v:a"
+            assert ck.get("b", timeout=30.0) == "v:b"  # shed then retried
+            m = ck.node.obs.metrics.snapshot()
+            assert m.get("clerk.busy", 0) >= 1
+            assert m.get("rpc.busy_in", 0) >= 1
+        finally:
+            ck.close()
+        sm = server.obs.metrics.snapshot()
+        assert sm["admit.shed"] >= 1
+        assert sm["admit.retry_after_s_count"] >= 1
+    finally:
+        server.close()
+
+
+@needs_native
+def test_verify_lane_exempt_from_shedding():
+    """The porcupine sampler's lane: with admission refusing ALL user
+    traffic, a verify-lane clerk still gets answers."""
+    from multiraft_tpu.distributed.engine_cluster import BlockingEngineClerk
+
+    server = _serve_stub(rate=0.0, burst=1.0, inflight_cap=64)
+    try:
+        vk = BlockingEngineClerk(server.port, host=server.host,
+                                 lane="verify")
+        try:
+            for i in range(5):
+                assert vk.get(f"k{i}", timeout=30.0) == f"v:k{i}"
+        finally:
+            vk.close()
+        sm = server.obs.metrics.snapshot()
+        assert sm["admit.lane.verify"] >= 5
+        assert sm.get("admit.shed", 0) == 0
+    finally:
+        server.close()
+
+
+@needs_native
+def test_legacy_wire_shed_degrades_to_silent_drop(monkeypatch):
+    """MRT_WIRE_LEGACY interop: the legacy client never negotiates the
+    busy cap, so a shed is a silent drop — its call times out and its
+    ordinary backoff applies; no frame errors, and the connection keeps
+    working for later admitted calls."""
+    monkeypatch.setenv("MRT_WIRE_LEGACY", "1")
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = _serve_stub(rate=5.0, burst=1.0, inflight_cap=64)
+    client = RpcNode()  # constructed WITH the legacy env: sends no hello
+    try:
+        end = client.client_end(server.host, server.port)
+        args = EngineCmdArgs(op="Get", key="k", client_id=1, command_id=0)
+        r1 = client.sched.wait(end.call("EngineKV.command", args), 5.0)
+        assert isinstance(r1, EngineCmdReply) and r1.err == OK
+        r2 = client.sched.wait(end.call("EngineKV.command", args), 0.5)
+        assert r2 is TIMEOUT  # shed, silently
+        sm = server.obs.metrics.snapshot()
+        assert sm["rpc.shed"] >= 1
+        assert sm.get("rpc.reply_send_fail", 0) == 0
+        cm = client.obs.metrics.snapshot()
+        assert cm.get("rpc.busy_in", 0) == 0  # no busy frame arrived
+        # The 0.5s timeout refilled ~2.5 tokens: the SAME connection
+        # admits again — the drop was a shed, not a wire fault.
+        r3 = client.sched.wait(end.call("EngineKV.command", args), 5.0)
+        assert isinstance(r3, EngineCmdReply) and r3.err == OK
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# load_surge at 3× the knee (slow acceptance)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_load_surge_3x_knee_stays_linearizable():
+    """ISSUE round-8 acceptance: an open-loop burst at 3× the r01 knee
+    (2000 ops/s) against a live engine process with admission enabled;
+    the control plane keeps answering THROUGH the surge, the surge
+    demonstrably reached the server, and concurrent clerk history stays
+    linearizable."""
+    from multiraft_tpu.distributed.cluster import EngineProcessCluster
+    from multiraft_tpu.harness.nemesis import (
+        Nemesis,
+        make_schedule,
+        run_clerk_load,
+    )
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    schedule = make_schedule(
+        seed=8, n_procs=1, duration_s=6.0, include=(),
+        surge_rate=6000.0, surge_dur_s=2.0,
+    )
+    assert [k for _, k, _ in schedule] == ["load_surge", "heal"]
+
+    cluster = EngineProcessCluster(kind="engine_kv", groups=16, seed=3,
+                                   chaos_seed=7)
+    try:
+        cluster.start()
+        addr = (cluster.host, cluster.port)
+        nem = Nemesis([addr])
+        try:
+            runner = nem.run_async(schedule)
+            # Control plane must answer WHILE the surge is live.
+            time.sleep(schedule[0][0] + 0.5)
+            assert nem.ctl.ping(addr)
+            history = run_clerk_load(
+                cluster.clerk, keys=["sa", "sb"],
+                n_workers=3, ops_per_worker=9, op_timeout=120.0,
+            )
+            runner.join(timeout=120.0)
+            assert not runner.is_alive()
+            assert nem.error is None
+            nem.verify_windows(require_hits=("load_surge",))
+            (w,) = [w for w in nem.windows if w["kind"] == "load_surge"]
+            assert w["hits"] > 0  # replies (OK or shed) came back
+        finally:
+            nem.close()
+        assert len(history) == 27
+        assert_linearizable(
+            kv_model, history, timeout=60.0, name="load-surge-3x"
+        )
+    finally:
+        cluster.shutdown()

@@ -64,6 +64,8 @@ _FIXTURE_CASES = [
     ("unbounded_queue.py", "unbounded-queue", 1),  # PR 6 reply-queue bug
     ("blocking_callback.py", "blocking-in-callback", 2),  # loop stalls
     ("wire_schema", "wire-schema", 2),  # cross-module frame drift
+    ("busy_drift.py", "frame-arity", 2),  # round-8 busy-frame drift
+    ("wire_schema_busy", "wire-schema", 2),  # busy hint cross-module drift
 ]
 
 

@@ -102,6 +102,88 @@ def test_make_schedule_partition_needs_two_procs():
     assert [k for _, k, _ in sched] == ["heal"]
 
 
+def test_make_schedule_load_surge_window():
+    kw = dict(duration_s=6.0, surge_rate=1500.0, surge_dur_s=1.2)
+    s1 = make_schedule(7, 2, **kw)
+    assert s1 == make_schedule(7, 2, **kw)
+    surges = [(at, p) for at, k, p in s1 if k == "load_surge"]
+    assert surges == [(2.4, {"proc": 0, "rate": 1500.0, "dur": 1.2})]
+    # The heal still closes the schedule, after the surge window ends.
+    assert s1[-1][1] == "heal" and s1[-1][0] >= 2.4 + 1.2
+    # No surge_rate, no surge window (the default schedule is unchanged).
+    assert not any(
+        k == "load_surge" for _, k, _ in make_schedule(7, 2, duration_s=6.0)
+    )
+
+
+@needs_native
+def test_nemesis_load_surge_runs_and_verifies():
+    """The load_surge verb end to end with an injected burst driver:
+    the window opens at its scheduled instant, the driver fires with
+    the schedule's (rate, dur), the replied count lands as the
+    window's hits, and verify_windows(require_hits) accepts it."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+
+    server = RpcNode(listen=True)
+    server.add_service("Echo", _Echo())
+    install_chaos(server, seed=2)
+    fired = []
+
+    def fake_surge(host, port, rate, dur, seed):
+        fired.append((host, port, rate, dur, seed))
+        return 37  # "37 requests got replies"
+
+    sched = make_schedule(
+        9, 1, duration_s=0.6, include=(),
+        surge_rate=800.0, surge_dur_s=0.2,
+    )
+    assert [k for _, k, _ in sched] == ["load_surge", "heal"]
+    nem = Nemesis([(server.host, server.port)], surge_fire=fake_surge)
+    try:
+        nem.run(sched)  # verify=True: must not raise
+        assert fired == [(server.host, server.port, 800.0, 0.2,
+                          800 + 1009 * 0)]
+        (w,) = nem.windows
+        assert w["kind"] == "load_surge" and w["acked"]
+        assert w["hits"] == 37 and w["t_stop_us"] is not None
+        nem.verify_windows(require_hits=("load_surge",))
+        kinds = [(ph, k) for ph, k, _ in nem.applied]
+        assert ("start", "load_surge") in kinds
+        assert ("stop", "load_surge") in kinds
+    finally:
+        nem.close()
+        server.close()
+
+
+@needs_native
+def test_nemesis_load_surge_failed_burst_is_a_silent_miss():
+    """A burst driver that errors (or a server that never replied)
+    must FAIL verification — a surge that never reached the fleet is
+    exactly the false green verify_windows exists to catch."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.nemesis import NemesisVerificationError
+
+    server = RpcNode(listen=True)
+    install_chaos(server, seed=2)
+
+    def broken_surge(host, port, rate, dur, seed):
+        raise RuntimeError("generator never started")
+
+    sched = make_schedule(
+        9, 1, duration_s=0.4, include=(),
+        surge_rate=500.0, surge_dur_s=0.1,
+    )
+    nem = Nemesis([(server.host, server.port)], surge_fire=broken_surge)
+    try:
+        with pytest.raises(NemesisVerificationError, match="load_surge"):
+            nem.run(sched)
+        (w,) = nem.windows
+        assert not w["acked"] and "surge burst failed" in w["excused"]
+    finally:
+        nem.close()
+        server.close()
+
+
 # ---------------------------------------------------------------------------
 # Chaos over real sockets (RpcNode level)
 # ---------------------------------------------------------------------------
