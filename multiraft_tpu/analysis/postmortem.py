@@ -70,6 +70,26 @@ def _ship_window_us() -> float:
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
+
+def _covering_window(
+    windows: List[Dict[str, Any]], ts: float,
+) -> Optional[Dict[str, Any]]:
+    """The nemesis fault window active at host-clock ``ts`` — exact
+    containment first; else the latest window that STARTED before
+    ``ts`` (a wedge is declared stall_ticks scrapes after its cause,
+    and may outlive a short window by detection lag)."""
+    best = None
+    for w in windows:
+        t0 = w.get("t_start_us")
+        if t0 is None or t0 > ts:
+            continue
+        t1 = w.get("t_stop_us")
+        if t1 is not None and t1 >= ts:
+            return w  # contains ts
+        if best is None or t0 > best.get("t_start_us", 0):
+            best = w
+    return best
+
 # OVERLOAD record codes (overload.py writes them).
 _OVL_STAGE = flightrec.OVERLOAD_KIND_CODES["stage_p99"]
 _OVL_GAUGE = flightrec.OVERLOAD_KIND_CODES["gauge"]
@@ -430,6 +450,54 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                         ),
                         "aligned": off is not None,
                     })
+        # Wedged leadership: WEDGE records (wedge.py watchdog) grouped
+        # by group — ONE anomaly per wedged group, anchored on the
+        # wedge ONSET, naming the stalled group, the stuck leader (the
+        # record tag carries "p<peer>@t<term>"), and the nemesis fault
+        # window that caused it (windows.json, same host clock the
+        # anomaly is aligned to).
+        wedge_by_g: Dict[int, List[Record]] = {}
+        for r in recs:
+            if r["type"] == flightrec.WEDGE:
+                wedge_by_g.setdefault(r["code"], []).append(r)
+        if wedge_by_g:
+            info["wedges"] = {
+                g: {
+                    "records": len(rs),
+                    "peak_stall": max(r["a"] for r in rs),
+                    "leader": rs[0]["tag"],
+                }
+                for g, rs in sorted(wedge_by_g.items())
+            }
+        for g, rs in sorted(wedge_by_g.items()):
+            first, last = rs[0], rs[-1]
+            onset = aligned(first["ts"])
+            span_s = (last["ts"] - first["ts"]) / 1e6
+            detail = (
+                f"wedged leadership: group {g} commit frontier stalled "
+                f"at {first['b']} with {first['c']} proposal(s) "
+                f"pending; stuck leader {first['tag']}; "
+                f"{len(rs)} wedge record(s) over {span_s:.1f}s, peak "
+                f"stall {max(r['a'] for r in rs)} scrape(s)"
+            )
+            win = (
+                _covering_window(bundle.get("windows") or [], onset)
+                if off is not None else None
+            )
+            if win is not None:
+                t1 = win.get("t_stop_us")
+                detail += (
+                    f"; during fault window '{win['kind']}' on "
+                    f"proc(s) {win.get('procs')} "
+                    f"(t={win.get('t_start_us', 0):.0f}–"
+                    + (f"{t1:.0f}us" if t1 is not None else "open")
+                    + ")"
+                )
+            anomalies.append({
+                "ts": onset, "proc": label,
+                "kind": "wedged_leadership", "detail": detail,
+                "aligned": off is not None,
+            })
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more
@@ -537,6 +605,12 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                     f"ship:g{r['code']}", ts, track="ship",
                     pid=pid, group=r["code"], records=r["a"],
                     bytes=r["b"], frontier=r["c"], kind=r["tag"],
+                )
+            elif t == flightrec.WEDGE:
+                out.instant(
+                    f"wedge:g{r['code']}", ts, track="wedge",
+                    pid=pid, group=r["code"], stall=r["a"],
+                    commit=r["b"], backlog=r["c"], leader=r["tag"],
                 )
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
@@ -653,6 +727,12 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
             add(
                 f"    shipped state: {p['ship_records']} shipment(s), "
                 f"last frontiers {gids}"
+            )
+        for g, w in (p.get("wedges") or {}).items():
+            add(
+                f"    wedged: group {g} leader {w['leader']}, "
+                f"{w['records']} record(s), peak stall "
+                f"{w['peak_stall']} scrape(s)"
             )
 
     if analysis["lag"]:

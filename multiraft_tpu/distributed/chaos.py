@@ -57,14 +57,17 @@ DROP = "drop"
 
 
 class ChaosRule:
-    """One edge's fault mix: independent drop/delay probabilities and a
-    hard ``block`` (the partition case — every frame vanishes).
+    """One edge's fault mix: independent drop/delay probabilities, a
+    hard ``block`` (the partition case — every frame vanishes), and a
+    ``floor`` (the slow-link case — EVERY frame pays at least this
+    latency, a degraded-but-alive link rather than burst jitter).
 
     ``delay_min``/``delay_max`` bound the uniform delay draw; labrpc's
     two regimes map to (0, 0.027) for "unreliable" jitter and (0, 7.0)
     for long-delay drops of requests to dead servers."""
 
-    __slots__ = ("drop", "delay", "delay_min", "delay_max", "block")
+    __slots__ = ("drop", "delay", "delay_min", "delay_max", "block",
+                 "floor")
 
     def __init__(
         self,
@@ -73,18 +76,20 @@ class ChaosRule:
         delay_min: float = 0.0,
         delay_max: float = 0.0,
         block: bool = False,
+        floor: float = 0.0,
     ) -> None:
         self.drop = float(drop)
         self.delay = float(delay)
         self.delay_min = float(delay_min)
         self.delay_max = float(delay_max)
         self.block = bool(block)
+        self.floor = float(floor)
 
     def to_wire(self) -> Dict[str, Any]:
         return {
             "drop": self.drop, "delay": self.delay,
             "delay_min": self.delay_min, "delay_max": self.delay_max,
-            "block": self.block,
+            "block": self.block, "floor": self.floor,
         }
 
     @classmethod
@@ -95,6 +100,7 @@ class ChaosRule:
             delay_min=d.get("delay_min", 0.0),
             delay_max=d.get("delay_max", 0.0),
             block=d.get("block", False),
+            floor=d.get("floor", 0.0),
         )
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
@@ -177,10 +183,25 @@ class ChaosState:
                 return DROP
             if rule.delay > 0.0 and self._rng.random() < rule.delay:
                 t = self._rng.uniform(rule.delay_min, rule.delay_max)
+                if rule.floor > 0.0:
+                    t = max(t, rule.floor)
                 self.delayed += 1
                 self._hit(path, "delay")
                 return t
+            if rule.floor > 0.0:
+                # slow_link: deterministic per-frame latency floor, no
+                # coin flip — the link is degraded for every frame.
+                self.delayed += 1
+                self._hit(path, "floor")
+                return rule.floor
         return PASS
+
+    def note_fault(self, path: str, kind: str) -> None:
+        """Record an externally-applied fault (e.g. an fsync stall from
+        disk.py) in the hit ledger / metrics / flight ring, under the
+        same lock the frame decisions use."""
+        with self._lock:
+            self._hit(path, kind)
 
     def decide_out(self, addr: Tuple[str, int]) -> Any:
         rule = self.peer_out.get(addr)
@@ -257,7 +278,22 @@ class ChaosControl:
 
     def clear(self, _args: Any = None) -> dict:
         self._state.clear()
+        # A full heal also lifts any gray-disk stall: the nemesis's
+        # heal-all must leave no residual fault on the node.
+        from . import disk
+        disk.set_fsync_stall(0.0)
         return self._state.snapshot()
+
+    def fsync_stall(self, args: Any = None) -> float:
+        """Gray disk: every fsync on this process stalls for
+        ``args[0]`` seconds (0 clears).  Injected through the shared
+        stall point in distributed/disk.py, which both the persister
+        and the WAL sync path run through — slow-but-alive storage,
+        the fault class ``block`` cannot model."""
+        from . import disk
+        s = float(args[0]) if args else 0.0
+        disk.set_fsync_stall(s, chaos=self._state if s > 0 else None)
+        return s
 
     def sever(self, args: Any = None) -> int:
         """Close live connections mid-stream (both directions see a

@@ -33,9 +33,46 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
+import time
 import zlib
 
-__all__ = ["DiskPersister"]
+__all__ = ["DiskPersister", "set_fsync_stall", "fsync_stall_point"]
+
+# -- gray-disk fault injection ----------------------------------------------
+#
+# A slow-but-alive disk is the storage analog of a slow link: fsync
+# still succeeds, just late — the fault class that wedges WAL-gated
+# acks without tripping any liveness detector built on "is it up".
+# ChaosControl.fsync_stall arms this process-wide stall; every sync
+# point (DiskPersister._write below, WriteAheadLog.sync) runs through
+# fsync_stall_point() so a single verb grays out ALL durable writes on
+# the node.  Each applied stall is recorded in the arming ChaosState's
+# hit ledger ("disk" path, kind "fsync_stall") so nemesis fault-window
+# verification and the postmortem doctor see it.
+
+_stall_lock = threading.Lock()
+_stall_s = 0.0
+_stall_chaos = None
+
+
+def set_fsync_stall(seconds: float, chaos=None) -> None:
+    """Arm (or, with 0, clear) the process-wide fsync stall."""
+    global _stall_s, _stall_chaos
+    with _stall_lock:
+        _stall_s = max(0.0, float(seconds))
+        _stall_chaos = chaos if _stall_s > 0 else None
+
+
+def fsync_stall_point() -> None:
+    """Run by every durable-write sync path before its os.fsync."""
+    with _stall_lock:
+        s, chaos = _stall_s, _stall_chaos
+    if s <= 0.0:
+        return
+    time.sleep(s)
+    if chaos is not None:
+        chaos.note_fault("disk", "fsync_stall")
 
 _MAGIC = b"MRF2"
 _HEADER = struct.Struct("<4sIQ")  # magic, crc32(len ‖ body), len(body)
@@ -97,6 +134,7 @@ class DiskPersister:
             f.write(body)
             f.flush()
             if self._fsync:
+                fsync_stall_point()
                 os.fsync(f.fileno())
         os.replace(tmp, path)
         if self._fsync:

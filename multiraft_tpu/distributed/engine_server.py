@@ -55,6 +55,7 @@ from .engine_wire import (
 )
 from .admission import install_admission
 from .overload import install_overload_watch
+from .wedge import install_wedge_watch
 from .realtime import (
     PumpCadence,
     RealtimeScheduler,
@@ -596,6 +597,10 @@ def serve_engine_kv(
     # turning those signals into shed/bounded behavior at dispatch.
     install_admission(node)
     install_overload_watch(node)
+    # Wedge watchdog (wedge.py): per-group commit-frontier stall with
+    # proposals pending -> WEDGE flight records + gauge.wedged_groups,
+    # the gray-failure signal the up/down detectors above cannot see.
+    install_wedge_watch(node)
     return node
 
 # Backwards-compatible re-exports: engine_server was the single module

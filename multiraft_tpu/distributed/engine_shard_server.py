@@ -1055,7 +1055,11 @@ def serve_engine_shardkv(
     # Admission: the watch's brownout state drives shedding at dispatch.
     from .admission import install_admission
     from .overload import install_overload_watch
+    from .wedge import install_wedge_watch
 
     install_admission(node)
     install_overload_watch(node)
+    # Wedge watchdog: commit-frontier stall with proposals pending →
+    # WEDGE records + gauge.wedged_groups (gray-failure liveness).
+    install_wedge_watch(node)
     return node

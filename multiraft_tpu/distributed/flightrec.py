@@ -100,6 +100,8 @@ PLACE = 15       # code=gid a=src_proc b=dst_proc c=placement_version
 #                  tag=reason (placement.py controller decisions)
 SHIP = 16        # code=gid a=n_records b=n_bytes c=acked_frontier
 #                  tag="snap"|"tail" (stateplane.py shipments)
+WEDGE = 17       # code=group a=stall_ticks b=commit_index c=backlog
+#                  tag=leader ("p<peer>@t<term>"; wedge.py watchdog)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -118,10 +120,14 @@ _TYPE_NAMES = {
     OVERLOAD: "overload",
     PLACE: "place",
     SHIP: "ship",
+    WEDGE: "wedge",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.
-CHAOS_KIND_CODES = {"drop": 1, "delay": 2, "block": 3}
+# floor: slow_link per-frame latency floor (every frame pays it);
+# fsync_stall: gray-disk stall applied at a disk.py/wal.py sync point.
+CHAOS_KIND_CODES = {"drop": 1, "delay": 2, "block": 3, "floor": 4,
+                    "fsync_stall": 5}
 
 # Runtime-sanitizer violation kinds → compact codes for SANITIZE
 # records (sanitize.py; the postmortem doctor names them back).

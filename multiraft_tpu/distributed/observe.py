@@ -256,6 +256,12 @@ class ObsControl:
             # bounded dispatched-unreplied count it enforces.
             out["gauge.admit_tokens"] = float(adm.tokens())
             out["gauge.admit_inflight"] = float(adm.inflight_total())
+        ww = getattr(node, "wedge_watch", None)
+        if ww is not None:
+            # Wedge watchdog (wedge.py): groups whose commit frontier
+            # is stalled with proposals pending — gray-failure liveness
+            # visible in a scrape, before the postmortem.
+            out["gauge.wedged_groups"] = float(len(ww.wedged))
         return out
 
     def hist(self, args: Any = None) -> Dict[str, Any]:

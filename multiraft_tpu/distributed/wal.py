@@ -42,7 +42,7 @@ from collections import deque
 from typing import Iterator, List, Optional, Tuple
 
 from ..utils.metrics import Metrics
-from . import flightrec
+from . import disk, flightrec
 
 __all__ = ["WriteAheadLog"]
 
@@ -214,6 +214,10 @@ class WriteAheadLog:
         self._write_pending()
         self._f.flush()
         if self._fsync:
+            # Gray-disk chaos: slow-but-alive storage stalls here (the
+            # stall lands inside wal.fsync_s, where the postmortem
+            # doctor's fsync-gap scan will see it).
+            disk.fsync_stall_point()
             os.fsync(self._f.fileno())
         dt = time.perf_counter() - t0
         m = self.metrics

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Dict, NamedTuple, Tuple
 
 import jax
@@ -60,6 +61,23 @@ __all__ = [
 ]
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default) not in ("", "0")
+
+
+def prevote_default() -> bool:
+    """PreVote election mode, ON unless ``MRT_PREVOTE=0`` (kill switch).
+    Read at EngineConfig construction, so the legacy arm of the CI A/B
+    matrix flips it per-process without touching call sites."""
+    return _env_on("MRT_PREVOTE")
+
+
+def check_quorum_default() -> bool:
+    """Check-quorum leader self-demotion, ON unless
+    ``MRT_CHECK_QUORUM=0`` (kill switch, paired with MRT_PREVOTE)."""
+    return _env_on("MRT_CHECK_QUORUM")
 
 # The tick's metrics schema — single source of truth for the mesh
 # path's out_specs (engine/mesh.py) and the host's per-device scalar
@@ -107,8 +125,20 @@ class EngineConfig:
     # only a prevote quorum promotes to a real candidacy.  Voters that
     # heard a live leader within ELECT_MIN ticks refuse, so a replica
     # rejoining from a partition cannot depose a healthy leader by
-    # term inflation.  Off by default (reference-faithful elections).
-    prevote: bool = False
+    # term inflation.  Default ON; ``MRT_PREVOTE=0`` restores the
+    # reference-faithful legacy elections (the CI A/B's second arm).
+    prevote: bool = dataclasses.field(default_factory=prevote_default)
+    # Check-quorum (etcd CheckQuorum analog): a leader that has not
+    # heard an append reply from a quorum within ELECT_MAX ticks
+    # demotes itself to follower AT ITS OWN TERM — a quorum-severed
+    # leader releases its groups instead of wedging them while clerk
+    # traffic piles into a log that can never commit.  The demotion
+    # keeps ``voted_for`` (clearing it would allow a second same-term
+    # grant and break election safety).  Default ON;
+    # ``MRT_CHECK_QUORUM=0`` is the kill switch.
+    check_quorum: bool = dataclasses.field(
+        default_factory=check_quorum_default
+    )
 
     def __post_init__(self) -> None:
         # The ring-log algebra requires headroom: vectorized scatters
@@ -150,6 +180,7 @@ class EngineState(NamedTuple):
     alive: jnp.ndarray  # bool[G,P] fault-injection: replica up
     pre_votes: jnp.ndarray  # bool[G,P,P] prevote grants (prevote mode)
     last_heard: jnp.ndarray  # i32[G,P] last tick a leader was heard
+    last_ack: jnp.ndarray  # i32[G,P,P] leader p: last ack tick from q
 
 
 class Mailbox(NamedTuple):
@@ -207,6 +238,7 @@ def init_state(cfg: EngineConfig, key: jax.Array) -> EngineState:
         alive=jnp.ones((G, P), bool),
         pre_votes=jnp.zeros((G, P, P), bool),
         last_heard=z(G, P),
+        last_ack=z(G, P, P),
     )
 
 
@@ -311,16 +343,24 @@ def _step_down(
     state: EngineState,
     higher: jnp.ndarray,
     m_term: jnp.ndarray,
+    clear_vote: bool = True,
 ) -> EngineState:
     """Observe a higher term: adopt it, clear the vote, drop to
     follower (reference: the term-check prologue of every RPC handler).
     In prevote mode a term bump also invalidates any prevote round in
-    flight — its grants were collected at a now-stale term."""
+    flight — its grants were collected at a now-stale term.
+
+    ``clear_vote=False`` is the check-quorum entry: the demotion
+    happens AT THE LEADER'S OWN TERM, where the vote must survive —
+    the leader voted for itself at this term, and releasing that vote
+    would let a concurrent same-term candidate collect a second grant
+    from this replica (two leaders at one term)."""
     kw = dict(
         term=jnp.where(higher, m_term, state.term),
-        voted_for=jnp.where(higher, -1, state.voted_for),
         role=jnp.where(higher, FOLLOWER, state.role),
     )
+    if clear_vote:
+        kw["voted_for"] = jnp.where(higher, -1, state.voted_for)
     if cfg.prevote:
         kw["pre_votes"] = jnp.where(
             higher[..., None], False, state.pre_votes
@@ -523,6 +563,15 @@ def tick_impl(
         ),
         hb_due=jnp.where(become_leader, now, state.hb_due),  # immediate HB
     )
+    if cfg.check_quorum:
+        # A fresh leader starts its check-quorum clock NOW: every peer
+        # counts as just-heard, so the demotion below cannot fire off
+        # acks owed to a previous reign.
+        state = state._replace(
+            last_ack=jnp.where(
+                become_leader[..., None], now, state.last_ack
+            )
+        )
 
     # ---- 3. append requests (reference: raft/raft_append_entry.go:108-162) ----
     # One arbitrated pass (fused r04).  Distinct leaders always carry
@@ -678,6 +727,13 @@ def tick_impl(
         & (state.role == LEADER)[..., None]
         & (m_term == state.term[..., None])
     )
+    if cfg.check_quorum:
+        # Any current-term reply — success OR conflict — proves the
+        # peer is reachable and acknowledges this leadership; both
+        # refresh the leader's per-peer last-ack clock.
+        state = state._replace(
+            last_ack=jnp.where(good, now, state.last_ack)
+        )
     succ = good & vT(inbox.ap_success)
     fail = good & ~vT(inbox.ap_success)
     new_match = jnp.maximum(state.match_idx, vT(inbox.ap_match))
@@ -735,6 +791,29 @@ def tick_impl(
             state.commit,
         )
     state = state._replace(commit=new_commit)
+
+    # ---- 4b. check-quorum: quorum-severed leaders release their
+    # groups (etcd CheckQuorum analog; beyond the reference) ----
+    if cfg.check_quorum:
+        # Quorum-heard tick: the (P-quorum)-th smallest effective ack
+        # (self slot = now) has ``quorum`` elements at or above it, so
+        # it is the newest tick at which a full quorum had acked.
+        eff_ack = jnp.where(own, now, state.last_ack)  # [G,P,P]
+        q_heard = _kth_smallest(eff_ack, P - cfg.quorum)  # [G,P]
+        demote = (
+            (state.role == LEADER)
+            & state.alive
+            & ((now - q_heard) >= cfg.ELECT_MAX)
+        )
+        state = _step_down(
+            cfg, state, demote, state.term, clear_vote=False
+        )
+        # Full randomized backoff before the deposed leader campaigns:
+        # while severed its prevotes cannot win anyway, and on heal the
+        # surviving side's leader should not be raced immediately.
+        state = state._replace(
+            elect_dl=jnp.where(demote, now + jitter, state.elect_dl)
+        )
 
     # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----
     timeout = state.alive & (now >= state.elect_dl) & (state.role != LEADER)

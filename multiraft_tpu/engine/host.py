@@ -352,6 +352,9 @@ class EngineDriver:
             # Conservative lease on rebirth: wait out ELECT_MIN before
             # granting prevotes (volatile, like the vote tallies).
             last_heard=st.last_heard.at[g, p].set(st.tick_no),
+            # Check-quorum clock is leadership-scoped (reseeded at
+            # become_leader), so rebirth just zeroes it.
+            last_ack=st.last_ack.at[g, p].set(0),
             # Applied rewinds to the snapshot floor: the service replays
             # the log above base (commit knowledge is volatile in Raft).
             commit=st.commit.at[g, p].set(st.base[g, p]),
@@ -590,7 +593,8 @@ class EngineDriver:
 
     # v2: EngineState gained pre_votes/last_heard (PreVote support);
     # Mailbox gained vr_pre/vp_pre.
-    CKPT_VERSION = 2
+    # v3: EngineState gained last_ack (check-quorum stepdown).
+    CKPT_VERSION = 3
 
     def save(self, path: str, extra: Optional[Dict[str, Any]] = None) -> str:
         """Atomically write a full checkpoint.  ``extra`` carries

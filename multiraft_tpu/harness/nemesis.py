@@ -92,6 +92,17 @@ def make_schedule(
     (one process's inbound fully blocked — the minority case), and
     ``sever`` (cut every live connection once, mid-stream).
 
+    Gray-failure kinds (the faults that wedge fleets without tripping
+    fail-stop detectors): ``asym_partition`` (ONE-WAY block a→b —
+    b still reaches a; n_procs ≥ 2), ``partial_partition`` (one
+    process severed from every OTHER engine process in both
+    directions while client traffic still flows — the
+    leader-hears-clerks-but-not-quorum case; n_procs ≥ 2),
+    ``slow_link`` (deterministic per-frame latency floor on a
+    process's inbound frames — degraded-but-alive, not burst jitter),
+    and ``fsync_stall`` (every durable write on a process stalls —
+    slow-but-alive storage, injected through distributed/disk.py).
+
     ``surge_rate`` > 0 adds one ``load_surge`` window mid-run: an
     open-loop request burst at that offered rate (ops/s) fired at
     process ``surge_proc`` for ``surge_dur_s`` seconds — the
@@ -107,7 +118,8 @@ def make_schedule(
     (a crash's restart would resurrect a process the placement layer
     has already declared dead)."""
     rng = random.Random(seed)
-    kinds = [k for k in include if k != "partition" or n_procs > 1]
+    _pairwise = ("partition", "asym_partition", "partial_partition")
+    kinds = [k for k in include if k not in _pairwise or n_procs > 1]
     events: List[Event] = []
     t = rng.uniform(*quiet_s)
     while t < duration_s and kinds:
@@ -132,6 +144,23 @@ def make_schedule(
             }))
         elif kind == "isolate":
             events.append((at, "isolate", {"proc": i, "dur": dur}))
+        elif kind == "asym_partition":
+            j = rng.choice([x for x in range(n_procs) if x != i])
+            events.append(
+                (at, "asym_partition", {"a": i, "b": j, "dur": dur})
+            )
+        elif kind == "partial_partition":
+            events.append((at, "partial_partition", {"proc": i, "dur": dur}))
+        elif kind == "slow_link":
+            events.append((at, "slow_link", {
+                "proc": i, "dur": dur,
+                "floor": round(rng.uniform(0.02, 0.12), 3),
+            }))
+        elif kind == "fsync_stall":
+            events.append((at, "fsync_stall", {
+                "proc": i, "dur": dur,
+                "stall": round(rng.uniform(0.05, 0.3), 3),
+            }))
         elif kind == "sever":
             events.append((at, "sever", {"proc": i}))
         else:
@@ -343,6 +372,30 @@ class Nemesis:
                 (aa, [f"peer:{ab[0]}:{ab[1]}"], ("block",)),
                 (ab, [f"peer:{aa[0]}:{aa[1]}"], ("block",)),
             ]
+        if kind == "asym_partition":
+            # One-way: only a's outbound edge carries the block rule.
+            aa, ab = addrs[p["a"]], addrs[p["b"]]
+            return [(aa, [f"peer:{ab[0]}:{ab[1]}"], ("block",))]
+        if kind == "partial_partition":
+            i = p["proc"]
+            a = addrs[i]
+            others = p.get("others")
+            if others is None:
+                others = [x for x in range(len(addrs)) if x != i]
+            specs = [(
+                a,
+                [f"peer:{addrs[x][0]}:{addrs[x][1]}" for x in others],
+                ("block",),
+            )]
+            specs += [
+                (addrs[x], [f"peer:{a[0]}:{a[1]}"], ("block",))
+                for x in others
+            ]
+            return specs
+        if kind == "slow_link":
+            return [(addrs[p["proc"]], ["all_in"], ("floor",))]
+        if kind == "fsync_stall":
+            return [(addrs[p["proc"]], ["disk"], ("fsync_stall",))]
         return []
 
     # -- actions -----------------------------------------------------------
@@ -392,6 +445,57 @@ class Nemesis:
             self._model[aa]["peers"][f"{ab[0]}:{ab[1]}"] = _rule(block=True)
             self._model[ab]["peers"][f"{aa[0]}:{aa[1]}"] = _rule(block=True)
             self._ack_start(w, [self._push(aa), self._push(ab)])
+        elif kind == "asym_partition":
+            # ONE-WAY block: a's frames toward b vanish; b→a flows.
+            # Only a carries a rule — the fault class check-quorum must
+            # catch (the leader's appends die while everything it hears
+            # says the fleet is healthy).
+            aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+            w = self._window(kind, p, [p["a"], p["b"]])
+            self._model[aa]["peers"][f"{ab[0]}:{ab[1]}"] = _rule(block=True)
+            self._ack_start(w, [self._push(aa)])
+        elif kind == "partial_partition":
+            # Sever proc i from every OTHER engine process, both
+            # directions, via per-peer rules only: client connections
+            # match no peer rule, so a leader living on i still hears
+            # its clerks while its quorum is gone — the wedge-shaped
+            # gray failure the check-quorum stepdown exists for.
+            i = p["proc"]
+            a = self.addrs[i]
+            others = [
+                x for x in range(len(self.addrs))
+                if x != i and x not in self._dead
+            ]
+            p["others"] = others  # pinned for _stop/_hit_spec symmetry
+            w = self._window(kind, p, [i] + others)
+            for x in others:
+                b = self.addrs[x]
+                self._model[a]["peers"][f"{b[0]}:{b[1]}"] = _rule(block=True)
+                self._model[b]["peers"][f"{a[0]}:{a[1]}"] = _rule(block=True)
+            self._ack_start(
+                w,
+                [self._push(a)] + [self._push(self.addrs[x]) for x in others],
+            )
+        elif kind == "slow_link":
+            a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
+            # Latency floor on EVERY inbound frame — degraded-but-alive,
+            # where delay_storm is probabilistic burst jitter.
+            self._model[a]["all_in"] = _rule(floor=p["floor"])
+            self._ack_start(w, [self._push(a)])
+        elif kind == "fsync_stall":
+            a = self.addrs[p["proc"]]
+            w = self._window(kind, p, [p["proc"]])
+            ack = self.ctl.call(a, "fsync_stall", [p["stall"]])
+            w["acked"] = ack is not None
+            # Stall hits land in the target's chaos ledger ("disk"
+            # path) as storage traffic syncs; baseline from stats, not
+            # from a rule push (the stall is not a wire rule).
+            w["baseline"] = self._hit_count(
+                self.ctl.stats(a), ["disk"], ("fsync_stall",)
+            )
+            if not w["acked"]:
+                w["excused"] = "fsync_stall push unacknowledged (target down?)"
         elif kind == "load_surge":
             a = self.addrs[p["proc"]]
             w = self._window(kind, p, [p["proc"]])
@@ -479,12 +583,40 @@ class Nemesis:
                 if t is not None and t.is_alive():
                     w["acked"] = False
                     w["excused"] = "surge burst never finished"
-        elif kind in ("delay_storm", "drop_storm", "isolate", "partition"):
+        elif kind in ("delay_storm", "drop_storm", "isolate", "partition",
+                      "asym_partition", "partial_partition", "slow_link",
+                      "fsync_stall"):
             if kind == "partition":
                 aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
                 self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
                 self._model[ab]["peers"].pop(f"{aa[0]}:{aa[1]}", None)
                 acks = [self._push(aa), self._push(ab)]
+            elif kind == "asym_partition":
+                aa, ab = self.addrs[p["a"]], self.addrs[p["b"]]
+                self._model[aa]["peers"].pop(f"{ab[0]}:{ab[1]}", None)
+                acks = [self._push(aa)]
+            elif kind == "partial_partition":
+                i = p["proc"]
+                a = self.addrs[i]
+                others = [
+                    x for x in p.get("others", ())
+                    if x not in self._dead
+                ]
+                for x in others:
+                    b = self.addrs[x]
+                    self._model[a]["peers"].pop(f"{b[0]}:{b[1]}", None)
+                    self._model[b]["peers"].pop(f"{a[0]}:{a[1]}", None)
+                acks = [self._push(a)] + [
+                    self._push(self.addrs[x]) for x in others
+                ]
+            elif kind == "fsync_stall":
+                a = self.addrs[p["proc"]]
+                # Lift the stall, then read the hit delta from stats
+                # (the stall is armed by verb, not by a wire rule).
+                lifted = self.ctl.call(a, "fsync_stall", [0.0])
+                acks = [
+                    self.ctl.stats(a) if lifted is not None else None
+                ]
             else:
                 a = self.addrs[p["proc"]]
                 self._model[a]["all_in"] = None
@@ -603,7 +735,8 @@ class Nemesis:
         actions: List[Tuple[float, int, str, str, Dict[str, Any]]] = []
         for n, (at, kind, p) in enumerate(schedule):
             if kind in ("delay_storm", "drop_storm", "isolate",
-                        "partition", "load_surge"):
+                        "partition", "asym_partition", "partial_partition",
+                        "slow_link", "fsync_stall", "load_surge"):
                 actions.append((at, n, "start", kind, p))
                 actions.append((at + p["dur"], n, "stop", kind, p))
             elif kind == "crash":

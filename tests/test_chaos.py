@@ -696,3 +696,255 @@ def test_firehose_get_gated_behind_unsynced_write():
     err, values = _firehose_reply(synced=True)
     assert err.tolist() == [FH_OK, FH_OK]
     assert values[1] == "applied-v"
+
+
+# ---------------------------------------------------------------------------
+# Gray-fault verbs: slow_link floor, fsync_stall, asym/partial partitions
+# ---------------------------------------------------------------------------
+
+
+class TestGrayFaults:
+    def test_floor_rule_wire_roundtrip_and_deterministic_delay(self):
+        r = ChaosRule(floor=0.08)
+        assert ChaosRule.from_wire(r.to_wire()).floor == 0.08
+        st = ChaosState(seed=3)
+        st.all_in = ChaosRule(floor=0.05)
+        # No coin flip: EVERY frame pays exactly the floor.
+        assert [st.decide_in() for _ in range(5)] == [0.05] * 5
+        assert st.hits["all_in"]["floor"] == 5
+        assert st.delayed == 5
+
+    def test_floor_raises_probabilistic_delay_draws(self):
+        st = ChaosState(seed=4)
+        st.all_in = ChaosRule(
+            delay=1.0, delay_min=0.0, delay_max=0.01, floor=0.5
+        )
+        for _ in range(10):
+            d = st.decide_in()
+            assert isinstance(d, float) and d >= 0.5
+
+    def test_note_fault_enters_hit_ledger(self):
+        st = ChaosState(seed=0)
+        st.note_fault("disk", "fsync_stall")
+        st.note_fault("disk", "fsync_stall")
+        assert st.hits["disk"]["fsync_stall"] == 2
+        assert st.snapshot()["hits"]["disk"]["fsync_stall"] == 2
+
+    def test_gray_kinds_have_flightrec_codes(self):
+        from multiraft_tpu.distributed.flightrec import CHAOS_KIND_CODES
+
+        assert CHAOS_KIND_CODES["floor"] != CHAOS_KIND_CODES["delay"]
+        assert "fsync_stall" in CHAOS_KIND_CODES
+
+    def test_fsync_stall_applies_to_persister_and_ledgers(self, tmp_path):
+        from multiraft_tpu.distributed import disk
+
+        st = ChaosState(seed=0)
+        disk.set_fsync_stall(0.01, chaos=st)
+        try:
+            p = disk.DiskPersister(str(tmp_path / "d"), fsync=True)
+            t0 = time.perf_counter()
+            p.save_raft_state(b"x")
+            assert time.perf_counter() - t0 >= 0.01
+            assert st.hits["disk"]["fsync_stall"] >= 1
+        finally:
+            disk.set_fsync_stall(0.0)
+        n = st.hits["disk"]["fsync_stall"]
+        p.save_raft_state(b"y")  # stall lifted: no new hits
+        assert st.hits["disk"]["fsync_stall"] == n
+
+    def test_fsync_stall_applies_to_wal_sync(self, tmp_path):
+        from multiraft_tpu.distributed import disk
+        from multiraft_tpu.distributed.wal import WriteAheadLog
+
+        st = ChaosState(seed=0)
+        wal = WriteAheadLog(str(tmp_path / "w.wal"), fsync=True)
+        disk.set_fsync_stall(0.01, chaos=st)
+        try:
+            wal.append(b"rec")
+            wal.sync()
+            assert st.hits["disk"]["fsync_stall"] >= 1
+            # The stall lands inside the measured fsync latency, where
+            # the postmortem doctor's fsync-gap scan looks.
+            assert wal.metrics.hists["wal.fsync_s"].vmax >= 0.01
+        finally:
+            disk.set_fsync_stall(0.0)
+            wal.close()
+
+    def test_chaos_control_fsync_stall_verb_and_clear_lifts(self):
+        from multiraft_tpu.distributed import disk
+        from multiraft_tpu.distributed.chaos import ChaosControl
+
+        st = ChaosState(seed=0)
+        ctl = ChaosControl(None, st)
+        try:
+            assert ctl.fsync_stall([0.02]) == 0.02
+            assert disk._stall_s == 0.02
+            # clear() is the nemesis's heal-all: it must leave no
+            # residual gray-disk fault behind.
+            ctl.clear()
+            assert disk._stall_s == 0.0
+            assert ctl.fsync_stall([0.0]) == 0.0
+        finally:
+            disk.set_fsync_stall(0.0)
+
+    def test_make_schedule_gray_kinds_deterministic(self):
+        gray = ("asym_partition", "partial_partition", "slow_link",
+                "fsync_stall")
+        s1 = make_schedule(3, 3, duration_s=9.0, include=gray)
+        assert s1 == make_schedule(3, 3, duration_s=9.0, include=gray)
+        kinds = {k for _, k, _ in s1}
+        assert kinds - {"heal"} <= set(gray)
+        assert len(kinds - {"heal"}) >= 2
+        for _, k, p in s1:
+            if k == "slow_link":
+                assert 0.0 < p["floor"] < 1.0
+            if k == "fsync_stall":
+                assert 0.0 < p["stall"] < 1.0
+            if k == "asym_partition":
+                assert p["a"] != p["b"]
+
+    def test_gray_pairwise_kinds_need_two_procs(self):
+        sched = make_schedule(
+            3, 1, duration_s=6.0,
+            include=("asym_partition", "partial_partition", "slow_link",
+                     "fsync_stall"),
+        )
+        kinds = {k for _, k, _ in sched}
+        assert "asym_partition" not in kinds
+        assert "partial_partition" not in kinds
+        assert kinds & {"slow_link", "fsync_stall"}
+
+    def test_hit_specs_for_gray_kinds(self):
+        addrs = [("h", 1), ("h", 2), ("h", 3)]
+        # One-way: only a's outbound edge must show block hits.
+        assert Nemesis._hit_spec(
+            "asym_partition", {"a": 0, "b": 2}, addrs
+        ) == [(("h", 1), ["peer:h:3"], ("block",))]
+        # Partial: the target blocks every other engine proc, and each
+        # of them blocks the target back — client paths carry no rule.
+        spec = Nemesis._hit_spec("partial_partition", {"proc": 1}, addrs)
+        assert spec[0] == (("h", 2), ["peer:h:1", "peer:h:3"], ("block",))
+        assert (("h", 1), ["peer:h:2"], ("block",)) in spec
+        assert (("h", 3), ["peer:h:2"], ("block",)) in spec
+        assert len(spec) == 3
+        # Pinned survivor list (stop-time symmetry) narrows the spec.
+        spec = Nemesis._hit_spec(
+            "partial_partition", {"proc": 1, "others": [2]}, addrs
+        )
+        assert spec == [
+            (("h", 2), ["peer:h:3"], ("block",)),
+            (("h", 3), ["peer:h:2"], ("block",)),
+        ]
+        assert Nemesis._hit_spec("slow_link", {"proc": 0}, addrs) == [
+            (("h", 1), ["all_in"], ("floor",))
+        ]
+        assert Nemesis._hit_spec("fsync_stall", {"proc": 2}, addrs) == [
+            (("h", 3), ["disk"], ("fsync_stall",))
+        ]
+
+
+@needs_native
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+def test_nemesis_gray_faults_fleet_linearizable(tmp_path):
+    """Gray-failure acceptance: a seeded schedule of asymmetric and
+    partial partitions, slow links, and fsync stalls runs against a
+    two-process durable engine fleet under clerk load.  Every window
+    verifies as fired — with slow_link and fsync_stall REQUIRED to show
+    applied faults (clerk traffic and durable writes guarantee both see
+    load) — and the client-observed history stays linearizable: gray
+    faults degrade, they must not corrupt."""
+    from multiraft_tpu.distributed.engine_cluster import EngineFleetCluster
+    from multiraft_tpu.porcupine.kv import kv_model
+    from multiraft_tpu.porcupine.visualization import assert_linearizable
+
+    gray = ("asym_partition", "partial_partition", "slow_link",
+            "fsync_stall")
+    kw = dict(
+        duration_s=10.0, include=gray,
+        fault_s=(0.5, 1.4), quiet_s=(0.3, 0.8),
+    )
+    schedule = make_schedule(21, 2, **kw)
+    assert schedule == make_schedule(21, 2, **kw)
+    kinds = {k for _, k, _ in schedule}
+    assert len(kinds - {"heal"}) >= 2  # a real gray mix scheduled
+
+    fleet = EngineFleetCluster(
+        [[1], [2]], seed=17, data_dir=str(tmp_path / "fleet"),
+        checkpoint_every_s=3600.0, chaos_seed=23,
+    )
+    try:
+        fleet.start_all()
+        fleet.admin("join", [1])
+        fleet.admin("join", [2])
+        addrs = [(fleet.host, p) for p in fleet.ports]
+        # Distinct first letters → distinct shards: with two gids
+        # owning five shards each, six distinct shards guarantee BOTH
+        # processes receive durable writes (fsync_stall's required
+        # hits need an fsync at the faulted process mid-window; keys
+        # on one shard would leave the other process fsync-idle).
+        keys = ["aw", "bw", "cw", "dw", "ew", "fw"]
+        # Continuous durable traffic on DISJOINT keys (the porcupine
+        # model below starts from empty state, so these values must
+        # never appear in a checked Get).  fsync_stall's required hits
+        # need a WAL sync at the faulted process MID-WINDOW, but the
+        # 27-op checked load finishes in a couple of seconds while the
+        # nemesis runs ~10 s — without a pump, later windows are
+        # write-idle and verify_windows fails with zero applied
+        # faults.  One blocking pass first (leaders elected, first
+        # fsyncs done) so even the earliest window sees real writes.
+        bg_keys = ["gw", "hw", "iw", "jw", "kw", "lw"]
+        import threading
+
+        warm = fleet.clerk()
+        for k in bg_keys:
+            warm.append(k, "(warm)", timeout=60.0)
+        stop_bg = threading.Event()
+
+        def _pump():
+            i = 0
+            while not stop_bg.is_set():
+                try:
+                    warm.append(bg_keys[i % len(bg_keys)], "+",
+                                timeout=5.0)
+                except Exception:
+                    time.sleep(0.05)
+                i += 1
+
+        bg = threading.Thread(target=_pump, daemon=True)
+        bg.start()
+        nem = Nemesis(addrs, kill=fleet.kill, restart=fleet.start)
+        try:
+            runner = nem.run_async(schedule)
+            history = run_clerk_load(
+                fleet.clerk, keys=keys,
+                n_workers=3, ops_per_worker=9, op_timeout=240.0,
+            )
+            runner.join(timeout=400.0)
+            stop_bg.set()
+            bg.join(timeout=30.0)
+            warm.close()
+            assert not runner.is_alive()
+            assert nem.error is None
+            assert nem.applied[-1][1] == "heal"
+            for a in addrs:
+                assert nem.ctl.ping(a)
+                # The heal-all left no residual gray-disk stall: fresh
+                # writes ack at normal speed (stats still reachable).
+                assert nem.ctl.stats(a) is not None
+            assert len(nem.windows) == len(schedule) - 1
+            applied_kinds = {w["kind"] for w in nem.windows}
+            assert applied_kinds == kinds - {"heal"}
+            nem.verify_windows(
+                require_hits=("slow_link", "fsync_stall")
+            )
+        finally:
+            stop_bg.set()
+            nem.close()
+        assert len(history) == 27
+        assert_linearizable(
+            kv_model, history, timeout=60.0, name="gray-nemesis"
+        )
+    finally:
+        fleet.shutdown()

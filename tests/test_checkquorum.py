@@ -1,0 +1,197 @@
+"""Check-quorum stepdown + PreVote-by-default tests (gray-failure
+hardening): a leader severed from its quorum releases the group within
+one election window instead of serving stale reads forever, demotion at
+the leader's OWN term keeps ``voted_for`` (two same-term leaders would
+otherwise become possible), and a replica rejoining after a partition
+raises the fleet's max term by at most one with PreVote on — versus the
+unbounded inflation of the legacy arm."""
+
+import numpy as np
+
+from multiraft_tpu.engine.core import (
+    LEADER,
+    EngineConfig,
+    check_quorum_default,
+    prevote_default,
+)
+from multiraft_tpu.engine.host import EngineDriver
+
+
+def make(G=2, P=3, seed=0, **kw) -> EngineDriver:
+    cfg = EngineConfig(G=G, P=P, **kw)
+    return EngineDriver(cfg, seed=seed)
+
+
+def _sever_leader(d: EngineDriver, g: int, lead: int) -> None:
+    """Cut every edge between the leader and its peers, both ways —
+    the quorum-severed-but-alive gray failure."""
+    for p in range(d.cfg.P):
+        if p != lead:
+            d.set_edge(g, lead, p, False)
+            d.set_edge(g, p, lead, False)
+
+
+def test_robust_election_defaults_and_kill_switches(monkeypatch):
+    """PreVote and check-quorum are ON by default; MRT_PREVOTE=0 /
+    MRT_CHECK_QUORUM=0 are the per-process kill switches (the CI A/B
+    legacy arm)."""
+    monkeypatch.delenv("MRT_PREVOTE", raising=False)
+    monkeypatch.delenv("MRT_CHECK_QUORUM", raising=False)
+    cfg = EngineConfig(G=1, P=3)
+    assert cfg.prevote and cfg.check_quorum
+    monkeypatch.setenv("MRT_PREVOTE", "0")
+    monkeypatch.setenv("MRT_CHECK_QUORUM", "0")
+    assert not prevote_default() and not check_quorum_default()
+    legacy = EngineConfig(G=1, P=3)
+    assert not legacy.prevote and not legacy.check_quorum
+    # Explicit arguments always win over the env defaults.
+    forced = EngineConfig(G=1, P=3, prevote=True, check_quorum=True)
+    assert forced.prevote and forced.check_quorum
+
+
+def test_checkquorum_stepdown_within_election_window():
+    """A leader that stops hearing any quorum demotes itself within
+    ELECT_MAX ticks, and the surviving pair elects a replacement that
+    commits — the group is released, not wedged."""
+    d = make(G=2, P=3, seed=5, prevote=True, check_quorum=True)
+    assert d.run_until_quiet_leaders(400)
+    g = 0
+    lead = d.leader_of(g)
+    _sever_leader(d, g, lead)
+    demoted_at = None
+    for i in range(d.cfg.ELECT_MAX + 5):
+        d.step()
+        st = d.np_state()
+        if st["role"][g, lead] != LEADER:
+            demoted_at = i + 1
+            break
+    assert demoted_at is not None, "severed leader never stepped down"
+    assert demoted_at <= d.cfg.ELECT_MAX + 5
+    # The two connected replicas still have quorum: new leader, new
+    # commits — while the old leader stays demoted.
+    assert d.run_until_quiet_leaders(400)
+    new = d.leader_of(g)
+    assert new != lead
+    before = int(d.np_state()["commit"].max(axis=1)[g])
+    for i in range(3):
+        d.start(g, f"post-{i}")
+    for _ in range(80):
+        d.step()
+    st = d.np_state()
+    assert int(st["commit"].max(axis=1)[g]) >= before + 3
+    assert st["role"][g, lead] != LEADER
+    d.check_log_matching(g)
+
+
+def test_checkquorum_demotion_keeps_vote_and_term():
+    """Check-quorum demotion happens at the leader's OWN term: the
+    term must not bump and ``voted_for`` must survive — clearing it
+    would let this replica grant a second same-term vote and elect two
+    leaders at one term."""
+    d = make(G=1, P=3, seed=7, prevote=True, check_quorum=True)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    st = d.np_state()
+    term0 = int(st["term"][0, lead])
+    vote0 = int(st["voted_for"][0, lead])
+    _sever_leader(d, 0, lead)
+    for _ in range(d.cfg.ELECT_MAX + 5):
+        d.step()
+        st = d.np_state()
+        if st["role"][0, lead] != LEADER:
+            break
+    assert st["role"][0, lead] != LEADER
+    # Severed from everyone, the demoted replica can observe no higher
+    # term: its own demotion left term and vote exactly in place.
+    assert int(st["term"][0, lead]) == term0
+    assert int(st["voted_for"][0, lead]) == vote0
+
+
+def test_legacy_arm_severed_leader_stays_wedged():
+    """The A/B contrast: without check-quorum a quorum-severed leader
+    keeps the crown indefinitely — the wedge the watchdog exists to
+    report (distributed/wedge.py)."""
+    d = make(G=1, P=3, seed=9, prevote=False, check_quorum=False)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    _sever_leader(d, 0, lead)
+    for _ in range(4 * d.cfg.ELECT_MAX):
+        d.step()
+    assert d.np_state()["role"][0, lead] == LEADER
+
+
+def test_prevote_rejoin_bounds_term_inflation():
+    """A replica partitioned for several election windows rejoins: with
+    PreVote its probe rounds never bump its real term, so the fleet max
+    term rises by at most one; the legacy arm inflates it every window
+    it spends alone."""
+    away = 6  # election windows spent partitioned
+
+    def run(prevote: bool, check_quorum: bool) -> int:
+        d = make(G=1, P=3, seed=11,
+                 prevote=prevote, check_quorum=check_quorum)
+        assert d.run_until_quiet_leaders(400)
+        lead = d.leader_of(0)
+        follower = (lead + 1) % d.cfg.P
+        for p in range(d.cfg.P):
+            if p != follower:
+                d.set_edge(0, follower, p, False)
+                d.set_edge(0, p, follower, False)
+        term_before = int(d.np_state()["term"].max())
+        for _ in range(away * d.cfg.ELECT_MAX):
+            d.step()
+        for p in range(d.cfg.P):
+            if p != follower:
+                d.set_edge(0, follower, p, True)
+                d.set_edge(0, p, follower, True)
+        assert d.run_until_quiet_leaders(600)
+        d.start(0, "post-heal")
+        for _ in range(80):
+            d.step()
+        st = d.np_state()
+        assert int(st["commit"].max()) >= 1
+        d.check_log_matching(0)
+        return int(st["term"].max()) - term_before
+
+    assert run(prevote=True, check_quorum=True) <= 1
+    # Legacy: the lone candidate inflated its term once per window and
+    # the heal forces the whole group up to it.
+    assert run(prevote=False, check_quorum=False) > 1
+
+
+def test_checkquorum_single_replica_group_never_demotes():
+    """P=1 edge case: a singleton leader IS its own quorum — the
+    (P - quorum)-th smallest ack is its own tick and the stepdown
+    predicate can never fire."""
+    d = make(G=2, P=1, seed=13, prevote=True, check_quorum=True)
+    assert d.run_until_quiet_leaders(200)
+    lead = d.leader_of(0)
+    for _ in range(3 * d.cfg.ELECT_MAX):
+        d.step()
+    st = d.np_state()
+    assert st["role"][0, lead] == LEADER
+    d.start(0, "solo")
+    for _ in range(40):
+        d.step()
+    assert int(d.np_state()["commit"].max(axis=1)[0]) >= 1
+
+
+def test_checkquorum_survives_checkpoint_roundtrip(tmp_path):
+    """The new ``last_ack`` plane rides the generic checkpoint path:
+    save/restore round-trips it and a restored cluster still demotes a
+    severed leader."""
+    d = make(G=1, P=3, seed=15, prevote=True, check_quorum=True)
+    assert d.run_until_quiet_leaders(400)
+    path = str(tmp_path / "cq.ckpt")
+    d.save(path)
+    r = EngineDriver.restore(path)
+    assert np.array_equal(
+        np.asarray(r.state.last_ack), np.asarray(d.state.last_ack)
+    )
+    lead = r.leader_of(0)
+    _sever_leader(r, 0, lead)
+    for _ in range(r.cfg.ELECT_MAX + 5):
+        r.step()
+        if r.np_state()["role"][0, lead] != LEADER:
+            break
+    assert r.np_state()["role"][0, lead] != LEADER

@@ -1,0 +1,227 @@
+"""Wedge-detection tests: the per-group no-progress watchdog
+(distributed/wedge.py), its ``gauge.wedged_groups`` surface in
+ObsControl.gauges, and the postmortem doctor's "wedged leadership"
+anomaly that names the stalled group, its stuck leader, and the fault
+window that caused the wedge."""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+
+from multiraft_tpu.analysis.postmortem import analyze, build_report
+from multiraft_tpu.distributed import flightrec
+from multiraft_tpu.distributed.observe import ObsControl
+from multiraft_tpu.distributed.wedge import WedgeWatch, install_wedge_watch
+from multiraft_tpu.utils.metrics import Metrics
+
+
+class _Rec:
+    """Record-collecting stand-in for the flight recorder."""
+
+    def __init__(self):
+        self.records = []
+
+    def record(self, etype, code=0, a=0, b=0, c=0, tag=""):
+        self.records.append(
+            {"type": etype, "code": code, "a": a, "b": b, "c": c,
+             "tag": tag}
+        )
+
+
+class _Ctl:
+    """ObsControl stand-in: scriptable per-group commit/leader/term
+    plus a driver backlog."""
+
+    def __init__(self, commit, backlog, leader=None, term=None):
+        self.commit = list(commit)
+        self.backlog = np.asarray(backlog, np.int64)
+        self.leader = leader or [0] * len(self.commit)
+        self.term = term or [1] * len(self.commit)
+
+    def groups(self):
+        return {
+            "G": len(self.commit),
+            "commit": list(self.commit),
+            "leader": list(self.leader),
+            "term": list(self.term),
+        }
+
+    def _engine_kv(self):
+        return types.SimpleNamespace(
+            driver=types.SimpleNamespace(backlog=self.backlog)
+        )
+
+
+def _node(rec=None):
+    return types.SimpleNamespace(
+        sched=types.SimpleNamespace(call_after=lambda *_a, **_k: None),
+        obs=types.SimpleNamespace(metrics=Metrics()),
+        _frec=rec,
+        _closed=False,
+    )
+
+
+def _watch(node, ctl, stall_ticks=3):
+    w = WedgeWatch(node, interval=999.0, stall_ticks=stall_ticks)
+    w._ctl = ctl
+    return w
+
+
+def test_wedge_declared_after_stall_ticks_and_recorded():
+    """commit frozen + backlog pending for ``stall_ticks`` scrapes →
+    the group is wedged: WEDGE record with (group, stall, commit,
+    backlog) and the "p<peer>@t<term>" leader tag, gauge set, one trip
+    counted."""
+    rec = _Rec()
+    node = _node(rec)
+    ctl = _Ctl(commit=[7, 3], backlog=[5, 0], leader=[2, 0], term=[9, 1])
+    w = _watch(node, ctl, stall_ticks=3)
+    assert w.check() == 0  # first scrape only establishes the baseline
+    assert w.check() == 0
+    assert w.check() == 0
+    assert w.check() == 1  # 3 consecutive stalled scrapes after baseline
+    assert w.wedged == {0}
+    assert node.obs.metrics.counters["wedge.trips"] == 1
+    assert node.obs.metrics.gauges["wedge.active"] == 1.0
+    assert len(rec.records) == 1
+    r = rec.records[0]
+    assert r["type"] == flightrec.WEDGE
+    assert r["code"] == 0 and r["a"] == 3 and r["b"] == 7 and r["c"] == 5
+    assert r["tag"] == "p2@t9"
+    # Still wedged: re-recorded each scrape, but only ONE trip.
+    w.check()
+    assert len(rec.records) == 2 and rec.records[1]["a"] == 4
+    assert node.obs.metrics.counters["wedge.trips"] == 1
+
+
+def test_wedge_clears_on_commit_advance_or_drained_backlog():
+    rec = _Rec()
+    node = _node(rec)
+    ctl = _Ctl(commit=[7], backlog=[5])
+    w = _watch(node, ctl, stall_ticks=2)
+    for _ in range(3):
+        w.check()
+    assert w.wedged == {0}
+    # One commit advance: the wedge clears and the gauge falls.
+    ctl.commit[0] += 1
+    assert w.check() == 0
+    assert w.wedged == set()
+    assert node.obs.metrics.gauges["wedge.active"] == 0.0
+    # Re-stall, then drain the backlog instead: idle is not wedged.
+    for _ in range(3):
+        w.check()
+    assert w.wedged == {0}
+    ctl.backlog[0] = 0
+    assert w.check() == 0 and w.wedged == set()
+
+
+def test_wedge_needs_pending_proposals():
+    """An idle group with a frozen frontier is NOT a wedge — nothing
+    is owed, so nothing is stalled."""
+    node = _node()
+    w = _watch(node, _Ctl(commit=[4], backlog=[0]), stall_ticks=2)
+    for _ in range(10):
+        assert w.check() == 0
+    assert w.wedged == set()
+
+
+def test_wedge_gauge_in_obs_gauges():
+    node = _node()
+    node.wedge_watch = types.SimpleNamespace(wedged={1, 3})
+    out = ObsControl(node).gauges()
+    assert out["gauge.wedged_groups"] == 2.0
+
+
+def test_install_wedge_watch_env_gate(monkeypatch):
+    monkeypatch.setenv("MRT_WEDGE_WATCH", "0")
+    assert install_wedge_watch(_node()) is None
+    monkeypatch.delenv("MRT_WEDGE_WATCH")
+    node = _node()
+    w = install_wedge_watch(node)
+    assert w is not None and node.wedge_watch is w
+    w.stop()
+
+
+# ---------------------------------------------------------------------------
+# Postmortem: the "wedged leadership" anomaly
+# ---------------------------------------------------------------------------
+
+
+def _wedge_rec(seq, ts, group=0, stall=3, commit=7, backlog=5,
+               tag="p2@t9"):
+    return {
+        "seq": seq, "ts": ts, "type": flightrec.WEDGE,
+        "type_name": "wedge", "code": group, "a": stall, "b": commit,
+        "c": backlog, "tag": tag,
+    }
+
+
+def _bundle(records, windows):
+    ring = {
+        "pid": 123, "name": "srv", "wall_t0": 0.0, "slots": 64,
+        "records": records, "torn": 0, "clean_close": True,
+        "path": "srv.ring",
+    }
+    return {
+        "dir": ".",
+        "manifest": {
+            "idents": {"h:1": {"pid": 123}},
+            "offsets_us": {"h:1": 0.0},
+        },
+        "snapshots": {}, "windows": windows, "rings": [ring],
+        "skipped": [],
+    }
+
+
+def test_postmortem_names_wedged_leadership_and_cause():
+    """One anomaly per wedged group, anchored on the onset, naming the
+    group, the stuck leader, and the covering nemesis fault window."""
+    windows = [
+        {"kind": "slow_link", "p": {"proc": 1}, "procs": [1],
+         "t_start_us": 100.0, "t_stop_us": 500.0},
+        {"kind": "partial_partition", "p": {"proc": 0}, "procs": [0],
+         "t_start_us": 900.0, "t_stop_us": 2600.0},
+    ]
+    recs = [
+        _wedge_rec(1, 1000.0, stall=3),
+        _wedge_rec(2, 1500.0, stall=5),
+        _wedge_rec(3, 2500.0, stall=8, commit=7, backlog=11),
+    ]
+    bundle = _bundle(recs, windows)
+    analysis = analyze(bundle)
+    wedges = [a for a in analysis["anomalies"]
+              if a["kind"] == "wedged_leadership"]
+    assert len(wedges) == 1
+    a = wedges[0]
+    assert a["ts"] == 1000.0 and a["aligned"]
+    assert "group 0" in a["detail"]
+    assert "p2@t9" in a["detail"]
+    assert "partial_partition" in a["detail"]  # the covering window
+    assert "slow_link" not in a["detail"]
+    # It is also the FIRST anomaly of this clean-closing ring.
+    assert analysis["first_anomaly"]["kind"] == "wedged_leadership"
+    report = build_report(bundle, analysis)
+    assert "wedged leadership" in report
+    assert "wedged: group 0 leader p2@t9" in report
+
+
+def test_postmortem_wedge_without_windows_still_reports():
+    bundle = _bundle([_wedge_rec(1, 1000.0)], windows=[])
+    analysis = analyze(bundle)
+    wedges = [a for a in analysis["anomalies"]
+              if a["kind"] == "wedged_leadership"]
+    assert len(wedges) == 1
+    assert "fault window" not in wedges[0]["detail"]
+    # Two wedged groups → two anomalies, each naming its own group.
+    bundle = _bundle(
+        [_wedge_rec(1, 1000.0, group=0),
+         _wedge_rec(2, 1100.0, group=3, tag="p0@t4")],
+        windows=[],
+    )
+    kinds = [a for a in analyze(bundle)["anomalies"]
+             if a["kind"] == "wedged_leadership"]
+    assert len(kinds) == 2
+    assert "group 3" in kinds[1]["detail"]
+    assert "p0@t4" in kinds[1]["detail"]
