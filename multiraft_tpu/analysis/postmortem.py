@@ -67,6 +67,18 @@ def _ship_window_us() -> float:
         return 5e6
 
 
+# Degraded-quorum bound for membership changes (placement.py healer):
+# a reconfig open longer than the replace deadline means the group ran
+# on a reduced quorum past the budget the operator set.  Same env knob
+# the controller uses, so doctor and healer agree.
+def _replace_deadline_us() -> float:
+    raw = os.environ.get("MRT_PLACE_REPLACE_DEADLINE_S")
+    try:
+        return float(raw) * 1e6 if raw is not None else 30e6
+    except ValueError:
+        return 30e6
+
+
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
@@ -498,6 +510,69 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                 "kind": "wedged_leadership", "detail": detail,
                 "aligned": off is not None,
             })
+        # Degraded quorum: CONFIG records (placement.py healer) grouped
+        # by group.  A replace-replica reconfig runs the group on a
+        # reduced quorum from the voter's death until "done"; flag any
+        # reconfig still OPEN at the ring's end (begun, never done or
+        # aborted — on an unclean controller death that's a heal the
+        # successor must resume) and any that ran past the replace
+        # deadline even when it eventually finished.
+        cfg_by_g: Dict[int, List[Record]] = {}
+        for r in recs:
+            if r["type"] == flightrec.CONFIG:
+                cfg_by_g.setdefault(r["code"], []).append(r)
+        if cfg_by_g:
+            info["reconfigs"] = {
+                g: {
+                    "records": len(rs),
+                    "last_phase": rs[-1]["tag"],
+                    "dead_peer": rs[0]["a"],
+                    "new_peer": rs[0]["b"],
+                }
+                for g, rs in sorted(cfg_by_g.items())
+            }
+        deadline_us = _replace_deadline_us()
+        for g, rs in sorted(cfg_by_g.items()):
+            first, last = rs[0], rs[-1]
+            onset = aligned(first["ts"])
+            span_us = last["ts"] - first["ts"]
+            open_end = last["tag"] not in ("done", "abort")
+            overran = span_us > deadline_us
+            if not open_end and not overran:
+                continue
+            if open_end:
+                what = (
+                    f"reconfig still open at ring end (last phase "
+                    f"'{last['tag']}' after {span_us / 1e6:.1f}s"
+                    + ("" if ring["clean_close"]
+                       else "; controller died mid-reconfig — successor "
+                            "must resume the replicated intent")
+                    + ")"
+                )
+            else:
+                what = (
+                    f"reconfig took {span_us / 1e6:.1f}s > deadline "
+                    f"{deadline_us / 1e6:.0f}s before '{last['tag']}'"
+                )
+            detail = (
+                f"degraded quorum: group {g} lost voter "
+                f"{first['a']} (replacement peer {first['b']}, epoch "
+                f"{first['c']}); {what}; {len(rs)} config record(s)"
+            )
+            win = (
+                _covering_window(bundle.get("windows") or [], onset)
+                if off is not None else None
+            )
+            if win is not None:
+                detail += (
+                    f"; during fault window '{win['kind']}' on "
+                    f"proc(s) {win.get('procs')}"
+                )
+            anomalies.append({
+                "ts": onset, "proc": label,
+                "kind": "degraded_quorum", "detail": detail,
+                "aligned": off is not None,
+            })
         torn = ring["torn"]
         if torn > 1:
             # One torn slot is the expected SIGKILL signature; more
@@ -611,6 +686,12 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                     f"wedge:g{r['code']}", ts, track="wedge",
                     pid=pid, group=r["code"], stall=r["a"],
                     commit=r["b"], backlog=r["c"], leader=r["tag"],
+                )
+            elif t == flightrec.CONFIG:
+                out.instant(
+                    f"config:g{r['code']}", ts, track="config",
+                    pid=pid, group=r["code"], dead_peer=r["a"],
+                    new_peer=r["b"], epoch=r["c"], phase=r["tag"],
                 )
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
@@ -733,6 +814,12 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
                 f"    wedged: group {g} leader {w['leader']}, "
                 f"{w['records']} record(s), peak stall "
                 f"{w['peak_stall']} scrape(s)"
+            )
+        for g, c in (p.get("reconfigs") or {}).items():
+            add(
+                f"    reconfig: group {g} voter {c['dead_peer']} → "
+                f"peer {c['new_peer']}, last phase '{c['last_phase']}' "
+                f"({c['records']} record(s))"
             )
 
     if analysis["lag"]:

@@ -236,6 +236,8 @@ def _server_main() -> None:  # pragma: no cover - subprocess entry
             checkpoint_every_s=spec.get("checkpoint_every_s", 30.0),
             mesh_devices=spec.get("mesh_devices", 0),
             spare_slots=spec.get("spare_slots", 0),
+            replicas=spec.get("replicas", 3),
+            voters=spec.get("voters"),
             # State plane (distributed/stateplane.py): the full fleet
             # roster + own index turn snapshot/tail shipping on.
             fleet_addrs=(

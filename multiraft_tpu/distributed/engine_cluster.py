@@ -322,6 +322,8 @@ class EngineFleetCluster:
         mesh_devices: int = 0,
         chaos_seed: Optional[int] = None,
         spare_slots: int = 0,
+        replicas: int = 3,
+        voters: Optional[Sequence[int]] = None,
         shipping: bool = False,
         ship_sync: Optional[bool] = None,
         ship_window_s: Optional[float] = None,
@@ -354,6 +356,14 @@ class EngineFleetCluster:
                 # Idle engine groups the placement controller adopts
                 # migrated gids into (harness/fleet.py).
                 spec["spare_slots"] = int(spare_slots)
+            if replicas != 3 or voters is not None:
+                # Spare engine REPLICA slots (self-healing replica
+                # sets): P=replicas rows per group, only ``voters``
+                # vote; the controller replaces a permanently dead
+                # voter by seating a learner in a spare row.
+                spec["replicas"] = int(replicas)
+                if voters is not None:
+                    spec["voters"] = [int(q) for q in voters]
             if data_dir is not None:
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
                 spec["checkpoint_every_s"] = checkpoint_every_s

@@ -543,6 +543,67 @@ class EngineShardKVService:
         ver, pmap = self._placement
         return (ver, {g: tuple(a) for g, a in pmap.items()})
 
+    # -- membership-change RPCs (self-healing replica sets) ---------------
+    #
+    # The placement controller's replace-dead-replica policy drives
+    # these: add_learner seats a fresh non-voting incarnation in a
+    # spare engine slot, learner_match gauges its catch-up, begin_joint
+    # appends the C_old,new entry at the leader (the engine auto-exits
+    # to C_new once it commits under BOTH quorums).  All handlers are
+    # idempotent — BatchedShardKV's *_gid wrappers answer True when
+    # the engine is already at or past the requested state — so the
+    # controller can replay any leg after a crash or lost reply.
+
+    def replica_config(self, args):
+        """``(OK, cfg)`` — the leader's config view for ``gid``
+        (voter sets, joint flag, epoch), ``cfg=None`` when leaderless
+        or the gid is not hosted here."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid = args[0] if isinstance(args, (tuple, list)) else args
+        return (SK_OK, self.skv.config_of_gid(gid))
+
+    def add_learner(self, args):
+        """Seat engine slot ``peer`` as a non-voting learner of
+        ``gid``; ``(OK, bool)``."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid, peer = args[0], args[1]
+        ok = self.skv.add_learner_gid(gid, int(peer))
+        if ok:
+            self.m.inc("reconfig.learners_seated")
+        return (SK_OK, bool(ok))
+
+    def learner_match(self, args):
+        """``(OK, (leader's match for peer, leader's last index))`` —
+        the catch-up gauge; ``(OK, None)`` when leaderless."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid, peer = args[0], args[1]
+        return (SK_OK, self.skv.learner_match_gid(gid, int(peer)))
+
+    def begin_joint(self, args):
+        """Append the C_old,new entry making ``voters`` the target
+        config of ``gid``; ``(OK, bool)``."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid, voters = args[0], args[1]
+        ok = self.skv.begin_joint_gid(gid, [int(q) for q in voters])
+        if ok:
+            self.m.inc("reconfig.joints_entered")
+        return (SK_OK, bool(ok))
+
+    def kill_replica(self, args):
+        """Chaos verb: permanently mark engine replica ``(gid, peer)``
+        dead (nemesis / acceptance harnesses only); ``(OK, bool)``."""
+        from ..engine.shardkv import OK as SK_OK
+
+        gid, peer = args[0], args[1]
+        ok = self.skv.kill_replica_gid(gid, int(peer))
+        if ok:
+            self.m.inc("reconfig.replicas_killed")
+        return (SK_OK, bool(ok))
+
     def _rebuild_peers(self) -> None:
         """Re-derive the gid→end peer map from the placement view,
         skipping locally hosted gids.  Ends are cached per address."""
@@ -943,6 +1004,8 @@ def serve_engine_shardkv(
     checkpoint_every_s: float = 30.0,
     mesh_devices: int = 0,
     spare_slots: int = 0,
+    replicas: int = 3,
+    voters: Optional[Sequence[int]] = None,
     fleet_addrs: Optional[dict] = None,  # proc -> (host, port), all procs
     me: Optional[int] = None,  # this process's index in fleet_addrs
     ship_rules=None,
@@ -998,8 +1061,18 @@ def serve_engine_shardkv(
         if restored:
             node.obs.metrics.inc("engine.restores")
         if not restored:
-            cfg = EngineConfig(G=G_local, P=3, L=64, E=8, INGEST=8)
+            cfg = EngineConfig(
+                G=G_local, P=max(3, int(replicas)), L=64, E=8, INGEST=8
+            )
             driver = EngineDriver(cfg, seed=seed, mesh=mesh)
+            if voters is not None and len(set(voters)) < cfg.P:
+                # Spare ENGINE REPLICA slots: only ``voters`` vote; the
+                # remaining rows park dead until the placement
+                # controller's replace-dead-replica policy seats a
+                # learner in one (self-healing replica sets).  A
+                # RESTORED process skips this — its config (voter
+                # masks included) comes from the checkpoint.
+                driver.seed_config(voters)
             # Warm-up before readiness (see serve_engine_kv):
             # elections + both tick compiles happen here, not under
             # client traffic.

@@ -102,6 +102,9 @@ SHIP = 16        # code=gid a=n_records b=n_bytes c=acked_frontier
 #                  tag="snap"|"tail" (stateplane.py shipments)
 WEDGE = 17       # code=group a=stall_ticks b=commit_index c=backlog
 #                  tag=leader ("p<peer>@t<term>"; wedge.py watchdog)
+CONFIG = 18      # code=gid a=dead_peer b=new_peer c=config_epoch
+#                  tag=phase ("learner"|"catchup"|"joint"|"done"|
+#                  "abort"; placement.py replace-dead-replica legs)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -121,6 +124,7 @@ _TYPE_NAMES = {
     PLACE: "place",
     SHIP: "ship",
     WEDGE: "wedge",
+    CONFIG: "config",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.

@@ -325,7 +325,7 @@ class ObsControl:
             [l2g.get(g, -1) for g in range(G)]
             if l2g is not None else list(range(G))
         )
-        return {
+        out = {
             "G": G,
             "gids": gids,
             "leader": leader.tolist(),
@@ -336,6 +336,40 @@ class ObsControl:
             "log_len": np.asarray(state.log_len).max(axis=1).tolist(),
             "snap_index": np.asarray(state.base).max(axis=1).tolist(),
         }
+        # Replica-membership health (engine/host.py joint consensus):
+        # per-replica liveness, the voter set (leader's view; row with
+        # the widest view when leaderless), joint flag, and whether a
+        # reconfig is in flight — the placement controller's dead-voter
+        # signal and the wedge watchdog's exemption column.  Guarded:
+        # states restored from pre-membership checkpoints lack the
+        # fields until their first tick.
+        vo = getattr(state, "voters_old", None)
+        if vo is not None:
+            vo = np.asarray(vo)
+            vn = np.asarray(state.voters_new)
+            joint = np.asarray(state.joint)
+            cfg_idx = np.asarray(state.cfg_idx)
+            P = int(vo.shape[1])
+            union = vo | vn
+            row = np.where(
+                lead.any(axis=1), lead.argmax(axis=1), union.argmax(axis=1)
+            )
+            bits = union[np.arange(G), row]
+            out["replica_alive"] = alive.tolist()
+            out["voters"] = [
+                [q for q in range(P) if (int(b) >> q) & 1] for b in bits
+            ]
+            out["joint"] = joint.any(axis=1).tolist()
+            out["reconfig"] = (
+                joint.any(axis=1)
+                | (cfg_idx.max(axis=1) > np.asarray(commit))
+            ).tolist()
+        is_sealed = getattr(kv, "is_sealed", None)
+        if is_sealed is not None and l2g is not None:
+            out["sealed"] = [
+                bool(g in l2g and is_sealed(l2g[g])) for g in range(G)
+            ]
+        return out
 
     def trace(self, args: Any = None) -> Dict[str, Any]:
         obs = self._node.obs

@@ -91,6 +91,15 @@ BEGIN = "Begin"
 DISPATCH = "Dispatch"
 COMMIT = "Commit"
 ABORT = "Abort"
+# Replica-replacement (reconfig) intents — same two-phase discipline as
+# migrations, but phased: a controller that dies between legs is
+# resumed by its successor AT the recorded phase, which is what keeps
+# add-learner / begin-joint retries idempotent instead of forking the
+# group's membership.
+RBEGIN = "RcfgBegin"
+RPHASE = "RcfgPhase"
+RDONE = "RcfgDone"
+RABORT = "RcfgAbort"
 
 # Bounded decision history kept in the replicated state (enough for
 # the doctor's thrash window without growing the snapshot unboundedly).
@@ -112,6 +121,8 @@ def place_knobs() -> Dict[str, float]:
         "cooldown_s": _env_f("MRT_PLACE_COOLDOWN_S", 5.0),
         "min_gain": _env_f("MRT_PLACE_MIN_GAIN", 0.25),
         "max_moves": int(_env_f("MRT_PLACE_MAX_MOVES", 1)),
+        "replace": _env_f("MRT_PLACE_REPLACE", 1.0) != 0.0,
+        "replace_deadline_s": _env_f("MRT_PLACE_REPLACE_DEADLINE_S", 30.0),
     }
 
 
@@ -130,6 +141,11 @@ class PlaceArgs:
     gid: int = 0
     dst: int = 0
     reason: str = ""
+    # Reconfig-intent fields (RBEGIN/RPHASE): the dead voter being
+    # replaced, the spare slot replacing it, and the recorded phase.
+    peer: int = -1
+    new_peer: int = -1
+    phase: str = ""
     client_id: int = 0
     command_id: int = 0
 
@@ -149,6 +165,11 @@ class PlaceReply:
     # Recent committed moves: (version, gid, src, dst, reason).
     history: List[Tuple[int, int, int, int, str]] = dataclasses.field(
         default_factory=list
+    )
+    # gid → (dead_peer, new_peer, phase): in-flight replica-replacement
+    # reconfigs, resumable by a successor controller at the phase.
+    reconfigs: Dict[int, Tuple[int, int, str]] = dataclasses.field(
+        default_factory=dict
     )
 
 
@@ -193,6 +214,7 @@ class PlacementCtrler:
         self.version = 0
         self.placement: Dict[int, int] = {}
         self.pending: Dict[int, Tuple[int, str]] = {}
+        self.reconfigs: Dict[int, Tuple[int, int, str]] = {}
         self.history: List[Tuple[int, int, int, int, str]] = []
         self.latest: Dict[int, int] = {}
         self._waiters: Dict[tuple, Future] = {}
@@ -233,6 +255,7 @@ class PlacementCtrler:
             placement=dict(self.placement),
             pending=dict(self.pending),
             history=list(self.history),
+            reconfigs=dict(self.reconfigs),
         )
 
     # -- apply ----------------------------------------------------------
@@ -274,6 +297,28 @@ class PlacementCtrler:
                     del self.history[:-HISTORY_CAP]
             elif args.op == ABORT:
                 self.pending.pop(args.gid, None)
+            elif args.op == RBEGIN:
+                self.reconfigs[args.gid] = (
+                    int(args.peer), int(args.new_peer), "learner"
+                )
+            elif args.op == RPHASE:
+                intent = self.reconfigs.get(args.gid)
+                if intent is not None:
+                    self.reconfigs[args.gid] = (
+                        intent[0], intent[1], args.phase
+                    )
+            elif args.op == RDONE:
+                intent = self.reconfigs.pop(args.gid, None)
+                if intent is not None:
+                    # No version bump — the gid→proc map is unchanged;
+                    # the decision still lands in the bounded history.
+                    self.history.append((
+                        self.version, args.gid, intent[0], intent[1],
+                        "replace-replica",
+                    ))
+                    del self.history[:-HISTORY_CAP]
+            elif args.op == RABORT:
+                self.reconfigs.pop(args.gid, None)
         if not is_dup:
             self.latest[args.client_id] = args.command_id
         waiter = self._waiters.get(
@@ -297,6 +342,7 @@ class PlacementCtrler:
                 "version": self.version,
                 "placement": dict(self.placement),
                 "pending": dict(self.pending),
+                "reconfigs": dict(self.reconfigs),
                 "history": list(self.history),
                 "latest": dict(self.latest),
             })
@@ -314,6 +360,11 @@ class PlacementCtrler:
             int(g): (v[0], v[1],
                      bool(v[2]) if len(v) > 2 else False)
             for g, v in blob["pending"].items()
+        }
+        # Snapshots from before replica replacement hold no reconfigs.
+        self.reconfigs = {
+            int(g): (int(v[0]), int(v[1]), str(v[2]))
+            for g, v in (blob.get("reconfigs") or {}).items()
         }
         self.history = list(blob["history"])
         self.latest = dict(blob["latest"])
@@ -381,6 +432,22 @@ class PlacementClerk:
     def abort(self, gid: int):
         return (yield from self._command(PlaceArgs(op=ABORT, gid=gid)))
 
+    def rbegin(self, gid: int, dead_peer: int, new_peer: int):
+        return (yield from self._command(PlaceArgs(
+            op=RBEGIN, gid=gid, peer=dead_peer, new_peer=new_peer,
+        )))
+
+    def rphase(self, gid: int, phase: str):
+        return (yield from self._command(
+            PlaceArgs(op=RPHASE, gid=gid, phase=phase)
+        ))
+
+    def rdone(self, gid: int):
+        return (yield from self._command(PlaceArgs(op=RDONE, gid=gid)))
+
+    def rabort(self, gid: int):
+        return (yield from self._command(PlaceArgs(op=RABORT, gid=gid)))
+
 
 class LocalPlacementStore:
     """Dict-backed stand-in for the replicated map — unit tests of the
@@ -391,6 +458,7 @@ class LocalPlacementStore:
         self.version = 1 if placement else 0
         self.placement = dict(placement or {})
         self.pending: Dict[int, Tuple[int, str, bool]] = {}
+        self.reconfigs: Dict[int, Tuple[int, int, str]] = {}
         self.history: List[Tuple[int, int, int, int, str]] = []
 
     def query(self):
@@ -424,6 +492,31 @@ class LocalPlacementStore:
 
     def abort(self, gid: int) -> None:
         self.pending.pop(gid, None)
+
+    # -- reconfig intents -----------------------------------------------
+
+    def reconfig_intents(self) -> Dict[int, Tuple[int, int, str]]:
+        return dict(self.reconfigs)
+
+    def rbegin(self, gid: int, dead_peer: int, new_peer: int) -> None:
+        self.reconfigs[gid] = (int(dead_peer), int(new_peer), "learner")
+
+    def rphase(self, gid: int, phase: str) -> None:
+        intent = self.reconfigs.get(gid)
+        if intent is not None:
+            self.reconfigs[gid] = (intent[0], intent[1], phase)
+
+    def rdone(self, gid: int) -> None:
+        intent = self.reconfigs.pop(gid, None)
+        if intent is not None:
+            self.history.append((
+                self.version, gid, intent[0], intent[1],
+                "replace-replica",
+            ))
+            del self.history[:-HISTORY_CAP]
+
+    def rabort(self, gid: int) -> None:
+        self.reconfigs.pop(gid, None)
 
 
 # ---------------------------------------------------------------------------
@@ -616,6 +709,48 @@ class TcpFleetTransport:
         )
         return isinstance(r, tuple) and bool(r) and r[0] == OK
 
+    # -- membership-change verbs (self-healing replica sets) ------------
+
+    def replica_config(self, proc: int, gid: int) -> Optional[Dict]:
+        """Leader's config view for ``gid`` (voter sets, joint flag,
+        epoch) or None when leaderless / RPC failure."""
+        r = self._call(
+            proc, "EngineShardKV.replica_config", (gid,), self.SCRAPE_S
+        )
+        if isinstance(r, tuple) and len(r) >= 2 and r[0] == OK:
+            return r[1]
+        return None
+
+    def add_learner(self, proc: int, gid: int, peer: int) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.add_learner", (gid, peer), self.PUSH_S
+        )
+        return (isinstance(r, tuple) and len(r) >= 2 and r[0] == OK
+                and bool(r[1]))
+
+    def learner_match(self, proc: int, gid: int,
+                      peer: int) -> Optional[Tuple[int, int]]:
+        r = self._call(
+            proc, "EngineShardKV.learner_match", (gid, peer), self.SCRAPE_S
+        )
+        if isinstance(r, tuple) and len(r) >= 2 and r[0] == OK:
+            return r[1]
+        return None
+
+    def begin_joint(self, proc: int, gid: int, voters) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.begin_joint", (gid, list(voters)),
+            self.PUSH_S,
+        )
+        return (isinstance(r, tuple) and len(r) >= 2 and r[0] == OK
+                and bool(r[1]))
+
+    def kill_replica(self, proc: int, gid: int, peer: int) -> bool:
+        r = self._call(
+            proc, "EngineShardKV.kill_replica", (gid, peer), self.PUSH_S
+        )
+        return isinstance(r, tuple) and bool(r) and r[0] == OK
+
 
 class PlacementController:
     """The scrape → plan → migrate loop (module docstring).  ``store``
@@ -634,6 +769,8 @@ class PlacementController:
         cooldown_s: Optional[float] = None,
         min_gain: Optional[float] = None,
         max_moves: Optional[int] = None,
+        replace: Optional[bool] = None,
+        replace_deadline_s: Optional[float] = None,
         obs=None,
         recorder=None,
         clock=time.monotonic,
@@ -650,6 +787,13 @@ class PlacementController:
         self.max_moves = (
             k["max_moves"] if max_moves is None else int(max_moves)
         )
+        self.replace = (
+            bool(k["replace"]) if replace is None else bool(replace)
+        )
+        self.replace_deadline_s = (
+            k["replace_deadline_s"] if replace_deadline_s is None
+            else float(replace_deadline_s)
+        )
         self._clock = clock
         self._obs = obs
         if recorder is None:
@@ -662,6 +806,16 @@ class PlacementController:
         self.last_moved: Dict[int, float] = {}
         self.loads: Dict[int, float] = {}
         self.dead: set = set()
+        # gid -> latest per-replica view scraped from Obs.groups
+        # (proc, alive[], voters[], joint, sealed)
+        self._replica_view: Dict[int, Dict[str, Any]] = {}
+        # (gid, peer) -> clock when the replica was first seen dead
+        self._replica_dead_since: Dict[Tuple[int, int], float] = {}
+        # gid -> clock when the heal intent was begun (this controller;
+        # a crash-resume successor drives the intent but skips stats)
+        self._reconfig_t0: Dict[int, float] = {}
+        # gid -> timing of the last COMPLETED replacement (benches)
+        self.replace_stats: Dict[int, Dict[str, float]] = {}
         self.rounds = 0
         self.moves_done = 0
         self._pushed_version = -1
@@ -726,9 +880,34 @@ class PlacementController:
             if not g or "gids" not in g:
                 continue
             rates = g.get("commit_rate") or [0.0] * g["G"]
+            r_alive = g.get("replica_alive") or []
+            voters = g.get("voters") or []
+            joint = g.get("joint") or []
+            sealed = g.get("sealed") or []
             for slot, gid in enumerate(g["gids"]):
-                if gid > 0:
-                    self.loads[gid] = float(rates[slot])
+                if gid <= 0:
+                    continue
+                self.loads[gid] = float(rates[slot])
+                if slot < len(r_alive) and slot < len(voters):
+                    self._replica_view[gid] = {
+                        "proc": p,
+                        "alive": list(r_alive[slot]),
+                        "voters": list(voters[slot]),
+                        "joint": bool(joint[slot])
+                        if slot < len(joint) else False,
+                        "sealed": bool(sealed[slot])
+                        if slot < len(sealed) else False,
+                    }
+        # Per-REPLICA death ledger (distinct from per-PROCESS self.dead:
+        # here the process serving the group is fine, one engine replica
+        # row inside it is permanently down).  First-seen-dead timestamps
+        # feed the replace-dead-replica policy (_heal_replicas).
+        for gid, view in self._replica_view.items():
+            for q, ok in enumerate(view["alive"]):
+                if ok:
+                    self._replica_dead_since.pop((gid, q), None)
+                else:
+                    self._replica_dead_since.setdefault((gid, q), now)
         self.dead |= {
             p for p in range(self.transport.n_procs)
             if now - self.last_pong[p] > self.dead_s
@@ -767,7 +946,13 @@ class PlacementController:
                 continue
             if self._execute(gid, src, dst, reason, alive):
                 executed += 1
+        # Self-healing replica sets: resume/begin joint-consensus
+        # replacements of dead engine replicas before planning any
+        # voluntary group moves — a group under reconfig must not also
+        # be migrated mid-joint.
+        executed += self._heal_replicas(alive)
         version, placement, pending, _ = self.store.query()
+        reconfigs = self._reconfig_intents()
         moves = plan_moves(
             placement,
             self.loads,
@@ -777,7 +962,7 @@ class PlacementController:
             last_moved=self.last_moved,
             now_s=now,
             max_moves=self.max_moves,
-            exclude=set(pending),
+            exclude=set(pending) | set(reconfigs),
         )
         for gid, src, dst, reason in moves:
             if src is None and reason == "failover":
@@ -808,6 +993,182 @@ class PlacementController:
                 states.append((p, None))
         order = pick_freshest(states)
         return order[0] if order else default
+
+    # -- replace-dead-replica policy (joint-consensus healing) ----------
+
+    def _reconfig_intents(self) -> Dict[int, Tuple[int, int, str]]:
+        fn = getattr(self.store, "reconfig_intents", None)
+        if fn is None:
+            return {}
+        try:
+            r = fn()
+        except Exception:
+            return {}
+        return r if isinstance(r, dict) else {}
+
+    def _config_record(self, gid: int, dead_p: int, new_p: int,
+                       epoch: int, phase: str) -> None:
+        if self._rec is not None:
+            from .flightrec import CONFIG
+
+            self._rec.record(
+                CONFIG, code=gid, a=dead_p, b=new_p, c=epoch, tag=phase,
+            )
+        if self._obs is not None:
+            metric = {
+                "learner": "reconfig.begun",
+                "joint": "reconfig.joint_entered",
+                "done": "reconfig.completed",
+                "abort": "reconfig.aborted",
+            }.get(phase)
+            if metric:
+                self._obs.metrics.inc(metric)
+
+    def _heal_replicas(self, alive: List[int]) -> int:
+        """Replace dead engine replicas via joint consensus.  Every
+        intent is a replicated two-phase record on the placement RSM
+        (``rbegin``/``rphase``/``rdone``), and every leg is idempotent,
+        so a controller crash mid-reconfig RESUMES at the recorded
+        phase — it never forks membership.  Returns completed
+        replacements this round."""
+        if not self.replace:
+            return 0
+        if getattr(self.transport, "add_learner", None) is None:
+            return 0  # transport predates membership verbs
+        rbegin = getattr(self.store, "rbegin", None)
+        if rbegin is None:
+            return 0  # store predates reconfig intents
+        now = self._clock()
+        alive_set = set(alive)
+        done = 0
+        # 1. Resume replicated intents (ours or a dead predecessor's).
+        for gid, intent in sorted(self._reconfig_intents().items()):
+            view = self._replica_view.get(gid)
+            if view is None or view["proc"] not in alive_set:
+                continue  # group unreachable this round: retry later
+            done += self._drive_reconfig(
+                gid, view, int(intent[0]), int(intent[1]), str(intent[2]),
+                now,
+            )
+        # 2. Begin new intents for voters dead past the grace period.
+        intents = self._reconfig_intents()
+        for (gid, q), t0 in sorted(self._replica_dead_since.items()):
+            if gid in intents or now - t0 < self.dead_s:
+                continue
+            view = self._replica_view.get(gid)
+            if view is None or view["proc"] not in alive_set:
+                continue
+            if view.get("sealed"):
+                continue  # mid-migration: heal after the move settles
+            if q not in view["voters"]:
+                # Dead NON-voter (parked spare / demoted casualty):
+                # nothing to heal — quorum does not depend on it.
+                continue
+            new_p = self._pick_spare(view, q)
+            if new_p is None:
+                if self._obs is not None:
+                    self._obs.metrics.inc("reconfig.no_spare")
+                continue
+            self.store.rbegin(gid, q, new_p)
+            self._reconfig_t0[gid] = t0
+            self._config_record(gid, q, new_p, 0, "learner")
+            done += self._drive_reconfig(
+                gid, view, q, new_p, "learner", now
+            )
+            intents = self._reconfig_intents()
+        return done
+
+    def _pick_spare(self, view: Dict[str, Any],
+                    dead_q: int) -> Optional[int]:
+        """Lowest engine slot that is neither a voter nor the dead
+        slot itself — the seat the new incarnation takes.  Voter sets
+        are static-slot subsets, so replacement is always a SWAP into a
+        spare row; no spare → no heal (reconfig.no_spare)."""
+        voters = set(view["voters"])
+        for q in range(len(view["alive"])):
+            if q != dead_q and q not in voters:
+                return q
+        return None
+
+    def _drive_reconfig(
+        self, gid: int, view: Dict[str, Any], dead_p: int, new_p: int,
+        phase: str, now: float,
+    ) -> int:
+        """Advance one replacement as far as this round allows:
+        learner → catchup → joint → done.  Every leg re-checks engine
+        state first, so re-running any prefix after a crash is a no-op
+        (add_learner_gid answers True for a live learner, begin_joint
+        for an already-entered or already-settled target)."""
+        tr = self.transport
+        store = self.store
+        if phase == "learner":
+            if not tr.add_learner(view["proc"], gid, new_p):
+                return 0  # leaderless or slot still a voter: retry
+            # The seated learner is a FRESH incarnation: any death
+            # timestamp recorded for the (previously parked) slot
+            # belongs to the old tenant, not this one.
+            self._replica_dead_since.pop((gid, new_p), None)
+            store.rphase(gid, "catchup")
+            self._config_record(gid, dead_p, new_p, 0, "catchup")
+            phase = "catchup"
+        if phase == "catchup":
+            # A learner that dies mid-catch-up can never close the gap:
+            # abort and let the next round pick a different spare.
+            t_dead = self._replica_dead_since.get((gid, new_p))
+            if t_dead is not None and now - t_dead >= self.dead_s:
+                store.rabort(gid)
+                self._config_record(gid, dead_p, new_p, 0, "abort")
+                self._reconfig_t0.pop(gid, None)
+                return 0
+            lm = tr.learner_match(view["proc"], gid, new_p)
+            if lm is None:
+                return 0
+            match, last = int(lm[0]), int(lm[1])
+            if match < last:
+                return 0  # still catching up: promote next round
+            cfg = tr.replica_config(view["proc"], gid)
+            if cfg is None:
+                return 0
+            target = sorted(
+                (set(cfg["voters_old"]) - {dead_p}) | {new_p}
+            )
+            if not tr.begin_joint(view["proc"], gid, target):
+                return 0
+            store.rphase(gid, "joint")
+            self._config_record(
+                gid, dead_p, new_p, int(cfg["epoch"]) + 1, "joint"
+            )
+            phase = "joint"
+        if phase == "joint":
+            cfg = tr.replica_config(view["proc"], gid)
+            if cfg is None:
+                return 0
+            if cfg["joint"]:
+                return 0  # both quorums still settling: engine exits
+            if dead_p in cfg["voters_old"]:
+                # Not joint AND the dead peer still votes: the leader
+                # died after the intent recorded "joint" but before the
+                # C_old,new entry replicated — the entry is LOST, not
+                # pending.  Re-issue it (begin_joint is idempotent
+                # against the already-settled target).
+                target = sorted(
+                    (set(cfg["voters_old"]) - {dead_p}) | {new_p}
+                )
+                tr.begin_joint(view["proc"], gid, target)
+                return 0
+            store.rdone(gid)
+            self._config_record(
+                gid, dead_p, new_p, int(cfg["epoch"]), "done"
+            )
+            self._replica_dead_since.pop((gid, dead_p), None)
+            t0 = self._reconfig_t0.pop(gid, None)
+            if t0 is not None:
+                self.replace_stats[gid] = {
+                    "replace_replica_s": max(0.0, now - (t0 + self.dead_s)),
+                    "degraded_quorum_window_s": now - t0,
+                }
+            return 1
+        return 0
 
     def _execute(
         self, gid: int, src: Optional[int], dst: int, reason: str,

@@ -109,9 +109,27 @@ class WedgeWatch:
         commit: List[int] = groups["commit"]
         prev, self._prev_commit = self._prev_commit, list(commit)
         frec = getattr(self.node, "_frec", None)
+        # Groups intentionally paused are NOT wedges: a sealed group is
+        # mid-migration (its frontier freezes by design until the
+        # destination adopts), and a reconfiguring group's commit may
+        # legitimately stall while the joint phase waits on BOTH
+        # quorums.  Counting either would fire a false "wedged
+        # leadership" anomaly exactly when self-healing is working.
+        sealed = groups.get("sealed") or []
+        reconfig = groups.get("reconfig") or []
         for g in range(len(commit)):
             pend = int(backlog[g]) if backlog is not None else 0
             moved = prev is None or g >= len(prev) or commit[g] > prev[g]
+            exempt = bool(
+                (g < len(sealed) and sealed[g])
+                or (g < len(reconfig) and reconfig[g])
+            )
+            if exempt:
+                self._stall[g] = 0
+                if g in self.wedged:
+                    self.wedged.discard(g)
+                m.inc("wedge.reconfig_exempt")
+                continue
             if moved or pend <= 0:
                 # Progress, or nothing owed: not a wedge.  (An idle
                 # group with a severed leader is invisible here by

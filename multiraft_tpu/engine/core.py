@@ -79,6 +79,15 @@ def check_quorum_default() -> bool:
     ``MRT_CHECK_QUORUM=0`` (kill switch, paired with MRT_PREVOTE)."""
     return _env_on("MRT_CHECK_QUORUM")
 
+
+def membership_default() -> bool:
+    """Joint-consensus membership change, ON unless ``MRT_MEMBERSHIP=0``
+    (kill switch).  With every group at its full static peer set the
+    masked dual-quorum reductions are value-identical to the legacy
+    single-quorum ones (see the math note on EngineConfig.membership),
+    so default-on changes no behavior until a config entry lands."""
+    return _env_on("MRT_MEMBERSHIP")
+
 # The tick's metrics schema — single source of truth for the mesh
 # path's out_specs (engine/mesh.py) and the host's per-device scalar
 # reduction (engine/host.py).  SCALAR keys are cluster-wide scalars
@@ -139,6 +148,23 @@ class EngineConfig:
     check_quorum: bool = dataclasses.field(
         default_factory=check_quorum_default
     )
+    # Joint-consensus membership change (Raft §6 / thesis §4.3): per-
+    # replica config views as voter BITMASKS (``voters_old`` /
+    # ``voters_new``, i32 bit p = peer p votes) plus a ``joint`` flag.
+    # While joint, vote tallying, quorum-median commit advance and
+    # check-quorum stepdown each require BOTH quorums (two masked
+    # reductions).  Config entries take effect ON APPEND (not commit):
+    # a replica always reasons with the latest config in its log.
+    # Math note: with a full mask (the init state) the masked reduction
+    # needs ``P//2+1`` of ``P`` voters and ignores no lanes — exactly
+    # the legacy ``cfg.quorum`` single-quorum math, so membership=True
+    # is a no-op until the first config entry.  The Pallas tally/commit
+    # kernels are mask-unaware, so masked math runs only on the jnp
+    # path: ``membership_on`` is gated off under ``use_pallas`` and the
+    # host admin ops refuse to start a reconfig there.
+    membership: bool = dataclasses.field(
+        default_factory=membership_default
+    )
 
     def __post_init__(self) -> None:
         # The ring-log algebra requires headroom: vectorized scatters
@@ -153,10 +179,27 @@ class EngineConfig:
             raise ValueError("EngineConfig: G, P, E must be >= 1")
         if self.ELECT_MIN >= self.ELECT_MAX or self.HB_TICKS < 1:
             raise ValueError("EngineConfig: bad timing parameters")
+        if self.membership and self.P > 30:
+            # Voter sets are i32 bitmasks; bit 31 is the sign bit.
+            raise ValueError(
+                f"EngineConfig: membership mode supports P <= 30 "
+                f"(i32 voter bitmasks), got P={self.P}"
+            )
 
     @property
     def quorum(self) -> int:
         return self.P // 2 + 1
+
+    @property
+    def membership_on(self) -> bool:
+        """Membership machinery active in the tick: requires the jnp
+        reduction path (the Pallas kernels are mask-unaware)."""
+        return self.membership and not self.use_pallas
+
+    @property
+    def full_voters(self) -> int:
+        """The all-peers voter bitmask (the init config)."""
+        return (1 << self.P) - 1
 
 
 class EngineState(NamedTuple):
@@ -181,6 +224,16 @@ class EngineState(NamedTuple):
     pre_votes: jnp.ndarray  # bool[G,P,P] prevote grants (prevote mode)
     last_heard: jnp.ndarray  # i32[G,P] last tick a leader was heard
     last_ack: jnp.ndarray  # i32[G,P,P] leader p: last ack tick from q
+    # Membership (joint consensus): each replica's VIEW of its group's
+    # config — voter bitmasks, the joint flag, a monotone config epoch
+    # and the log index of the latest config entry.  Equal old/new
+    # masks outside the joint phase (the invariant that makes the
+    # dual-quorum reductions branchless).
+    voters_old: jnp.ndarray  # i32[G,P] bitmask: C_old voters
+    voters_new: jnp.ndarray  # i32[G,P] bitmask: C_new voters
+    joint: jnp.ndarray  # bool[G,P] in the C_old,new transition
+    cfg_epoch: jnp.ndarray  # i32[G,P] config generation counter
+    cfg_idx: jnp.ndarray  # i32[G,P] log index of the latest cfg entry
 
 
 class Mailbox(NamedTuple):
@@ -211,6 +264,15 @@ class Mailbox(NamedTuple):
     ap_success: jnp.ndarray  # bool[G,P,P]
     ap_match: jnp.ndarray  # i32[G,P,P]
     ap_conflict: jnp.ndarray  # i32[G,P,P]
+    # Leader config view, broadcast with every append: a follower whose
+    # log provably covers ``ar_cfg_idx`` mirrors the leader's view
+    # (effect-on-append without per-entry payload plumbing — see the
+    # phase-3 adoption note in tick_impl).
+    ar_cfg_epoch: jnp.ndarray  # i32[G,P,P]
+    ar_cfg_idx: jnp.ndarray  # i32[G,P,P]
+    ar_cfg_old: jnp.ndarray  # i32[G,P,P] voter bitmask
+    ar_cfg_new: jnp.ndarray  # i32[G,P,P] voter bitmask
+    ar_cfg_joint: jnp.ndarray  # bool[G,P,P]
 
 
 def init_state(cfg: EngineConfig, key: jax.Array) -> EngineState:
@@ -239,6 +301,11 @@ def init_state(cfg: EngineConfig, key: jax.Array) -> EngineState:
         pre_votes=jnp.zeros((G, P, P), bool),
         last_heard=z(G, P),
         last_ack=z(G, P, P),
+        voters_old=jnp.full((G, P), cfg.full_voters, jnp.int32),
+        voters_new=jnp.full((G, P), cfg.full_voters, jnp.int32),
+        joint=jnp.zeros((G, P), bool),
+        cfg_epoch=z(G, P),
+        cfg_idx=z(G, P),
     )
 
 
@@ -258,6 +325,9 @@ def empty_mailbox(cfg: EngineConfig) -> Mailbox:
         ar_snap=b(G, P, P),
         ap_active=b(G, P, P), ap_term=z(G, P, P), ap_success=b(G, P, P),
         ap_match=z(G, P, P), ap_conflict=z(G, P, P),
+        ar_cfg_epoch=z(G, P, P), ar_cfg_idx=z(G, P, P),
+        ar_cfg_old=z(G, P, P), ar_cfg_new=z(G, P, P),
+        ar_cfg_joint=b(G, P, P),
     )
 
 
@@ -313,18 +383,56 @@ def _ring_write(
     return jnp.where(hit, v, log)
 
 
-def _kth_smallest(x: jnp.ndarray, k: int) -> jnp.ndarray:
-    """k-th smallest (0-based) along the last axis via an unrolled
-    compare-swap network — ``jnp.sort`` costs ~1.6 ms at bench shapes
-    where this is a handful of fused min/max passes.  The last axis
-    length is static and small (P peers)."""
+def _sort_cols(x: jnp.ndarray) -> list:
+    """Ascending sort along the (static, small) last axis via an
+    unrolled compare-swap network — ``jnp.sort`` costs ~1.6 ms at bench
+    shapes where this is a handful of fused min/max passes.  Returns
+    the sorted columns as a list of [...] arrays."""
     cols = [x[..., i] for i in range(x.shape[-1])]
     n = len(cols)
     for i in range(n):
         for j in range(n - 1 - i):
             a, b = cols[j], cols[j + 1]
             cols[j], cols[j + 1] = jnp.minimum(a, b), jnp.maximum(a, b)
-    return cols[k]
+    return cols
+
+
+def _kth_smallest(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """k-th smallest (0-based) along the last axis (see _sort_cols)."""
+    return _sort_cols(x)[k]
+
+
+def _voter_lanes(bits: jnp.ndarray, P: int) -> jnp.ndarray:
+    """Expand an i32 voter bitmask [...] to a bool lane mask [..., P]."""
+    qi = jnp.arange(P, dtype=jnp.int32)
+    return ((bits[..., None] >> qi) & 1) == 1
+
+
+def _quorum_met(grants: jnp.ndarray, bits: jnp.ndarray, P: int) -> jnp.ndarray:
+    """Does ``grants`` (bool[..., P]) contain a majority of the voters
+    named by ``bits`` (i32 bitmask [...])?  The masked generalization of
+    ``count >= cfg.quorum``: with a full mask it needs P//2+1 of P."""
+    lanes = _voter_lanes(bits, P)
+    n = jnp.sum((grants & lanes).astype(jnp.int32), axis=-1)
+    need = jnp.sum(lanes.astype(jnp.int32), axis=-1) // 2 + 1
+    return n >= need
+
+
+def _quorum_kth(vals: jnp.ndarray, bits: jnp.ndarray, P: int) -> jnp.ndarray:
+    """Largest v such that a majority of the voters in ``bits`` have
+    ``vals >= v`` — the masked, dynamic-quorum generalization of
+    ``_kth_smallest(vals, P - quorum)``.  Non-voter lanes are pushed
+    below every real value (sentinel -1), so the top ``count(bits)``
+    sorted columns are exactly the voters and the majority-th largest
+    overall equals the majority-th largest among voters."""
+    lanes = _voter_lanes(bits, P)
+    need = jnp.sum(lanes.astype(jnp.int32), axis=-1) // 2 + 1  # [...]
+    cols = _sort_cols(jnp.where(lanes, vals, -1))
+    k = P - need  # dynamic per-element index into the ascending sort
+    out = cols[0]
+    for i in range(1, P):
+        out = jnp.where(k == i, cols[i], out)
+    return out
 
 
 def _term_at(cfg: EngineConfig, state: EngineState, idx: jnp.ndarray) -> jnp.ndarray:
@@ -521,10 +629,22 @@ def tick_impl(
         # a term bump happens in prevote mode).  The real vote requests
         # go out in phase 5 via ``promote``.
         diag = jnp.arange(P)[None, :, None] == jnp.arange(P)[None, None, :]
-        n_pre = jnp.sum(state.pre_votes, axis=-1)  # [G,P]
-        promote = (
-            state.alive & (state.role != LEADER) & (n_pre >= cfg.quorum)
-        )
+        if cfg.membership_on:
+            # Joint phase: a prevote round wins only with BOTH quorums
+            # (equal masks outside joint make this the single-quorum
+            # check).  A candidate tallies against its OWN config view
+            # — the latest config in its log, per effect-on-append.
+            promote = (
+                state.alive
+                & (state.role != LEADER)
+                & _quorum_met(state.pre_votes, state.voters_old, P)
+                & _quorum_met(state.pre_votes, state.voters_new, P)
+            )
+        else:
+            n_pre = jnp.sum(state.pre_votes, axis=-1)  # [G,P]
+            promote = (
+                state.alive & (state.role != LEADER) & (n_pre >= cfg.quorum)
+            )
         state = state._replace(
             term=jnp.where(promote, state.term + 1, state.term),
             role=jnp.where(promote, CANDIDATE, state.role),
@@ -535,7 +655,17 @@ def tick_impl(
         )
     else:
         promote = None
-    if cfg.use_pallas:
+    if cfg.membership_on:
+        # Leadership needs a majority of C_old AND (while joint) of
+        # C_new — the two masked tallies that make a config change safe
+        # against a disjoint-quorum double election (Raft §6).
+        become_leader = (
+            (state.role == CANDIDATE)
+            & state.alive
+            & _quorum_met(state.votes, state.voters_old, P)
+            & _quorum_met(state.votes, state.voters_new, P)
+        )
+    elif cfg.use_pallas:
         from .pallas_ops import vote_tally_pallas
 
         become_leader = vote_tally_pallas(
@@ -549,6 +679,27 @@ def tick_impl(
         n_votes = jnp.sum(state.votes, axis=-1)  # [G,P]
         become_leader = (
             (state.role == CANDIDATE) & state.alive & (n_votes >= cfg.quorum)
+        )
+    if cfg.membership_on:
+        # A leader elected while a config change is pending appends a
+        # NO-OP at its own term (Raft thesis §6.4 / §3.6.2): the joint
+        # or exit entry it inherited carries an older term, and the
+        # current-term commit guard would otherwise stall the
+        # transition forever on an idle group.  Gated on a pending
+        # change so steady-state elections stay entry-free.
+        noop = (
+            become_leader
+            & (state.joint | (state.cfg_idx > state.commit))
+            & ((L - 2 - E - state.log_len) >= 1)
+        )
+        noop_idx = _last_index(state) + 1
+        lanes_no = jnp.arange(L, dtype=jnp.int32)
+        hit_no = (
+            jnp.mod(lanes_no - noop_idx[..., None], L) == 0
+        ) & noop[..., None]
+        state = state._replace(
+            log_term=jnp.where(hit_no, state.term[..., None], state.log_term),
+            log_len=state.log_len + noop.astype(jnp.int32),
         )
     last_idx = _last_index(state)
     state = state._replace(
@@ -691,6 +842,36 @@ def tick_impl(
         )
     )
 
+    if cfg.membership_on:
+        # Config mirroring (effect-on-append without per-entry payload
+        # plumbing — a deliberate divergence from entry-parse Raft): a
+        # follower adopts the leader's whole config view when a
+        # successful append proves its log COVERS the leader's latest
+        # config entry (``cfg_idx <= prev + n``: log matching then
+        # guarantees the entry at cfg_idx is the leader's).  Truncation
+        # rollback falls out for free — a new leader with an older
+        # config re-mirrors its view the same way.  A snapshot
+        # fast-forward adopts unconditionally: config is part of
+        # snapshot state (reference: raft/raft_snapshot.go InstallSnapshot
+        # carries the config in etcd/thesis Raft).
+        m_cfg_idx = pick(inbox.ar_cfg_idx)
+        covered = m_cfg_idx <= (prev + n_ent)
+        adopt_cfg = (match & covered) | do_snap
+        m_joint = jnp.any(sel & vT(inbox.ar_cfg_joint), axis=2)
+        state = state._replace(
+            voters_old=jnp.where(
+                adopt_cfg, pick(inbox.ar_cfg_old), state.voters_old
+            ),
+            voters_new=jnp.where(
+                adopt_cfg, pick(inbox.ar_cfg_new), state.voters_new
+            ),
+            joint=jnp.where(adopt_cfg, m_joint, state.joint),
+            cfg_epoch=jnp.where(
+                adopt_cfg, pick(inbox.ar_cfg_epoch), state.cfg_epoch
+            ),
+            cfg_idx=jnp.where(adopt_cfg, m_cfg_idx, state.cfg_idx),
+        )
+
     # Replies go to EVERY active sender ([G,dst,src] is out.ap's
     # [G,src,dst] layout: the replier is out's src).  Only the winner
     # can succeed; losers get failure + our current term, and their
@@ -765,7 +946,25 @@ def tick_impl(
     # Self always matches its own last entry.
     own = pi[None] == pi[..., None]  # [1,P,P] diag mask
     eff_match = jnp.where(own, last_idx[..., None], state.match_idx)
-    if cfg.use_pallas:
+    if cfg.membership_on:
+        # Joint commit rule: an index is committed only when a majority
+        # of C_old AND a majority of C_new have matched it — the min of
+        # the two masked quorum medians (equal outside joint).  A
+        # leader REMOVED by the in-flight config still advances commit
+        # here: the medians run over the voters' match columns, not the
+        # leader's own lane, so it can commit the very entry that
+        # removes it (Raft thesis §4.2.2).
+        q_old = _quorum_kth(eff_match, state.voters_old, P)
+        q_new = _quorum_kth(eff_match, state.voters_new, P)
+        quorum_idx = jnp.minimum(q_old, q_new)
+        # Current-term guard (reference: raft/raft_append_entry.go:98).
+        guard = _term_at(cfg, state, quorum_idx) == state.term
+        new_commit = jnp.where(
+            is_leader & guard,
+            jnp.maximum(state.commit, quorum_idx),
+            state.commit,
+        )
+    elif cfg.use_pallas:
         from .pallas_ops import quorum_commit_pallas
 
         new_commit = quorum_commit_pallas(
@@ -799,7 +998,18 @@ def tick_impl(
         # (self slot = now) has ``quorum`` elements at or above it, so
         # it is the newest tick at which a full quorum had acked.
         eff_ack = jnp.where(own, now, state.last_ack)  # [G,P,P]
-        q_heard = _kth_smallest(eff_ack, P - cfg.quorum)  # [G,P]
+        if cfg.membership_on:
+            # Joint check-quorum: the leader must be hearing BOTH
+            # quorums — losing either one means it can no longer
+            # commit, so it releases the group.  Learner acks are
+            # masked out: a caught-up learner must never keep a
+            # voter-severed leader alive.
+            q_heard = jnp.minimum(
+                _quorum_kth(eff_ack, state.voters_old, P),
+                _quorum_kth(eff_ack, state.voters_new, P),
+            )
+        else:
+            q_heard = _kth_smallest(eff_ack, P - cfg.quorum)  # [G,P]
         demote = (
             (state.role == LEADER)
             & state.alive
@@ -815,8 +1025,36 @@ def tick_impl(
             elect_dl=jnp.where(demote, now + jitter, state.elect_dl)
         )
 
+    # ---- 4c. membership: a leader removed by a COMPLETED config
+    # change steps down once the removing entry commits (Raft thesis
+    # §4.2.2: it keeps leading — and committing — up to that point) ----
+    if cfg.membership_on:
+        self_voter = (
+            ((state.voters_old | state.voters_new) >> pi) & 1
+        ) == 1  # [G,P]
+        removed = (
+            (state.role == LEADER)
+            & state.alive
+            & ~state.joint
+            & ~self_voter
+            & (state.commit >= state.cfg_idx)
+        )
+        # Own-term demotion, like check-quorum: no higher term was
+        # observed, so the vote must survive.
+        state = _step_down(cfg, state, removed, state.term, clear_vote=False)
+        state = state._replace(
+            elect_dl=jnp.where(removed, now + jitter, state.elect_dl)
+        )
+
     # ---- 5. timers: elections (reference: raft/raft.go:106-125) ----
     timeout = state.alive & (now >= state.elect_dl) & (state.role != LEADER)
+    if cfg.membership_on:
+        # Non-voters (learners, removed peers) never campaign: their
+        # own config view excludes them from both voter sets.  They
+        # still GRANT votes — eligibility is the candidate's config,
+        # tallied under the candidate's masks above.
+        member = (((state.voters_old | state.voters_new) >> pi) & 1) == 1
+        timeout = timeout & member
     if not cfg.prevote:
         state = state._replace(
             term=jnp.where(timeout, state.term + 1, state.term),
@@ -857,6 +1095,42 @@ def tick_impl(
         vr_last_term=jnp.broadcast_to(last_term[:, :, None], (G, P, P)),
         vr_pre=jnp.broadcast_to(send_pre[:, :, None], (G, P, P)) & vr_act,
     )
+
+    # ---- 5a-bis. membership: joint auto-exit.  A leader whose
+    # C_old,new entry has COMMITTED appends the C_new exit entry
+    # in-tick (no host round-trip in the transition's critical path)
+    # and adopts it immediately — effect-on-append collapses old to
+    # new, ending the dual-quorum phase.  Placed before ingest so the
+    # capacity accounting and ``last_idx`` the firehose sees already
+    # include the exit entry. ----
+    if cfg.membership_on:
+        last_idx = _last_index(state)
+        can_exit = (
+            (state.role == LEADER)
+            & state.alive
+            & state.joint
+            & (state.commit >= state.cfg_idx)
+            & ((L - 2 - E - state.log_len) >= 1)
+        )
+        exit_idx = last_idx + 1
+        lanes_cfg = jnp.arange(L, dtype=jnp.int32)
+        hit_cfg = (
+            jnp.mod(lanes_cfg - exit_idx[..., None], L) == 0
+        ) & can_exit[..., None]
+        state = state._replace(
+            log_term=jnp.where(
+                hit_cfg, state.term[..., None], state.log_term
+            ),
+            log_len=state.log_len + can_exit.astype(jnp.int32),
+            voters_old=jnp.where(
+                can_exit, state.voters_new, state.voters_old
+            ),
+            joint=jnp.where(can_exit, False, state.joint),
+            cfg_epoch=jnp.where(
+                can_exit, state.cfg_epoch + 1, state.cfg_epoch
+            ),
+            cfg_idx=jnp.where(can_exit, exit_idx, state.cfg_idx),
+        )
 
     # ---- 5b. Start() ingestion: leaders append the firehose ----
     # Only the leader at the group's max alive term ingests: a zombie
@@ -937,6 +1211,18 @@ def tick_impl(
         ar_terms=ar_terms,
         ar_commit=jnp.broadcast_to(state.commit[:, :, None], (G, P, P)),
         ar_snap=need_snap & send,
+        # Leader config view rides every append (phase-3 mirroring).
+        ar_cfg_epoch=jnp.broadcast_to(
+            state.cfg_epoch[:, :, None], (G, P, P)
+        ),
+        ar_cfg_idx=jnp.broadcast_to(state.cfg_idx[:, :, None], (G, P, P)),
+        ar_cfg_old=jnp.broadcast_to(
+            state.voters_old[:, :, None], (G, P, P)
+        ),
+        ar_cfg_new=jnp.broadcast_to(
+            state.voters_new[:, :, None], (G, P, P)
+        ),
+        ar_cfg_joint=jnp.broadcast_to(state.joint[:, :, None], (G, P, P)),
     )
     state = state._replace(
         hb_due=jnp.where(hb_fire, now + cfg.HB_TICKS, state.hb_due),

@@ -375,6 +375,228 @@ class EngineDriver:
             mb, lambda _, a: a.at[g, p, :].set(False).at[g, :, p].set(False)
         )
 
+    def reset_replica(self, g: int, p: int) -> None:
+        """Wipe slot (g, p) to a FRESH INCARNATION — the re-add path
+        (a removed peer index being reused for a new server), NOT the
+        crash-restart path (:meth:`restart_replica`, where persistent
+        state must survive).
+
+        Beyond the restarted-row reset, this clears the OTHER replicas'
+        per-column state about p: a stale ``votes[g, :, p]`` grant from
+        the old incarnation would otherwise count toward a quorum of
+        the new config at the old term, and a stale ``match_idx`` would
+        let a leader commit over entries the new incarnation never
+        acked.  ``alive`` is left False — :meth:`add_learner` raises it
+        once the config view is seeded."""
+        st = self.state
+        self.state = st._replace(
+            # Own row: blank server.
+            term=st.term.at[g, p].set(0),
+            voted_for=st.voted_for.at[g, p].set(-1),
+            role=st.role.at[g, p].set(FOLLOWER),
+            commit=st.commit.at[g, p].set(0),
+            applied=st.applied.at[g, p].set(0),
+            base=st.base.at[g, p].set(0),
+            base_term=st.base_term.at[g, p].set(0),
+            log_len=st.log_len.at[g, p].set(0),
+            log_term=st.log_term.at[g, p].set(0),
+            next_idx=st.next_idx.at[g, p].set(1).at[g, :, p].set(1),
+            hb_due=st.hb_due.at[g, p].set(0),
+            last_heard=st.last_heard.at[g, p].set(st.tick_no),
+            elect_dl=st.elect_dl.at[g, p].set(
+                st.tick_no + self.cfg.ELECT_MAX
+            ),
+            # Cross-replica columns about p (the regression fix): no
+            # vote, prevote, match or ack of the OLD incarnation may
+            # leak into the new one's ledger.
+            votes=st.votes.at[g, p].set(False).at[g, :, p].set(False),
+            pre_votes=st.pre_votes.at[g, p]
+            .set(False)
+            .at[g, :, p]
+            .set(False),
+            match_idx=st.match_idx.at[g, p].set(0).at[g, :, p].set(0),
+            last_ack=st.last_ack.at[g, p]
+            .set(0)
+            .at[g, :, p]
+            .set(st.tick_no),
+            alive=st.alive.at[g, p].set(False),
+        )
+        # In-flight traffic of the old incarnation dies with it.
+        self.inbox = self._mask_edges(self.inbox, g, p)
+        self._delayed = [
+            it
+            for it in self._delayed
+            if not (it[2][0] == g and p in (it[2][1], it[2][2]))
+        ]
+
+    # -- membership change (joint consensus) -------------------------------
+
+    def _require_membership(self) -> None:
+        if not self.cfg.membership_on:
+            raise RuntimeError(
+                "membership change requires EngineConfig.membership and "
+                "the jnp reduction path (use_pallas=False) — the Pallas "
+                "tally/commit kernels are mask-unaware"
+            )
+
+    def config_of(self, g: int, p: Optional[int] = None) -> Dict[str, Any]:
+        """Replica (g, p)'s config view (the leader's when p is None):
+        voter index sets, joint flag, epoch and the latest config
+        entry's log index."""
+        if p is None:
+            p = self.leader_of(g)
+            if p is None:
+                raise RuntimeError(f"group {g} has no leader")
+        st = self.np_state()
+        bits_old = int(st["voters_old"][g, p])
+        bits_new = int(st["voters_new"][g, p])
+        unpack = lambda b: sorted(
+            q for q in range(self.cfg.P) if (b >> q) & 1
+        )
+        return {
+            "peer": int(p),
+            "voters_old": unpack(bits_old),
+            "voters_new": unpack(bits_new),
+            "joint": bool(st["joint"][g, p]),
+            "epoch": int(st["cfg_epoch"][g, p]),
+            "cfg_idx": int(st["cfg_idx"][g, p]),
+        }
+
+    def add_learner(self, g: int, p: int) -> None:
+        """AddServer step 1: (re)seat slot (g, p) as a NON-VOTING
+        learner of group g — a fresh incarnation (stale vote/match
+        state of any prior tenant cleared, see :meth:`reset_replica`)
+        whose config view mirrors the leader's, so it knows it is not
+        a voter and never campaigns.  Catch-up is the ordinary
+        replication path: the leader snapshot-fast-forwards it and
+        streams the tail; promotion (:meth:`begin_joint`) should wait
+        for :meth:`learner_match` to close on the leader's last index
+        so the joint phase never depends on a cold log."""
+        self._require_membership()
+        lead = self.leader_of(g)
+        if lead is None:
+            raise RuntimeError(f"add_learner: group {g} has no leader")
+        if lead == p:
+            raise ValueError(f"add_learner: ({g},{p}) is the leader")
+        st = self.np_state()
+        if ((int(st["voters_old"][g, lead]) | int(st["voters_new"][g, lead]))
+                >> p) & 1:
+            raise ValueError(
+                f"add_learner: peer {p} is a voter of group {g}; remove "
+                f"it from the config before reseating the slot"
+            )
+        self.reset_replica(g, p)
+        st2 = self.state
+        self.state = st2._replace(
+            voters_old=st2.voters_old.at[g, p].set(
+                st2.voters_old[g, lead]
+            ),
+            voters_new=st2.voters_new.at[g, p].set(
+                st2.voters_new[g, lead]
+            ),
+            joint=st2.joint.at[g, p].set(st2.joint[g, lead]),
+            cfg_epoch=st2.cfg_epoch.at[g, p].set(st2.cfg_epoch[g, lead]),
+            cfg_idx=st2.cfg_idx.at[g, p].set(st2.cfg_idx[g, lead]),
+            alive=st2.alive.at[g, p].set(True),
+        )
+
+    def learner_match(self, g: int, p: int) -> tuple:
+        """(leader's match for p, leader's last index) — the catch-up
+        gauge ``begin_joint`` callers poll before promoting."""
+        lead = self.leader_of(g)
+        if lead is None:
+            raise RuntimeError(f"learner_match: group {g} has no leader")
+        st = self.np_state()
+        last = int(st["base"][g, lead] + st["log_len"][g, lead])
+        return int(st["match_idx"][g, lead, p]), last
+
+    def begin_joint(self, g: int, new_voters) -> int:
+        """AddServer/RemoveServer step 2: append the C_old,new config
+        entry at group g's leader (host surgery on the leader's row —
+        the one entry the firehose cannot carry, since it must take
+        effect ON APPEND).  From the next tick the leader replicates it
+        like any entry; once it commits under BOTH quorums the tick
+        auto-appends the C_new exit entry (core.py phase 5a-bis).
+        Returns the joint entry's log index."""
+        self._require_membership()
+        new_voters = sorted(set(int(q) for q in new_voters))
+        if not new_voters:
+            raise ValueError("begin_joint: empty target voter set")
+        if any(q < 0 or q >= self.cfg.P for q in new_voters):
+            raise ValueError(
+                f"begin_joint: voters {new_voters} out of range "
+                f"0..{self.cfg.P - 1}"
+            )
+        lead = self.leader_of(g)
+        if lead is None:
+            raise RuntimeError(f"begin_joint: group {g} has no leader")
+        st = self.np_state()
+        if bool(st["joint"][g, lead]):
+            raise RuntimeError(
+                f"begin_joint: group {g} already has a config change in "
+                f"flight (one at a time — Raft §6)"
+            )
+        mask = 0
+        for q in new_voters:
+            mask |= 1 << q
+        if mask == int(st["voters_old"][g, lead]):
+            raise ValueError("begin_joint: target equals current config")
+        if self.cfg.L - 2 - self.cfg.E - int(st["log_len"][g, lead]) < 1:
+            raise RuntimeError(
+                f"begin_joint: group {g} leader log has no headroom"
+            )
+        idx = int(st["base"][g, lead] + st["log_len"][g, lead]) + 1
+        term = int(st["term"][g, lead])
+        s = self.state
+        self.state = s._replace(
+            log_term=s.log_term.at[g, lead, idx % self.cfg.L].set(term),
+            log_len=s.log_len.at[g, lead].add(1),
+            voters_new=s.voters_new.at[g, lead].set(mask),
+            joint=s.joint.at[g, lead].set(True),
+            cfg_epoch=s.cfg_epoch.at[g, lead].add(1),
+            cfg_idx=s.cfg_idx.at[g, lead].set(idx),
+        )
+        return idx
+
+    def seed_config(self, voters) -> None:
+        """Bootstrap-time config: make ``voters`` (a peer index list)
+        the voter set of EVERY group, leaving the remaining slots as
+        dead spares a later :meth:`add_learner` can reseat.  Host
+        surgery on a cluster that has not run yet — call before the
+        first tick (replica replacement on a live group goes through
+        ``add_learner``/``begin_joint``)."""
+        self._require_membership()
+        voters = sorted(set(int(q) for q in voters))
+        if not voters or any(q < 0 or q >= self.cfg.P for q in voters):
+            raise ValueError(f"seed_config: bad voter set {voters}")
+        if int(np.asarray(self.state.tick_no)) != 0:
+            raise RuntimeError("seed_config: cluster already ticked")
+        mask = 0
+        for q in voters:
+            mask |= 1 << q
+        spares = [q for q in range(self.cfg.P) if q not in voters]
+        st = self.state
+        alive = st.alive
+        for q in spares:
+            alive = alive.at[:, q].set(False)
+        self.state = st._replace(
+            voters_old=jnp.full_like(st.voters_old, mask),
+            voters_new=jnp.full_like(st.voters_new, mask),
+            alive=alive,
+        )
+
+    def reconfiguring(self) -> np.ndarray:
+        """Per-group bool: a membership change is in flight — the group
+        is in the joint phase, or its latest config entry has not yet
+        committed.  Stateless read the wedge watchdog and placement
+        health checks consult (a reconfiguring group's commit frontier
+        may legitimately stall while it waits on BOTH quorums)."""
+        st = self.np_state()
+        return (
+            st["joint"].any(axis=1)
+            | (st["cfg_idx"].max(axis=1) > st["commit"].max(axis=1))
+        )
+
     # -- Start() ----------------------------------------------------------
 
     def start(self, g: int, command: Any = None) -> None:
@@ -594,7 +816,11 @@ class EngineDriver:
     # v2: EngineState gained pre_votes/last_heard (PreVote support);
     # Mailbox gained vr_pre/vp_pre.
     # v3: EngineState gained last_ack (check-quorum stepdown).
-    CKPT_VERSION = 3
+    # v4: EngineState gained voters_old/voters_new/joint/cfg_epoch/
+    # cfg_idx and Mailbox gained the ar_cfg_* lanes (joint-consensus
+    # membership change) — config state rides the generic _asdict()
+    # path, so an in-flight reconfig survives checkpoint/restore.
+    CKPT_VERSION = 4
 
     def save(self, path: str, extra: Optional[Dict[str, Any]] = None) -> str:
         """Atomically write a full checkpoint.  ``extra`` carries

@@ -511,6 +511,115 @@ class BatchedShardKV(FrontierService):
         rep = self.reps.get(gid)
         return rep is not None and getattr(rep, "sealed", False)
 
+    # -- replica membership (engine/host.py joint consensus) --------------
+    #
+    # Gid-level facades over the EngineDriver admin ops, shaped for the
+    # placement controller's replace-dead-replica legs: every verb is
+    # idempotent (a retried leg after a controller crash or lost reply
+    # answers the same way), and ``begin_joint_gid`` treats "already in
+    # joint toward this target" / "already settled at this target" as
+    # success rather than the engine's one-change-at-a-time refusal.
+
+    def replica_health(self, gid: int) -> Optional[Dict[str, Any]]:
+        """Per-replica liveness + the group's voter sets: ``{"alive":
+        [bool]*P, "voters_old", "voters_new", "joint", "epoch"}`` —
+        the controller's dead-voter detection signal.  The config view
+        is the leader's when one exists (max across rows otherwise:
+        mid-election health must still name the voters)."""
+        g = self._g2l.get(gid)
+        if g is None:
+            return None
+        d = self.driver
+        st = d.np_state()
+        lead = d.leader_of(g)
+        row = lead if lead is not None else int(
+            (st["voters_old"][g] | st["voters_new"][g]).argmax()
+        )
+        unpack = lambda b: sorted(
+            q for q in range(d.cfg.P) if (int(b) >> q) & 1
+        )
+        return {
+            "alive": st["alive"][g].astype(bool).tolist(),
+            "voters_old": unpack(st["voters_old"][g, row]),
+            "voters_new": unpack(st["voters_new"][g, row]),
+            "joint": bool(st["joint"][g].any()),
+            "epoch": int(st["cfg_epoch"][g, row]),
+            "leader": -1 if lead is None else int(lead),
+        }
+
+    def config_of_gid(self, gid: int) -> Optional[Dict[str, Any]]:
+        g = self._g2l.get(gid)
+        if g is None:
+            return None
+        try:
+            return self.driver.config_of(g)
+        except RuntimeError:
+            return None  # no leader right now: caller retries
+
+    def add_learner_gid(self, gid: int, p: int) -> bool:
+        """Seat ``p`` as a fresh learner of ``gid``.  Idempotent: if
+        ``p`` is already a live non-voter (a previous attempt landed
+        but the reply was lost), answers True without re-wiping it —
+        a re-wipe mid-catch-up would discard replication progress."""
+        g = self._g2l.get(gid)
+        if g is None:
+            return False
+        d = self.driver
+        st = d.np_state()
+        lead = d.leader_of(g)
+        if lead is None:
+            return False
+        voter = ((int(st["voters_old"][g, lead])
+                  | int(st["voters_new"][g, lead])) >> p) & 1
+        if not voter and bool(st["alive"][g, p]):
+            return True  # already seated by a prior attempt
+        try:
+            d.add_learner(g, p)
+        except (RuntimeError, ValueError):
+            return False
+        return True
+
+    def learner_match_gid(self, gid: int, p: int) -> Optional[tuple]:
+        g = self._g2l.get(gid)
+        if g is None:
+            return None
+        try:
+            return self.driver.learner_match(g, p)
+        except RuntimeError:
+            return None
+
+    def begin_joint_gid(self, gid: int, new_voters) -> bool:
+        """Enter the joint phase toward ``new_voters``.  Idempotent:
+        already joint toward this exact target, or already settled AT
+        the target, answers True — the controller's crash-resume
+        re-drive of a leg whose first attempt landed."""
+        g = self._g2l.get(gid)
+        if g is None:
+            return False
+        target = sorted(set(int(q) for q in new_voters))
+        c = self.config_of_gid(gid)
+        if c is None:
+            return False
+        if c["joint"] and c["voters_new"] == target:
+            return True
+        if not c["joint"] and c["voters_old"] == target:
+            return True  # transition already completed
+        try:
+            self.driver.begin_joint(g, target)
+        except (RuntimeError, ValueError):
+            return False
+        return True
+
+    def kill_replica_gid(self, gid: int, p: int) -> bool:
+        """Permanently kill replica row ``p`` of ``gid`` (the nemesis
+        verb behind replace-dead-replica chaos: the row stays dead
+        until a reconfig reseats the slot as a fresh incarnation)."""
+        g = self._g2l.get(gid)
+        if g is None:
+            return False
+        self.driver.set_alive(g, int(p), False)
+        return True
+
     def export_group(self, gid: int) -> Optional[Dict[str, Any]]:
         """Seal ``gid`` and return its serialized applied state, or
         ``None`` if it cannot seal yet (mid-migration, config proposal

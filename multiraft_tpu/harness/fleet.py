@@ -116,6 +116,23 @@ class PlacementMap:
     def abort(self, gid: int) -> None:
         self._run(self._clerk.abort(gid))
 
+    # -- reconfig intents (replace-dead-replica policy) ------------------
+
+    def reconfig_intents(self) -> Dict[int, Tuple[int, int, str]]:
+        return dict(self._run(self._clerk.query()).reconfigs)
+
+    def rbegin(self, gid: int, dead_peer: int, new_peer: int) -> None:
+        self._run(self._clerk.rbegin(gid, dead_peer, new_peer))
+
+    def rphase(self, gid: int, phase: str) -> None:
+        self._run(self._clerk.rphase(gid, phase))
+
+    def rdone(self, gid: int) -> None:
+        self._run(self._clerk.rdone(gid))
+
+    def rabort(self, gid: int) -> None:
+        self._run(self._clerk.rabort(gid))
+
     # -- chaos ----------------------------------------------------------
 
     def leader(self) -> Optional[int]:
@@ -167,6 +184,8 @@ class PlacedFleet:
         ship_sync: Optional[bool] = None,
         ship_window_s: Optional[float] = None,
         data_dir: Optional[str] = None,
+        replicas: int = 3,
+        voters: Optional[Sequence[int]] = None,
     ) -> None:
         from ..distributed.engine_cluster import EngineFleetCluster
 
@@ -186,6 +205,7 @@ class PlacedFleet:
             mesh_devices=mesh_devices, chaos_seed=chaos_seed,
             shipping=shipping, ship_sync=ship_sync,
             ship_window_s=ship_window_s, data_dir=data_dir,
+            replicas=replicas, voters=voters,
         )
         self.ctrl_replicas = ctrl_replicas
         self.seed = seed
@@ -259,6 +279,16 @@ class PlacedFleet:
         placement layer)."""
         self.cluster.kill(i)
 
+    def kill_replica(self, gid: int, peer: int) -> bool:
+        """Permanently kill ONE engine replica of ``gid`` at its
+        current owner process (the process lives on) — the fault the
+        controller's replace-dead-replica policy heals via joint
+        consensus.  Routed through the controller's own transport."""
+        tr = self.controller.transport
+        _, placement = self.placement()
+        proc = placement.get(gid)
+        return proc is not None and tr.kill_replica(proc, gid, peer)
+
 
 # ---------------------------------------------------------------------------
 # In-process fleet (deterministic, socket-free)
@@ -280,6 +310,8 @@ class InProcessFleet:
         assignment: Sequence[Sequence[int]],
         spare_slots: int = 1,
         seed: int = 0,
+        replicas: int = 3,
+        voters: Optional[Sequence[int]] = None,
     ) -> None:
         from ..engine.core import EngineConfig
         from ..engine.host import EngineDriver
@@ -294,9 +326,16 @@ class InProcessFleet:
         self.standbys: Dict[int, Any] = {}
         for i, gl in enumerate(self.assignment):
             cfg = EngineConfig(
-                G=len(gl) + 1 + spare_slots, P=3, L=64, E=8, INGEST=8
+                G=len(gl) + 1 + spare_slots, P=replicas, L=64, E=8,
+                INGEST=8,
             )
             driver = EngineDriver(cfg, seed=seed + 131 * i)
+            if voters is not None and len(set(voters)) < replicas:
+                # Spare ENGINE REPLICA slots (self-healing replica
+                # sets): only ``voters`` vote, the remaining rows park
+                # dead until the placement controller seats a learner
+                # in one to replace a permanently killed voter.
+                driver.seed_config(voters)
             if not driver.run_until_quiet_leaders(max_ticks=2000):
                 raise RuntimeError(f"instance {i} leaders never settled")
             self.instances.append(BatchedShardKV(driver, gids=gl))
@@ -442,6 +481,13 @@ class InProcessFleet:
         answering, its memory is never read again (the crash model)."""
         self.killed.add(p)
 
+    def kill_replica(self, gid: int, peer: int) -> bool:
+        """Chaos verb: permanently kill ONE engine replica of ``gid``
+        (the process lives; the replica row never ticks again) — the
+        fault the controller's replace-dead-replica policy heals."""
+        inst = self.owner_of(gid)
+        return inst is not None and inst.kill_replica_gid(gid, peer)
+
     def clerk(self, client_id: int = 1) -> "InProcFleetClerk":
         return InProcFleetClerk(self, client_id=client_id)
 
@@ -550,12 +596,45 @@ class LocalFleetTransport:
                 max(0.0, (c - p) / dt) for c, p in zip(commit, prev[1])
             ]
         self._prev[proc] = (now, commit)
-        return {
+        gids = [inst._l2g.get(g, -1) for g in range(G)]
+        out = {
             "G": G,
-            "gids": [inst._l2g.get(g, -1) for g in range(G)],
+            "gids": gids,
             "commit": commit,
             "commit_rate": rate,
         }
+        # Membership columns (mirror Obs.groups): per-replica liveness,
+        # the voter union, and the reconfig/sealed exemption flags the
+        # controller's healer and the wedge watch consume.
+        from ..engine.core import LEADER
+
+        st = inst.driver.np_state()
+        vo = st.get("voters_old")
+        if vo is not None:
+            vn = st["voters_new"]
+            joint = st["joint"]
+            cfg_idx = st["cfg_idx"]
+            alive = st["alive"]
+            lead = (st["role"] == LEADER) & alive
+            P = int(vo.shape[1])
+            union = vo | vn
+            row = np.where(
+                lead.any(axis=1), lead.argmax(axis=1), union.argmax(axis=1)
+            )
+            bits = union[np.arange(G), row]
+            out["replica_alive"] = alive.tolist()
+            out["voters"] = [
+                [q for q in range(P) if (int(b) >> q) & 1] for b in bits
+            ]
+            out["joint"] = joint.any(axis=1).tolist()
+            out["reconfig"] = (
+                joint.any(axis=1)
+                | (cfg_idx.max(axis=1) > np.asarray(commit))
+            ).tolist()
+        out["sealed"] = [
+            bool(gids[g] > 0 and inst.is_sealed(gids[g])) for g in range(G)
+        ]
+        return out
 
     def pull_group(self, proc: int, gid: int):
         if proc in self.fleet.killed:
@@ -647,3 +726,30 @@ class LocalFleetTransport:
         # contract observable for tests.
         self.last_push = (version, dict(addr_map))
         return proc not in self.fleet.killed
+
+    # -- membership-change verbs (self-healing replica sets) -------------
+
+    def _inst(self, proc: int):
+        if proc in self.fleet.killed:
+            return None
+        return self.fleet.instances[proc]
+
+    def replica_config(self, proc: int, gid: int):
+        inst = self._inst(proc)
+        return None if inst is None else inst.config_of_gid(gid)
+
+    def add_learner(self, proc: int, gid: int, peer: int) -> bool:
+        inst = self._inst(proc)
+        return inst is not None and inst.add_learner_gid(gid, peer)
+
+    def learner_match(self, proc: int, gid: int, peer: int):
+        inst = self._inst(proc)
+        return None if inst is None else inst.learner_match_gid(gid, peer)
+
+    def begin_joint(self, proc: int, gid: int, voters) -> bool:
+        inst = self._inst(proc)
+        return inst is not None and inst.begin_joint_gid(gid, voters)
+
+    def kill_replica(self, proc: int, gid: int, peer: int) -> bool:
+        inst = self._inst(proc)
+        return inst is not None and inst.kill_replica_gid(gid, peer)

@@ -74,6 +74,7 @@ def make_schedule(
     crash_procs: Sequence[int] = (),
     crash_down_s: float = 1.0,
     kill_procs: Sequence[int] = (),
+    kill_replicas: Sequence[Tuple[int, int]] = (),
     fault_s: Tuple[float, float] = (0.6, 1.8),
     quiet_s: Tuple[float, float] = (0.2, 0.8),
     surge_rate: float = 0.0,
@@ -116,7 +117,14 @@ def make_schedule(
     controller (distributed/placement.py) is what re-places its groups
     onto survivors.  Keep ``kill_procs`` disjoint from ``crash_procs``
     (a crash's restart would resurrect a process the placement layer
-    has already declared dead)."""
+    has already declared dead).
+
+    ``kill_replicas``: one PERMANENT ``kill_replica`` per ``(gid,
+    peer)`` entry — the serving process survives but ONE engine
+    replica row of group ``gid`` never ticks again.  Recovery is the
+    controller's replace-dead-replica policy (learner → catch-up →
+    joint entry → promote), not a restart; this is the fault the
+    self-healing acceptance runs schedule against clerk load."""
     rng = random.Random(seed)
     _pairwise = ("partition", "asym_partition", "partial_partition")
     kinds = [k for k in include if k not in _pairwise or n_procs > 1]
@@ -185,6 +193,16 @@ def make_schedule(
             duration_s * (0.45 + 0.2 * k / max(1, len(kill_procs))), 3
         )
         events.append((at, "kill_mesh_process", {"proc": int(proc)}))
+    for k, (gid, peer) in enumerate(kill_replicas):
+        # Replica kills land early (~30%) so the whole learner →
+        # joint → promote pipeline plays out under the remaining
+        # chaos windows and traffic.
+        at = round(
+            duration_s * (0.3 + 0.2 * k / max(1, len(kill_replicas))), 3
+        )
+        events.append(
+            (at, "kill_replica", {"gid": int(gid), "peer": int(peer)})
+        )
     # The global heal comes strictly after every window has closed —
     # it must be the schedule's last executed action.
     end = max(
@@ -296,11 +314,16 @@ class Nemesis:
         kill: Optional[Callable[[int], None]] = None,
         restart: Optional[Callable[[int], None]] = None,
         surge_fire: Optional[Callable[..., int]] = None,
+        kill_replica: Optional[Callable[[int, int], bool]] = None,
     ) -> None:
         self.addrs = [tuple(a) for a in addrs]
         self.ctl = ChaosClient(self.addrs)
         self._kill = kill
         self._restart = restart
+        # kill_replica(gid, peer) -> bool: permanently kill ONE engine
+        # replica row (the fleet's kill_replica verb) — required only
+        # when the schedule contains kill_replica events.
+        self._kill_replica = kill_replica
         # load_surge burst driver: (host, port, rate, dur, seed) ->
         # replied count.  Injectable so fast tests swap in a fake; the
         # default lazy-imports benchmarks/openloop.py (harness modules
@@ -540,6 +563,22 @@ class Nemesis:
             self._kill(p["proc"])
             self._dead.add(p["proc"])
             w["acked"] = True
+            w["t_stop_us"] = now_us()
+            self._open.pop(id(p), None)
+        elif kind == "kill_replica":
+            # Permanent single-replica death (the process lives):
+            # healing is the placement controller's joint-consensus
+            # replacement, never a restart.
+            if self._kill_replica is None:
+                raise ValueError(
+                    "kill_replica event but no kill_replica callback"
+                )
+            w = self._window(kind, p, [])
+            w["acked"] = bool(
+                self._kill_replica(p["gid"], p["peer"])
+            )
+            if not w["acked"]:
+                w["excused"] = "replica not hosted (already moved?)"
             w["t_stop_us"] = now_us()
             self._open.pop(id(p), None)
         elif kind == "heal":

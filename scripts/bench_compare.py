@@ -102,6 +102,11 @@ FAMILIES: Dict[str, Dict[str, Any]] = {
             ("durable_failover_s", "durable failover time (s)", False),
             ("lost_acked_writes", "acked writes lost", False),
             ("ship_tail_records", "tail records shipped", True),
+            # Self-healing replica sets (r03+): dead-voter replacement
+            # via joint consensus.
+            ("replace_replica_s", "replica replace time (s)", False),
+            ("degraded_quorum_window_s", "degraded quorum window (s)",
+             False),
         ],
     },
 }

@@ -35,6 +35,18 @@ empty adoption, and the report adds ``durable_failover_s``,
 The acceptance comparison against PLACEMENT_r01: the durable failover
 may cost the shipping-replay overhead on top of r01's replace time,
 but never loses an acknowledged write.
+
+``--replace`` runs the SELF-HEALING variant (PLACEMENT_r03): each
+group gets a spare engine replica slot (P=4, voters {0,1,2}); one
+group's LEADER replica is permanently killed under acknowledged-write
+load, and the controller's replace-dead-replica policy heals it
+(learner → catch-up → joint entry → promote — a replicated two-phase
+intent on the placement store).  Reports ``replace_replica_s`` (grace
+deadline to config settled at the new voter set),
+``degraded_quorum_window_s`` (kill to healed), and
+``lost_acked_writes`` (must be 0).  ``--crash-controller`` kills the
+controller mid-reconfig and hands the recorded intent to a fresh one,
+which must RESUME the replacement — never fork membership.
 """
 
 from __future__ import annotations
@@ -304,6 +316,146 @@ def run_durable(procs: int, gpp: int, seed: int, quick: bool) -> dict:
     }
 
 
+def run_replace(procs: int, gpp: int, seed: int, quick: bool,
+                crash_controller: bool = False) -> dict:
+    """PLACEMENT_r03: self-healing replica sets (module docstring).
+
+    One group's leader REPLICA is permanently killed (the process
+    lives); the controller detects the dead voter past ``dead_s``,
+    seats a learner in the spare engine slot, waits for catch-up,
+    appends the joint config entry, and lets the engine auto-promote
+    to the new voter set.  With ``crash_controller`` the first
+    controller is abandoned at the first recorded mid-reconfig phase
+    and a fresh controller finishes from the replicated intent.
+    """
+    from multiraft_tpu.distributed.observe import Observability
+
+    assignment = [
+        [p * gpp + j + 1 for j in range(gpp)] for p in range(procs)
+    ]
+    all_gids = [g for gl in assignment for g in gl]
+    print(f"self-heal fleet: {procs} procs x {gpp} groups {assignment}, "
+          f"seed {seed}, P=4 voters [0,1,2]")
+    fleet = InProcessFleet(assignment, spare_slots=1, seed=seed,
+                           replicas=4, voters=[0, 1, 2])
+    for g in all_gids:
+        fleet.admin("join", [g])
+    fleet.settle()
+    obs = Observability(name="selfheal")
+    clerk = fleet.clerk()
+    kmap = keys_by_gid(fleet)
+
+    transport = LocalFleetTransport(fleet)
+    store = LocalPlacementStore({g: p for p, gl in enumerate(assignment)
+                                 for g in gl})
+    dead_s = 1.0
+
+    def make_controller():
+        # Voluntary moves off (max_moves=0): the run measures replica
+        # healing, not group rebalancing.
+        return PlacementController(
+            transport, store, obs=obs,
+            scrape_s=0.0, dead_s=dead_s, cooldown_s=0.0,
+            min_gain=10.0, max_moves=0,
+        )
+
+    controller = make_controller()
+
+    # Phase 1: acknowledged writes across every group (the ledger the
+    # zero-loss check replays afterwards).
+    n_rounds = 2 if quick else 4
+    expected = {}
+    keys = list(kmap)[: procs * gpp * (4 if quick else 10)]
+    for r in range(n_rounds):
+        for k in keys:
+            clerk.append(k, f"w{r},")
+            expected[k] = expected.get(k, "") + f"w{r},"
+    controller.scrape()
+    fleet.pump_all(2)
+    controller.scrape()
+
+    # Phase 2: permanently kill the victim group's LEADER replica.
+    victim_gid = assignment[0][0]
+    victim_proc = fleet.proc_of(victim_gid)
+    cfg0 = transport.replica_config(victim_proc, victim_gid)
+    victim_peer = int(cfg0["peer"])
+    print(f"killing leader replica (gid {victim_gid}, peer "
+          f"{victim_peer}) — config {cfg0['voters_old']}")
+    t_kill = time.perf_counter()
+    assert fleet.kill_replica(victim_gid, victim_peer)
+
+    crashed_at = None
+    deadline = t_kill + 90.0
+    healed_cfg = None
+    while time.perf_counter() < deadline:
+        controller.step()
+        fleet.pump_all(4)
+        intents = store.reconfig_intents()
+        if (crash_controller and crashed_at is None
+                and victim_gid in intents):
+            # SIGKILL-the-controller moment: abandon it mid-reconfig
+            # (its in-memory ledgers die with it) and bring up a
+            # successor that has ONLY the replicated intent to go on.
+            crashed_at = intents[victim_gid][2]
+            print(f"controller crashed at phase {crashed_at!r}; "
+                  f"successor resumes")
+            controller = make_controller()
+            continue
+        if victim_gid not in intents:
+            cfg = transport.replica_config(
+                fleet.proc_of(victim_gid), victim_gid
+            )
+            if (cfg is not None and not cfg["joint"]
+                    and victim_peer not in cfg["voters_old"]):
+                healed_cfg = cfg
+                break
+    t_healed = time.perf_counter()
+    assert healed_cfg is not None, "replica never replaced"
+    degraded_s = t_healed - t_kill
+    replace_s = max(0.0, degraded_s - dead_s)
+    stats = controller.replace_stats.get(victim_gid)
+    if stats is not None:
+        # The controller's own clock brackets the same interval more
+        # tightly (scrape-observed death, not the kill call).
+        replace_s = stats["replace_replica_s"]
+        degraded_s = stats["degraded_quorum_window_s"]
+
+    # Phase 3: zero acknowledged-write loss + the group still serves.
+    for g in all_gids:
+        k = next(k for k, kg in kmap.items() if kg == g)
+        clerk.put(k, expected.get(k, "") + "post")
+        expected[k] = expected.get(k, "") + "post"
+    lost = sum(1 for k, v in expected.items() if clerk.get(k) != v)
+    counters = dict(obs.metrics.counters)
+    _, pl, _, history = store.query()
+    print(f"replaced leader replica of gid {victim_gid} in "
+          f"{replace_s:.2f}s (degraded-quorum window {degraded_s:.2f}s), "
+          f"{lost} acked write(s) lost, healed config "
+          f"{healed_cfg['voters_old']}")
+
+    return {
+        "replace_replica_s": round(replace_s, 3),
+        "degraded_quorum_window_s": round(degraded_s, 3),
+        "lost_acked_writes": lost,
+        "acked_writes": len(expected),
+        "healed_voters": healed_cfg["voters_old"],
+        "killed": [victim_gid, victim_peer],
+        "crash_controller": int(crash_controller),
+        "crashed_at_phase": crashed_at,
+        "reconfig_begun": int(counters.get("reconfig.begun", 0)),
+        "reconfig_joint_entered": int(
+            counters.get("reconfig.joint_entered", 0)
+        ),
+        "reconfig_completed": int(counters.get("reconfig.completed", 0)),
+        "reconfig_aborted": int(counters.get("reconfig.aborted", 0)),
+        "procs": procs,
+        "groups_per_proc": gpp,
+        "seed": seed,
+        "placement": {str(g): p for g, p in sorted(pl.items())},
+        "history": [list(h) for h in history],
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
@@ -316,8 +468,19 @@ def main() -> int:
     ap.add_argument("--durable", action="store_true",
                     help="durable-failover variant (PLACEMENT_r02): "
                          "sync shipping + stateful recovery")
+    ap.add_argument("--replace", action="store_true",
+                    help="self-healing variant (PLACEMENT_r03): "
+                         "replace a permanently killed replica via "
+                         "joint consensus")
+    ap.add_argument("--crash-controller", action="store_true",
+                    help="with --replace: kill the controller "
+                         "mid-reconfig; a successor must resume")
     args = ap.parse_args()
-    if args.durable:
+    if args.replace:
+        result = run_replace(args.procs, args.groups_per_proc,
+                             args.seed, args.quick,
+                             crash_controller=args.crash_controller)
+    elif args.durable:
         result = run_durable(args.procs, args.groups_per_proc,
                              args.seed, args.quick)
     else:
@@ -330,9 +493,16 @@ def main() -> int:
             f.write(doc + "\n")
         print(f"wrote {args.out}")
     # The scenario's own acceptance: the rebalance must help (r01) /
-    # no acknowledged write may be lost (r02), and the failover must
-    # complete.
-    if args.durable:
+    # no acknowledged write may be lost (r02, r03), and the
+    # failover/replacement must complete inside the deadline.
+    if args.replace:
+        from multiraft_tpu.distributed.placement import place_knobs
+
+        ok = (result["lost_acked_writes"] == 0
+              and result["reconfig_completed"] >= 1
+              and result["replace_replica_s"]
+              < place_knobs()["replace_deadline_s"])
+    elif args.durable:
         ok = (result["lost_acked_writes"] == 0
               and result["durable_failover_s"] < 60.0)
     else:

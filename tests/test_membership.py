@@ -1,0 +1,313 @@
+"""Joint-consensus membership change tests (self-healing replica sets):
+config entries take effect on append, the joint phase demands BOTH
+quorums for elections / commit advance / check-quorum, learners catch up
+without voting, and a reseated peer slot starts as a genuinely fresh
+incarnation (no stale votes from its previous tenant)."""
+
+import numpy as np
+
+from multiraft_tpu.engine.core import (
+    FOLLOWER,
+    LEADER,
+    EngineConfig,
+    membership_default,
+)
+from multiraft_tpu.engine.host import EngineDriver
+
+
+def make(G=1, P=5, seed=0, **kw) -> EngineDriver:
+    cfg = EngineConfig(G=G, P=P, **kw)
+    return EngineDriver(cfg, seed=seed)
+
+
+def _commit(d: EngineDriver, g: int = 0) -> int:
+    return int(d.np_state()["commit"].max(axis=1)[g])
+
+
+def _sever(d: EngineDriver, g: int, peers) -> None:
+    for p in peers:
+        for q in range(d.cfg.P):
+            if q != p:
+                d.set_edge(g, p, q, False)
+                d.set_edge(g, q, p, False)
+
+
+def _heal(d: EngineDriver, g: int) -> None:
+    for s in range(d.cfg.P):
+        for t in range(d.cfg.P):
+            d.set_edge(g, s, t, True)
+
+
+def _settle_config(d: EngineDriver, g: int, target, max_ticks=400) -> bool:
+    """Step until the group's config has collapsed to ``target`` voters
+    (joint exited, old == new) at some leader."""
+    target = sorted(target)
+    for _ in range(max_ticks):
+        d.step()
+        lead = d.leader_of(g)
+        if lead is None:
+            continue
+        c = d.config_of(g)
+        if (not c["joint"] and c["voters_old"] == target
+                and c["voters_new"] == target):
+            return True
+    return False
+
+
+def test_membership_default_and_kill_switch(monkeypatch):
+    """Membership is ON by default; MRT_MEMBERSHIP=0 is the kill
+    switch; the Pallas path gates the machinery off (mask-unaware
+    kernels) and the admin API refuses to start a reconfig there."""
+    monkeypatch.delenv("MRT_MEMBERSHIP", raising=False)
+    assert EngineConfig(G=1, P=3).membership
+    assert EngineConfig(G=1, P=3).membership_on
+    monkeypatch.setenv("MRT_MEMBERSHIP", "0")
+    assert not membership_default()
+    assert not EngineConfig(G=1, P=3).membership
+    forced = EngineConfig(G=1, P=3, membership=True)
+    assert forced.membership and forced.membership_on
+    monkeypatch.delenv("MRT_MEMBERSHIP", raising=False)
+    pallas = EngineConfig(G=1, P=3, use_pallas=True)
+    assert pallas.membership and not pallas.membership_on
+    d = EngineDriver(pallas, seed=0)
+    try:
+        d.begin_joint(0, [0, 1])
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised, "begin_joint must refuse the mask-unaware Pallas path"
+
+
+def test_learner_is_nonvoting_and_catches_up():
+    """A reseated slot joins as a learner: the leader snapshot-fast-
+    forwards and streams it to match, but it never campaigns and its
+    ack never silences check-quorum — adding a peer cannot degrade the
+    group."""
+    d = make(P=4, seed=1)
+    # Initial config {0,1,2}; slot 3 is a dead spare.
+    st = d.state
+    d.state = st._replace(
+        voters_old=st.voters_old.at[0].set(0b0111),
+        voters_new=st.voters_new.at[0].set(0b0111),
+        alive=st.alive.at[0, 3].set(False),
+    )
+    assert d.run_until_quiet_leaders(400)
+    for i in range(10):
+        d.start(0, f"x{i}")
+    for _ in range(120):
+        d.step()
+    assert _commit(d) >= 10
+    d.add_learner(0, 3)
+    caught = False
+    for _ in range(150):
+        d.step()
+        m, last = d.learner_match(0, 3)
+        if m >= last:
+            caught = True
+            break
+    assert caught, "learner never caught up to the leader's last index"
+    st = d.np_state()
+    assert st["role"][0, 3] == FOLLOWER
+    # Its view excludes itself from both voter sets: it cannot campaign.
+    assert not ((int(st["voters_old"][0, 3])
+                 | int(st["voters_new"][0, 3])) >> 3) & 1
+    # Config unchanged by the add: still {0,1,2}, epoch 0.
+    c = d.config_of(0)
+    assert c["voters_old"] == [0, 1, 2] and not c["joint"]
+
+
+def test_joint_requires_both_quorums_for_commit():
+    """Satellite: while C_old,new is in flight, NO commit advances with
+    only one of the two quorums reachable — and the transition
+    completes once the partition heals."""
+    d = make(P=5, seed=3)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    others = [q for q in range(5) if q != lead]
+    a, b = others[0], others[1]  # future co-voters, about to be severed
+    for i in range(3):
+        d.start(0, f"pre-{i}")
+    for _ in range(60):
+        d.step()
+    base_commit = _commit(d)
+    assert base_commit >= 3
+    # Shrink to {lead, a, b}, then isolate a and b: the old quorum
+    # (lead + others[2:]) is intact, the new quorum (2 of {lead,a,b})
+    # is not.
+    _sever(d, 0, [a, b])
+    d.begin_joint(0, [lead, a, b])
+    for i in range(3):
+        d.start(0, f"joint-{i}")
+    for _ in range(4 * d.cfg.ELECT_MAX):
+        d.step()
+    st = d.np_state()
+    # One masked quorum alone moved nothing — not even at the severed
+    # pair, and not the joint entry itself.
+    assert int(st["commit"].max()) == base_commit
+    _heal(d, 0)
+    assert _settle_config(d, 0, [lead, a, b], 600)
+    for i in range(3):
+        d.start(0, f"post-{i}")
+    for _ in range(80):
+        d.step()
+    assert _commit(d) >= base_commit + 6
+    d.check_log_matching(0)
+
+
+def test_joint_leader_demotes_and_old_quorum_cannot_reelect():
+    """Satellite: mid-joint, a leader that loses the NEW quorum demotes
+    (dual-quorum check-quorum) and no candidate wins with the old
+    config alone — leadership needs both quorums until the exit entry
+    lands."""
+    d = make(P=5, seed=5)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    others = [q for q in range(5) if q != lead]
+    a, b = others[0], others[1]
+    _sever(d, 0, [a, b])
+    d.begin_joint(0, [lead, a, b])
+    demoted = False
+    for _ in range(3 * d.cfg.ELECT_MAX):
+        d.step()
+        if d.np_state()["role"][0, lead] != LEADER:
+            demoted = True
+            break
+    assert demoted, "joint leader severed from C_new never demoted"
+    # The reachable majority is an old-config quorum only: nobody can
+    # win an election for several windows.
+    for _ in range(4 * d.cfg.ELECT_MAX):
+        d.step()
+        assert d.leader_of(0) is None, (
+            "a leader was elected by the old config alone mid-joint"
+        )
+    _heal(d, 0)
+    assert _settle_config(d, 0, [lead, a, b], 800)
+    d.check_log_matching(0)
+
+
+def test_config_entry_survives_checkpoint_roundtrip(tmp_path):
+    """Satellite: the five config-state tensors ride the generic
+    checkpoint path — an in-flight joint survives save/restore and
+    completes afterwards (CKPT v4)."""
+    d = make(P=4, seed=7)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    target = [q for q in range(4) if q != (lead + 1) % 4]
+    d.begin_joint(0, target)
+    d.step(2)  # let the joint entry start replicating
+    path = str(tmp_path / "member.ckpt")
+    d.save(path)
+    r = EngineDriver.restore(path)
+    for f in ("voters_old", "voters_new", "joint", "cfg_epoch", "cfg_idx"):
+        assert np.array_equal(
+            np.asarray(getattr(r.state, f)), np.asarray(getattr(d.state, f))
+        ), f"{f} did not round-trip"
+    assert bool(np.asarray(r.state.joint).any())
+    assert _settle_config(r, 0, target, 600)
+    c = r.config_of(0)
+    assert c["epoch"] >= 2 and c["cfg_idx"] > 0
+    r.check_log_matching(0)
+
+
+def test_removed_leader_steps_down_after_exit_commit():
+    """A leader excluded from C_new keeps leading (and committing)
+    through the transition, then demotes once the exit entry commits —
+    and a new-config voter takes over."""
+    d = make(P=4, seed=9)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    target = [q for q in range(4) if q != lead]
+    d.begin_joint(0, target)
+    assert _settle_config(d, 0, target, 600)
+    for _ in range(3 * d.cfg.ELECT_MAX):
+        d.step()
+        new_lead = d.leader_of(0)
+        if new_lead is not None and new_lead != lead:
+            break
+    assert d.np_state()["role"][0, lead] != LEADER
+    assert d.leader_of(0) in target
+    before = _commit(d)
+    for i in range(3):
+        d.start(0, f"after-{i}")
+    for _ in range(100):
+        d.step()
+    assert _commit(d) >= before + 3
+    d.check_log_matching(0)
+
+
+def test_one_config_change_at_a_time():
+    d = make(P=4, seed=11)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    d.begin_joint(0, [q for q in range(4) if q != (lead + 1) % 4])
+    try:
+        d.begin_joint(0, [0, 1])
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised, "overlapping config changes must be refused"
+
+
+def test_reset_replica_clears_stale_cross_replica_state():
+    """Regression (satellite): reseating a peer slot must clear the
+    OTHER replicas' ledgers about it — a stale vote granted by the old
+    incarnation must not count toward a quorum for the new config, and
+    stale match state must not let a leader commit over entries the
+    fresh log never acked.  (Contrast: crash-restart keeps persistent
+    state — that path is exercised by the existing restart tests.)"""
+    d = make(P=4, seed=13)
+    assert d.run_until_quiet_leaders(400)
+    for i in range(5):
+        d.start(0, f"x{i}")
+    for _ in range(80):
+        d.step()
+    victim = (d.leader_of(0) + 1) % 4
+    # Remove the victim from the config first (reseating a live voter
+    # slot is refused — see test_add_learner_refuses_current_voter).
+    d.begin_joint(0, [q for q in range(4) if q != victim])
+    assert _settle_config(d, 0, [q for q in range(4) if q != victim])
+    # Plant the old incarnation's droppings: a granted vote and a
+    # prevote sitting in every candidate's tally column, and a match
+    # entry at a leader.
+    st = d.state
+    d.state = st._replace(
+        votes=st.votes.at[0, :, victim].set(True),
+        pre_votes=st.pre_votes.at[0, :, victim].set(True),
+        match_idx=st.match_idx.at[0, :, victim].set(99),
+        voted_for=st.voted_for.at[0, victim].set(2),
+    )
+    d.set_alive(0, victim, False)
+    d.reset_replica(0, victim)
+    st = d.np_state()
+    assert not st["votes"][0, :, victim].any(), "stale votes survived"
+    assert not st["pre_votes"][0, :, victim].any()
+    assert (st["match_idx"][0, :, victim] == 0).all(), "stale match survived"
+    assert st["voted_for"][0, victim] == -1
+    assert st["term"][0, victim] == 0 and st["log_len"][0, victim] == 0
+    assert not st["alive"][0, victim]  # add_learner raises it
+    # And the full re-add path — learner, then promotion back to a
+    # voter — produces a working group whose quorum the fresh
+    # incarnation earns with NEW votes only.
+    d.add_learner(0, victim)
+    assert d.run_until_quiet_leaders(400)
+    d.begin_joint(0, [0, 1, 2, 3])
+    assert _settle_config(d, 0, [0, 1, 2, 3])
+    before = _commit(d)
+    for i in range(3):
+        d.start(0, f"y{i}")
+    for _ in range(80):
+        d.step()
+    assert _commit(d) >= before + 3
+    d.check_log_matching(0)
+
+
+def test_add_learner_refuses_current_voter():
+    d = make(P=3, seed=15)
+    assert d.run_until_quiet_leaders(400)
+    lead = d.leader_of(0)
+    try:
+        d.add_learner(0, (lead + 1) % 3)
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised, "reseating a live voter slot must be refused"
