@@ -287,7 +287,10 @@ class PorcupineSampler:
         self._lock = threading.Lock()
         self.history: List[Any] = []
         self._threads = [
-            threading.Thread(target=self._run, args=(vi,), daemon=True)
+            threading.Thread(
+                target=self._run, args=(vi,), daemon=True,
+                name=f"porcupine-sampler-{vi}",
+            )
             for vi in range(n_clerks)
         ]
 
@@ -375,6 +378,7 @@ def sweep(
     p99_target_ms: float = 50.0,
     verify: bool = True,
     drain_s: float = 2.0,
+    flame_out: str = "",
 ) -> Dict[str, Any]:
     """Run the full open-loop rate ladder against one served engine
     and return the LOADCURVE report (see module docstring)."""
@@ -407,7 +411,8 @@ def sweep(
                 drain_s=drain_s,
             )
 
-        steps = run_sweep(obs, fire_step, rates)
+        flame: Dict[str, int] = {}
+        steps = run_sweep(obs, fire_step, rates, flame_acc=flame)
         porc = sampler.finish() if sampler is not None else {
             "porcupine": "skipped", "verifier_ops": 0,
         }
@@ -417,6 +422,35 @@ def sweep(
         out["seed"] = seed
         out["step_s"] = step_s
         out["keyspace"] = keyspace
+        # Whole-sweep CPU attribution: the merged fleet flame's top
+        # functions land in the report; the raw flame (collapsed
+        # format, flamegraph.pl/speedscope-ready) goes to flame_out.
+        if flame:
+            from multiraft_tpu.distributed.profile import (
+                to_collapsed, top_functions,
+            )
+
+            # Strip the process prefix for ranking (top_functions
+            # expects "thread;frames" keys, as in one process's dump).
+            # The headline ranks serving threads only — every thread
+            # is sampled every tick, so a parked main thread otherwise
+            # outranks the pegged loop (same cut as profile_window).
+            bare: Dict[str, int] = {}
+            serving: Dict[str, int] = {}
+            for k, v in flame.items():
+                b = k.split(";", 1)[1] if ";" in k else k
+                bare[b] = bare.get(b, 0) + v
+                if b.startswith("multiraft-loop"):
+                    serving[b] = serving.get(b, 0) + v
+            out["profile"] = {
+                "samples": sum(flame.values()),
+                "top": top_functions(serving or bare, 20),
+                "top_all_threads": top_functions(bare, 20),
+            }
+            if flame_out:
+                with open(flame_out, "w") as f:
+                    f.write(to_collapsed(flame) + "\n")
+                out["profile"]["flame_path"] = flame_out
         return out
     finally:
         if sampler is not None and not sampler._stop.is_set():
@@ -430,10 +464,13 @@ def main(argv: List[str]) -> None:
     rates: Sequence[float] = DEFAULT_RATES
     mode, step_s, seed, out_path, verify = "poisson", 4.0, 7, "", True
     target = 50.0
+    flame_out = ""
     it = iter(argv[1:])
     for a in it:
         if a == "--mode":
             mode = next(it)
+        elif a == "--flame":
+            flame_out = next(it)
         elif a == "--rates":
             rates = [float(x) for x in next(it).split(",")]
         elif a == "--step-s":
@@ -450,7 +487,7 @@ def main(argv: List[str]) -> None:
             raise SystemExit(f"unknown arg {a!r}")
     report = sweep(
         rates=rates, step_s=step_s, mode=mode, seed=seed,
-        p99_target_ms=target, verify=verify,
+        p99_target_ms=target, verify=verify, flame_out=flame_out,
     )
     blob = json.dumps(report, indent=1)
     if out_path:

@@ -375,11 +375,13 @@ def bench_firehose_sockets(
             sched.wait(sched.spawn(driver()), 600.0)
 
         threads = [
-            threading.Thread(target=client_main, args=(ci,))
+            threading.Thread(target=client_main, args=(ci,),
+                             name=f"firehose-client-{ci}")
             for ci in range(n_clients)
         ]
         vthreads = [
-            threading.Thread(target=verifier_main, args=(vi,))
+            threading.Thread(target=verifier_main, args=(vi,),
+                             name=f"firehose-verifier-{vi}")
             for vi in range(2)
         ] if verify else []
         t0 = time.perf_counter()

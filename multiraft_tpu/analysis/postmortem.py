@@ -111,6 +111,16 @@ _OVL_BROWNOUT = flightrec.OVERLOAD_KIND_CODES["brownout"]
 # Brownout states (overload.py BrownoutMachine) named for the note.
 _BROWNOUT_NAMES = {0: "healthy", 1: "shedding", 2: "brownout"}
 
+# CPU-saturation evidence bound: a PROF breadcrumb (profile.py, ~1/s)
+# carries process CPU busy per-mille of wall in its code field; a
+# collapse window whose peak busy reaches this is reclassified from
+# "queueing collapse" to "cpu saturation" — the queues diverged because
+# the CPU could not keep up, and the breadcrumb's tag names the hot
+# function.  ~850‰ rather than 1000‰: the sampler's 1 s windows
+# straddle the onset, diluting the pegged fraction.
+def _cpusat_permille() -> int:
+    return int(os.environ.get("MRT_CPUSAT_PERMILLE", "850"))
+
 
 # -- loading ---------------------------------------------------------------
 
@@ -320,11 +330,30 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                 "detail": detail,
                 "aligned": off is not None,
             })
-        # Overload-watch trips → ONE "queueing collapse" anomaly per
-        # ring, anchored on the FIRST saturated stage (a collapse can
-        # leave hundreds of trip records; the first one names where the
+        # Profiler breadcrumbs (PROF, ~1/s): cumulative samples,
+        # distinct stacks, process CPU busy per-mille per window
+        # (code), hottest leaf function (tag) — the sampler's black
+        # box.  Summarized here; consumed below to discriminate the
+        # overload diagnosis.
+        profs = [r for r in recs if r["type"] == flightrec.PROF]
+        if profs:
+            info["profile"] = {
+                "records": len(profs),
+                "samples": profs[-1]["a"],
+                "peak_busy_permille": max(r["code"] for r in profs),
+                "hottest": next(
+                    (r["tag"] for r in reversed(profs) if r["tag"]), ""
+                ),
+            }
+        # Overload-watch trips → ONE collapse anomaly per ring,
+        # anchored on the FIRST saturated stage (a collapse can leave
+        # hundreds of trip records; the first one names where the
         # queueing started).  The paired gauge_ctx record supplies the
-        # queue the collapse backed up into.
+        # queue the collapse backed up into.  The PROF breadcrumbs
+        # then pick the diagnosis: pegged CPU during the collapse
+        # window → "cpu_saturation" (the stage's CPU-seconds fill the
+        # wall window; fix the hot function); CPU idle → the classic
+        # "queueing_collapse" (something downstream stalled).
         over = [r for r in recs if r["type"] == flightrec.OVERLOAD]
         # Brownout transitions are control decisions, not bound trips —
         # excluded from the collapse evidence so the two notes stay
@@ -369,33 +398,63 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                 None,
             )
             if first["code"] == _OVL_STAGE:
-                detail = (
-                    f"queueing collapse: first saturated stage "
+                what = (
+                    f"first saturated stage "
                     f"'{first['tag']}' windowed p99 "
                     f"{first['a'] / 1e3:.1f}ms > bound "
                     f"{first['b'] / 1e3:.1f}ms "
                     f"({first['c']} sample(s) in window)"
                 )
             else:
-                detail = (
-                    f"queueing collapse: queue gauge '{first['tag']}' "
+                what = (
+                    f"queue gauge '{first['tag']}' "
                     f"depth {first['a']} > bound {first['b']}"
                 )
             if gauge is not None:
-                detail += (
+                what += (
                     f"; queue gauge {gauge['tag']}={gauge['a']}"
                     + (f" (bound {gauge['b']})" if gauge["b"] else "")
                 )
-            detail += f"; {len(trips)} overload trip(s) total"
+            what += f"; {len(trips)} overload trip(s) total"
+            # Discrimination: PROF breadcrumbs from the first trip to
+            # the ring's end (fall back to the whole ring if the
+            # sampler died before the trip landed).
+            wprofs = [r for r in profs if r["ts"] >= first["ts"]] or profs
+            busy = max((r["code"] for r in wprofs), default=0)
+            hot = next(
+                (r["tag"] for r in reversed(wprofs) if r["tag"]), ""
+            )
+            if busy >= _cpusat_permille():
+                kind = "cpu_saturation"
+                detail = (
+                    f"CPU saturation: {what}; process CPU busy "
+                    f"{busy}‰ of wall at peak during the collapse"
+                    + (f"; profiler hottest function '{hot}'"
+                       if hot else "")
+                    + " — the stage's CPU-seconds fill the wall window "
+                      "(host-bound): the queue bound is the symptom, "
+                      "the hot function is the fix"
+                )
+            else:
+                kind = "queueing_collapse"
+                detail = f"queueing collapse: {what}"
+                if profs:
+                    detail += (
+                        f"; CPU idle while queues diverged (peak busy "
+                        f"{busy}‰) — a downstream stall, not a CPU "
+                        f"shortage"
+                    )
             anomalies.append({
                 "ts": aligned(first["ts"]), "proc": label,
-                "kind": "queueing_collapse", "detail": detail,
+                "kind": kind, "detail": detail,
                 "aligned": off is not None,
             })
             info["overload"] = {
                 "trips": len(trips),
                 "first": first["tag"],
                 "gauge": gauge["tag"] if gauge is not None else None,
+                "diagnosis": kind,
+                "peak_busy_permille": busy,
             }
         # Placement thrash: PLACE records (the controller's decision
         # log) grouped by gid; the densest window per gid against the
@@ -624,10 +683,13 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
     manifest = bundle.get("manifest") or {}
     offsets = _pid_offsets(manifest)
     total = sum(len(r["records"]) for r in bundle["rings"])
-    out = Tracer(max_events=total + 16 * max(1, len(bundle["rings"])))
+    # ×2: a PROF record can emit a counter AND a hottest-function
+    # instant; every other type emits at most one event.
+    out = Tracer(max_events=2 * total + 16 * max(1, len(bundle["rings"])))
     for ring in bundle["rings"]:
         pid = ring["pid"]
         off = offsets.get(pid, 0.0)
+        last_hot = ""
         addr = _pid_addr(manifest, pid)
         tagbits = "" if pid in offsets else " (unaligned clock)"
         out.process_name(
@@ -693,6 +755,17 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                     pid=pid, group=r["code"], dead_peer=r["a"],
                     new_peer=r["b"], epoch=r["c"], phase=r["tag"],
                 )
+            elif t == flightrec.PROF:
+                out.counter(
+                    "profiler", ts,
+                    {"busy_permille": r["code"], "samples": r["a"],
+                     "stacks": r["b"], "overflow": r["c"]},
+                    pid=pid, track="profile",
+                )
+                if r["tag"] and r["tag"] != last_hot:
+                    last_hot = r["tag"]
+                    out.instant(f"hot:{r['tag']}", ts, track="profile",
+                                pid=pid, busy_permille=r["code"])
             else:  # NODE_CLOSE / MARK / future types
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
                             tag=r["tag"])
@@ -799,6 +872,17 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
                 f"    overload: {o['trips']} trip(s), first saturated: "
                 f"{o['first']}"
                 + (f", queue gauge {o['gauge']}" if o["gauge"] else "")
+                + (f" — diagnosed {o['diagnosis']} "
+                   f"(peak busy {o['peak_busy_permille']}‰)"
+                   if "diagnosis" in o else "")
+            )
+        if "profile" in p:
+            pr = p["profile"]
+            add(
+                f"    profiler: {pr['records']} breadcrumb(s), "
+                f"{pr['samples']} sample(s), peak busy "
+                f"{pr['peak_busy_permille']}‰"
+                + (f", hottest {pr['hottest']}" if pr["hottest"] else "")
             )
         if "shipments" in p:
             gids = ", ".join(

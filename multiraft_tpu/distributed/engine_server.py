@@ -174,10 +174,19 @@ class EngineKVService:
         if flush is not None:
             flush()
         t0 = time.perf_counter()
+        cp0 = time.thread_time()
         self.kv.pump(self._ticks)
         dt = time.perf_counter() - t0
+        cdt = time.thread_time() - cp0
         self.m.inc("pump.count")
         self.m.observe("pump.wall_s", dt)
+        # Wall-vs-CPU split: a tick whose wall ≫ CPU is device-bound
+        # (the host blocked on the accelerator); wall ≈ CPU is
+        # host-bound (binding/resolution burning the loop).  The CPU
+        # side doubles as the engine stage's cost-accounting counter —
+        # the pump IS the engine stage's CPU (observe.py vocabulary).
+        self.m.observe("pump.cpu_s", cdt)
+        self.m.observe("cpu.engine_s", cdt)
         fr = self._frec
         if fr is not None:
             # Tick boundary + (on change only) the consensus frontier.
@@ -425,6 +434,7 @@ class EngineKVService:
             t_start = self.sched.now
             deadline = t_start + self.DEADLINE_S
             while self.sched.now < deadline:
+                cs0 = time.thread_time() if stages is not None else 0.0
                 t = self.kv.submit(
                     g,
                     KVOp(
@@ -435,6 +445,14 @@ class EngineKVService:
                         command_id=args.command_id,
                     ),
                 )
+                if stages is not None:
+                    # The submit's binding cost runs in a coroutine
+                    # step the dispatcher's synchronous cpu.handler_s
+                    # segment can't see — fold it here (segment
+                    # accounting: this CPU lands nowhere else).
+                    self.m.observe(
+                        "cpu.handler_s", time.thread_time() - cs0
+                    )
                 if stages is not None and not stages.engine:
                     # First submit closes the handler leg; resubmits
                     # stay inside the engine leg (they ARE the engine's

@@ -105,6 +105,12 @@ WEDGE = 17       # code=group a=stall_ticks b=commit_index c=backlog
 CONFIG = 18      # code=gid a=dead_peer b=new_peer c=config_epoch
 #                  tag=phase ("learner"|"catchup"|"joint"|"done"|
 #                  "abort"; placement.py replace-dead-replica legs)
+PROF = 19        # code=cpu_busy_permille a=samples b=distinct_stacks
+#                  c=overflow / tag=hottest leaf function (profile.py
+#                  sampler breadcrumb, ~1/s: a SIGKILL'd process still
+#                  names what it was burning CPU on; code is process
+#                  CPU over wall for the window ×1000 — the doctor's
+#                  cpu_saturation vs queueing_collapse evidence)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -125,6 +131,7 @@ _TYPE_NAMES = {
     SHIP: "ship",
     WEDGE: "wedge",
     CONFIG: "config",
+    PROF: "prof",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.

@@ -22,6 +22,11 @@ The service verbs:
 * ``Obs.trace``    — drain the trace buffer.  Drain, not read: repeated
   scrapes never duplicate events, and the server's memory stays bounded
   by ``max_events`` between scrapes (drops are counted and reported).
+* ``Obs.profile``  — drain the process's continuous sampling profiler
+  (profile.py): the folded-stack aggregate since the previous scrape.
+  Drain-on-read like ``Obs.trace`` (pass ``{"reset": False}`` for a
+  non-destructive peek); control-exempt like every Obs verb, so chaos
+  cannot partition the profiler away.
 
 Timestamps everywhere are ``time.perf_counter() * 1e6`` — the same
 clock the RPC spans and engine tick spans already use, so one process's
@@ -31,6 +36,7 @@ events need only a constant offset to land on the scraper's timeline.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -62,6 +68,22 @@ def is_control(svc_meth: str) -> bool:
 def now_us() -> float:
     """This process's trace clock (µs, arbitrary epoch, monotonic)."""
     return time.perf_counter() * 1e6
+
+
+try:
+    _PAGE_MB = os.sysconf("SC_PAGE_SIZE") / (1024.0 * 1024.0)
+except (ValueError, OSError, AttributeError):  # non-POSIX
+    _PAGE_MB = 4096.0 / (1024.0 * 1024.0)
+
+
+def _rss_mb() -> Optional[float]:
+    """Resident set size in MB via /proc/self/statm (one small read,
+    no fork, no psutil); None where /proc is absent."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return float(int(f.read().split()[1])) * _PAGE_MB
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -97,6 +119,31 @@ def now_us() -> float:
 # ``MRT_STAGECLOCK=0`` compiles the whole plane out (no send stamp, no
 # StageClock allocation, no folds) — the A/B lever for the overhead
 # budget in BENCHMARKS.
+#
+# CPU-SECONDS twins (``cpu.<stage>_s``, profiling plane): the same
+# stage vocabulary carries explicit cost accounting — thread-CPU-clock
+# deltas around each synchronous serve-path segment, observed into the
+# same mergeable Hist machinery (so loadcurve windows them with
+# Hist.sub exactly like the wall stages, and Hist.total is the
+# window's CPU-seconds sum).  Segment accounting, not per-request:
+# each loop-thread CPU second lands in exactly ONE stage, so the sums
+# never double-count under pipelining —
+#
+#   cpu.wire_s      ingress frame decode (tcp._on_event)
+#   cpu.dispatch_s  dispatch bookkeeping: admission, stage setup,
+#                   handler lookup (tcp._dispatch entry → handler call)
+#   cpu.handler_s   synchronous handler execution; engine write ops
+#                   add their per-submit binding cost from the
+#                   generator body (engine_server.command)
+#   cpu.engine_s    pump tick CPU (engine_server._pump_loop) — the
+#                   engine stage's CPU *is* the pump
+#   cpu.ack_s       completion bookkeeping (tcp._dispatch._done)
+#   cpu.flush_s     reply encode + vectored write (tcp._flush_replies)
+#
+# Coroutine-step scheduler overhead and generator bookkeeping outside
+# the wrapped segments are not attributed (the sampling profiler is
+# the exact lens); the counters answer "which stage burns the loop's
+# CPU" at ~zero cost.  They ride the MRT_STAGECLOCK kill switch.
 
 STAGES = ("wire", "dispatch", "handler", "engine", "ack", "flush", "total")
 
@@ -262,6 +309,16 @@ class ObsControl:
             # is stalled with proposals pending — gray-failure liveness
             # visible in a scrape, before the postmortem.
             out["gauge.wedged_groups"] = float(len(ww.wedged))
+        # Process resource gauges (stdlib only — no psutil): the CPU
+        # clock is cumulative, so two scrapes diff into the window's
+        # CPU-seconds; against the wall window that says whether the
+        # process is CPU-pegged (the loadcurve records all three per
+        # step).  rss via /proc/self/statm on Linux; absent elsewhere.
+        out["gauge.cpu_s"] = time.process_time()
+        out["gauge.threads"] = float(threading.active_count())
+        rss = _rss_mb()
+        if rss is not None:
+            out["gauge.rss_mb"] = rss
         return out
 
     def hist(self, args: Any = None) -> Dict[str, Any]:
@@ -380,6 +437,28 @@ class ObsControl:
             "now_us": now_us(),
             "events": events,
             "dropped": dropped,
+        }
+
+    def profile(self, args: Any = None) -> Dict[str, Any]:
+        """Drain the process's sampling profiler (profile.py) — the
+        folded-stack aggregate since the previous scrape, plus the
+        sampler's own health/overhead telemetry.  ``{"reset": False}``
+        peeks without draining.  ``profile`` is None when the sampler
+        is disabled (MRT_PROFILE=0) or never started in this process —
+        an explicit marker, so a fleet merge can tell "no CPU burned"
+        from "not profiling"."""
+        from .profile import get_profiler
+
+        reset = not (isinstance(args, dict) and args.get("reset") is False)
+        prof = get_profiler()
+        return {
+            "name": self._node.obs.name,
+            "pid": os.getpid(),
+            "now_us": now_us(),
+            "profile": (
+                None if prof is None
+                else (prof.drain() if reset else prof.snapshot())
+            ),
         }
 
 

@@ -92,7 +92,7 @@ class RealtimeScheduler:
     :meth:`post` and :meth:`wait`.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "multiraft-loop") -> None:
         self._origin = time.monotonic()
         self._heap: list[tuple[float, int, Timer]] = []
         self._seq = 0
@@ -104,8 +104,12 @@ class RealtimeScheduler:
         # runs goes through its duration-budget shim.  None = off =
         # one `is None` check per dispatch.
         self._san = get_sanitizer()
+        # ``name`` is the loop thread's name — the profiler keys CPU
+        # attribution by it (profile.py), so multi-node processes pass
+        # a per-node suffix ("multiraft-loop/9001") to keep their
+        # loops distinguishable in the fleet flame.
         self._thread = threading.Thread(
-            target=self._run, name="multiraft-loop", daemon=True
+            target=self._run, name=name, daemon=True
         )
         self._thread.start()
 
@@ -325,13 +329,14 @@ class IoScheduler(RealtimeScheduler):
         io_wake: Callable[[], None],
         idle_max: float = 0.2,
         io_flush: Optional[Callable[[bool], None]] = None,
+        name: str = "multiraft-loop",
     ) -> None:
         self._io_poll = io_poll
         self._io_handle = io_handle
         self._io_wake = io_wake
         self._io_flush = io_flush
         self._idle_max = idle_max
-        super().__init__()
+        super().__init__(name=name)
 
     def flush_io(self) -> None:
         """Run the io_flush hook forced, from the loop thread.  The

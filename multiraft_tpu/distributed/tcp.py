@@ -82,6 +82,7 @@ from .observe import (
     is_control,
     stageclock_enabled,
 )
+from .profile import maybe_start_profiler
 from .realtime import IoScheduler
 from .sanitize import get_sanitizer
 
@@ -211,6 +212,10 @@ class RpcNode:
         # ONLY, bounded by _REPLY_Q_CAP like its twin.
         self._outq_stamps: Dict[int, List[float]] = {}
         install_obs(self)
+        # Continuous sampling profiler (profile.py): one per-process
+        # daemon sampler shared by every node, default-on (MRT_PROFILE
+        # gates), drained over this node's socket via Obs.profile.
+        maybe_start_profiler()
         # Crash-surviving black box (flightrec.py): fixed-width event
         # records in an mmap ring, shared process-wide, env-gated
         # (MRT_FLIGHTREC_DIR).  None = disabled = zero hot-path cost
@@ -263,9 +268,14 @@ class RpcNode:
         # The loop thread doubles as the transport's read reactor; it
         # owns all handler execution and future resolution.  io_flush
         # drains the reply queue once per loop iteration.
+        # Loop thread named per node (listeners by port, clients by a
+        # process-local seq) — profiler attribution and postmortem
+        # lines stay readable when one process hosts several nodes.
         self.sched = IoScheduler(
             self._tr.poll, self._on_event, self._tr.wake,
             io_flush=self._flush_replies,
+            name=(f"multiraft-loop/{self.port}" if listen
+                  else f"multiraft-loop/client{next(RpcNode._trace_seq)}"),
         )
 
     # -- service side ------------------------------------------------------
@@ -416,7 +426,13 @@ class RpcNode:
             m.inc("rpc.frames_in")
             m.inc("rpc.bytes_in", len(payload))
             try:
+                # cpu.wire_s: ingress decode's CPU cost (thread-CPU
+                # delta around the decode — the profiling plane's
+                # cost-accounting twin of the wall stage clock).
+                c0 = time.thread_time() if t_read is not None else 0.0
                 msg = codec.decode(payload)
+                if t_read is not None:
+                    m.observe("cpu.wire_s", time.thread_time() - c0)
                 if self._dbg:
                     # Tracing must never affect delivery: a repr or
                     # stderr failure here is swallowed, not treated
@@ -577,6 +593,7 @@ class RpcNode:
         obs = self.obs
         obs.metrics.inc("rpc.handled")
         t0 = time.perf_counter()
+        c0 = time.thread_time() if self._stageclock else None
 
         # Stage clock: a tuple rid element is (rid, t_send) from a
         # stage-clocked caller.  Fold the wire leg (send → socket read)
@@ -603,6 +620,7 @@ class RpcNode:
         frec = self._frec
 
         def _done(conn_, req_id_, value):
+            ca = time.thread_time() if c0 is not None else 0.0
             if adm is not None:
                 # Frees this dispatch's slot in the bounded
                 # per-connection queue (pairs with the admit above).
@@ -629,6 +647,10 @@ class RpcNode:
                     svc_meth, t0 * 1e6, dt * 1e6, track="rpc", **sargs
                 )
             reply(conn_, req_id_, value)
+            if c0 is not None:
+                # cpu.ack_s: completion bookkeeping + reply enqueue
+                # (the flush write itself lands in cpu.flush_s).
+                obs.metrics.observe("cpu.ack_s", time.thread_time() - ca)
 
         try:
             handler = self._handlers.get(svc_meth)
@@ -645,7 +667,19 @@ class RpcNode:
             self._cur_conn = conn
             self._cur_trace = trace_id
             self._cur_stages = st
-            result = handler(args)
+            if c0 is not None:
+                # cpu.dispatch_s: admission + stage setup + handler
+                # lookup; cpu.handler_s: the synchronous handler body
+                # (generator handlers count creation here and fold
+                # their own submit cost — see engine_server.command).
+                ch = time.thread_time()
+                obs.metrics.observe("cpu.dispatch_s", ch - c0)
+                result = handler(args)
+                obs.metrics.observe(
+                    "cpu.handler_s", time.thread_time() - ch
+                )
+            else:
+                result = handler(args)
         except Exception:
             obs.metrics.inc("rpc.handler_errors")
             result = None
@@ -766,6 +800,7 @@ class RpcNode:
         self._outq = {}
         stamps_by_conn, self._outq_stamps = self._outq_stamps, {}
         m = self.obs.metrics
+        cf = time.thread_time() if self._stageclock else None
         if stamps_by_conn:
             # Flush-stage fold: how long each reply coalesced between
             # enqueue and this vectored write (stat-only; folded even
@@ -812,6 +847,10 @@ class RpcNode:
                 m.observe("rpc.frames_per_flush", float(len(pairs)))
             except Exception:
                 m.inc("rpc.reply_send_fail", len(pairs))
+        if cf is not None:
+            # cpu.flush_s: reply encode + vectored write for the whole
+            # batch (one segment per flush, not per reply).
+            m.observe("cpu.flush_s", time.thread_time() - cf)
 
     def sever(
         self,

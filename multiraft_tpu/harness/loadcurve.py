@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..distributed.profile import top_functions
 from ..utils.metrics import Hist
 from .observe import FleetObserver
 
@@ -36,7 +37,10 @@ __all__ = [
     "scrape_hists",
     "window_hists",
     "stage_stats",
+    "cpu_stage_stats",
     "gauge_peaks",
+    "window_proc_cpu_s",
+    "profile_window",
     "find_knee",
     "max_sustainable",
     "run_sweep",
@@ -117,6 +121,86 @@ def stage_stats(windows: Dict[str, Hist]) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def cpu_stage_stats(windows: Dict[str, Hist]) -> Dict[str, Dict[str, Any]]:
+    """Per-stage CPU cost accounting for one window: the ``cpu.*_s``
+    twins of the wall stages (observe.py's segment-accounting
+    vocabulary).  ``cpu_s`` is the window's fleet-wide CPU-seconds sum
+    for the stage (Hist.total diffs exactly, like counts), ``count``
+    the number of segments — together they answer "which stage burned
+    the loop's CPU this step"."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, h in sorted(windows.items()):
+        if not (name.startswith("cpu.") and name.endswith("_s")):
+            continue
+        stage = name[len("cpu."):-len("_s")]
+        out[stage] = {
+            "count": h.count,
+            "cpu_s": round(h.total, 6),
+        }
+    return out
+
+
+def window_proc_cpu_s(
+    before: Dict[str, Dict[str, Any]],
+    after: Dict[str, Dict[str, Any]],
+) -> Optional[float]:
+    """Fleet-wide process CPU-seconds burned between two scrapes —
+    ``gauge.cpu_s`` (the cumulative process CPU clock) diffed per
+    process and summed.  Against the step's wall time this says
+    whether the fleet was CPU-pegged; None when no process reported
+    the gauge on both sides."""
+    total, seen = 0.0, False
+    for key, snap in after.items():
+        if snap.get("missing"):
+            continue
+        a = (snap.get("gauges") or {}).get("gauge.cpu_s")
+        b = ((before.get(key) or {}).get("gauges") or {}).get("gauge.cpu_s")
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            total += max(0.0, float(a) - float(b))
+            seen = True
+    return round(total, 6) if seen else None
+
+
+def profile_window(
+    obs: FleetObserver, topn: int = 15
+) -> Dict[str, Any]:
+    """Drain the fleet's sampling profilers (``Obs.profile``) and fold
+    the window into its attribution summary: total samples, per-thread
+    totals, the top-N functions by self samples — plus the raw merged
+    ``flame`` (folded stacks, process-name-prefixed) for callers that
+    accumulate a whole-sweep flamegraph.  Drain-on-read gives the same
+    windowing the histogram scrapes get from cumulative-diff: each
+    call returns exactly the samples since the previous one."""
+    dumps = obs.profile_all()
+    flame = FleetObserver.fleet_flame(dumps)
+    # Fleet-flame keys are "proc;thread;frames..." — attribution rows
+    # are the proc;thread pair (per_thread_totals alone would stop at
+    # the process segment).
+    threads: Dict[str, int] = {}
+    unprefixed: Dict[str, int] = {}
+    serving: Dict[str, int] = {}
+    for k, v in flame.items():
+        row = ";".join(k.split(";", 2)[:2])
+        threads[row] = threads.get(row, 0) + int(v)
+        bare = k.split(";", 1)[1] if ";" in k else k
+        unprefixed[bare] = unprefixed.get(bare, 0) + int(v)
+        # The sampler records every thread every tick — a main thread
+        # parked in sleep shows the same sample rate as a pegged loop.
+        # The serving-thread cut ranks only the per-node loop threads
+        # ("multiraft-loop*", which also run the engine pump), so the
+        # headline names what serving CPU was spent on rather than
+        # where idle threads happened to be parked.
+        if bare.startswith("multiraft-loop"):
+            serving[bare] = serving.get(bare, 0) + int(v)
+    return {
+        "samples": sum(flame.values()),
+        "per_thread": threads,
+        "top": top_functions(serving or unprefixed, topn),
+        "top_all_threads": top_functions(unprefixed, topn),
+        "flame": flame,
+    }
+
+
 def gauge_peaks(after: Dict[str, Dict[str, Any]]) -> Dict[str, float]:
     """Max of each queue gauge across the fleet at scrape time — the
     step's congestion witness next to its latency decomposition."""
@@ -179,22 +263,41 @@ def run_sweep(
     obs: FleetObserver,
     fire_step: Callable[[float], Dict[str, Any]],
     rates: Sequence[float],
+    profile_topn: int = 15,
+    flame_acc: Optional[Dict[str, int]] = None,
 ) -> List[Dict[str, Any]]:
     """Step the offered rate up the ladder: scrape → fire → scrape,
-    attach the windowed per-stage decomposition and queue-gauge peaks
-    to whatever the step driver returned.  ``fire_step(rate)`` runs one
+    attach the windowed per-stage decomposition (wall AND cpu), the
+    queue-gauge peaks, the window's process-CPU burn, and the window's
+    profiler attribution (top functions + per-thread samples) to
+    whatever the step driver returned.  ``fire_step(rate)`` runs one
     open-loop step to completion (including its drain grace, so the
     closing scrape sees the step's replies) and returns its client-side
-    record (offered/achieved rate, client p50/p99, drops)."""
+    record (offered/achieved rate, client p50/p99, drops).
+
+    ``flame_acc`` (mutated in place when given) accumulates the merged
+    fleet flame across every step — the whole-sweep flamegraph the
+    loadcurve CLI writes next to the round file.  The profiler is
+    drained once before the ladder so step 1's window excludes warmup."""
     steps: List[Dict[str, Any]] = []
     before = scrape_hists(obs)
+    obs.profile_all()  # drain: the ladder starts with a clean window
     for rate in rates:
         res = dict(fire_step(float(rate)))
         after = scrape_hists(obs)
         win = window_hists(before, after)
+        prof = profile_window(obs, topn=profile_topn)
         res["offered_rate"] = float(rate)
         res["stages"] = stage_stats(win)
+        res["cpu"] = cpu_stage_stats(win)
         res["gauges"] = gauge_peaks(after)
+        res["proc_cpu_s"] = window_proc_cpu_s(before, after)
+        if flame_acc is not None:
+            for k, v in prof.pop("flame").items():
+                flame_acc[k] = flame_acc.get(k, 0) + v
+        else:
+            prof.pop("flame")
+        res["profile"] = prof
         steps.append(res)
         before = after  # next step's window starts where this ended
     return steps
@@ -223,7 +326,7 @@ def build_loadcurve(
             "index": knee_i,
         }
     sustainable = max_sustainable(rates, p99s, p99_target_ms)
-    return {
+    out = {
         "steps": list(steps),
         "curve": {
             "offered_rate": rates,
@@ -240,3 +343,27 @@ def build_loadcurve(
         "p99_target_ms": p99_target_ms,
         "max_sustainable_ops_per_sec": sustainable,
     }
+    # CPU-attribution headline columns (bench_compare --family cpu):
+    # per-stage CPU-µs per acknowledged op at the KNEE step — the
+    # comparable operating point — plus the profiler's top functions
+    # at the knee and at saturation (the top of the ladder).  Absent
+    # in pre-profiling rounds → n/a in the gate, never a regression.
+    if knee_i is not None:
+        ks = steps[knee_i]
+        ok = ks.get("ok") or 0
+        total_us = 0.0
+        for stage, rec in (ks.get("cpu") or {}).items():
+            if ok and isinstance(rec.get("cpu_s"), (int, float)):
+                us = 1e6 * float(rec["cpu_s"]) / ok
+                out[f"cpu_{stage}_us_per_op"] = round(us, 2)
+                total_us += us
+        if ok and total_us:
+            out["cpu_total_us_per_op"] = round(total_us, 2)
+        out["top_funcs_at_knee"] = (
+            (ks.get("profile") or {}).get("top") or []
+        )[:5]
+    if steps:
+        out["top_funcs_at_saturation"] = (
+            (steps[-1].get("profile") or {}).get("top") or []
+        )[:5]
+    return out

@@ -130,6 +130,79 @@ class FleetObserver:
         :meth:`snapshot_all`'s missing markers)."""
         return {f"{a[0]}:{a[1]}": self.hist(a) for a in self.addrs}
 
+    def profile(
+        self, addr: Addr, reset: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """One process's sampling-profiler aggregate (``Obs.profile``,
+        profile.py).  Drain-on-read by default — each scrape returns
+        exactly the samples taken since the previous one, the same
+        windowing discipline the loadcurve uses; ``reset=False``
+        peeks."""
+        args = None if reset else {"reset": False}
+        return self.call(addr, "profile", args, timeout=5.0)
+
+    def profile_all(
+        self, reset: bool = True
+    ) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Scrape ``Obs.profile`` fleet-wide: ``{"host:port": reply}``,
+        ``None`` for unreachable processes."""
+        return {
+            f"{a[0]}:{a[1]}": self.profile(a, reset) for a in self.addrs
+        }
+
+    @staticmethod
+    def fleet_flame(
+        dumps: Dict[str, Optional[Dict[str, Any]]],
+    ) -> Dict[str, int]:
+        """Merge per-process ``Obs.profile`` replies into ONE folded
+        aggregate — the fleet flame.  Each process's stacks are
+        prefixed with its Observability name (``pid123:9001;
+        multiraft-loop/9001;tcp._run;...``), so one flamegraph shows
+        the whole fleet with per-process, per-thread attribution.
+        Unreachable (None) and not-profiling (``profile: None``)
+        processes contribute nothing — the caller can tell them apart
+        in ``dumps`` itself."""
+        from ..distributed.profile import merge_folded
+
+        parts: List[Dict[str, int]] = []
+        for key, reply in dumps.items():
+            prof = (reply or {}).get("profile")
+            if not prof:
+                continue
+            name = str((reply or {}).get("name") or key)
+            parts.append({
+                f"{name};{stack}": n
+                for stack, n in (prof.get("stacks") or {}).items()
+            })
+        return merge_folded(parts)
+
+    @staticmethod
+    def profile_counter_track(
+        tracer: Tracer,
+        dumps: Dict[str, Optional[Dict[str, Any]]],
+        ts_us: Optional[float] = None,
+    ) -> None:
+        """Emit one Perfetto counter sample per process from a
+        ``profile_all`` scrape — per-thread sample counts on a
+        ``cpu_samples`` track (repeated scrapes across a sweep render
+        as the fleet's CPU-attribution area chart next to the latency
+        tracks)."""
+        from ..distributed.profile import per_thread_totals
+
+        if ts_us is None:
+            ts_us = now_us()
+        for pid, (key, reply) in enumerate(sorted(dumps.items())):
+            prof = (reply or {}).get("profile")
+            if not prof:
+                continue
+            totals = per_thread_totals(prof.get("stacks") or {})
+            if totals:
+                tracer.counter(
+                    "cpu_samples", ts_us,
+                    {t: float(n) for t, n in sorted(totals.items())},
+                    pid=pid + 1, track="profile",
+                )
+
     # -- clock alignment ---------------------------------------------------
 
     def clock_offset_us(

@@ -23,7 +23,12 @@ and must keep meaning what it meant):
   ``PLACEMENT_r*.json`` (per-process commit-rate spread reduction
   after rebalancing a hot/cold skew, failover re-place time after a
   process kill, migrations executed — fewer is better: the planner
-  should fix the skew with minimal movement).
+  should fix the skew with minimal movement);
+* ``cpu`` — the profiling plane's CPU-attribution columns inside the
+  SAME ``LOADCURVE_r*.json`` rounds (per-stage CPU-µs per acknowledged
+  op at the knee step, lower is better — the cost-accounting gate the
+  front-door rebuild proves its wins against; rounds recorded before
+  the profiling plane lack the columns and read n/a).
 
 ``FRESH.json`` is either the family's raw result object or a round
 wrapper (``{"parsed": {...}}``).  The history is every round file of
@@ -107,6 +112,24 @@ FAMILIES: Dict[str, Dict[str, Any]] = {
             ("replace_replica_s", "replica replace time (s)", False),
             ("degraded_quorum_window_s", "degraded quorum window (s)",
              False),
+        ],
+    },
+    # CPU cost accounting rides the loadcurve rounds: same history
+    # files, different metric table — per-stage CPU-µs per op at the
+    # knee (observe.py's segment-accounting vocabulary).  Direction:
+    # burning MORE CPU per op at the same operating point is the
+    # regression, whatever the latency curve did.
+    "cpu": {
+        "history": "LOADCURVE_r*.json",
+        "strip": "LOADCURVE_",
+        "metrics": [
+            ("cpu_total_us_per_op", "total CPU (µs/op)", False),
+            ("cpu_wire_us_per_op", "wire CPU (µs/op)", False),
+            ("cpu_dispatch_us_per_op", "dispatch CPU (µs/op)", False),
+            ("cpu_handler_us_per_op", "handler CPU (µs/op)", False),
+            ("cpu_engine_us_per_op", "engine CPU (µs/op)", False),
+            ("cpu_ack_us_per_op", "ack CPU (µs/op)", False),
+            ("cpu_flush_us_per_op", "flush CPU (µs/op)", False),
         ],
     },
 }

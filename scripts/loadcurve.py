@@ -62,6 +62,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="output path (default: next LOADCURVE_rNN.json)")
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the porcupine sampler clerks")
+    ap.add_argument("--flame", default="",
+                    help="write the merged fleet flame (collapsed "
+                         "folded-stack format) to this path")
     ap.add_argument("--compare", action="store_true",
                     help="gate against the recorded LOADCURVE trajectory "
                          "(exit 1 on regression)")
@@ -75,6 +78,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = sweep(
         rates=rates, step_s=ns.step_s, mode=ns.mode, seed=ns.seed,
         p99_target_ms=ns.p99_target_ms, verify=not ns.no_verify,
+        flame_out=ns.flame,
     )
     rc = 0
     if ns.compare:
@@ -112,6 +116,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"({report.get('verifier_ops')} sampled op(s))",
         flush=True,
     )
+    prof = report.get("profile") or {}
+    if prof.get("top"):
+        hot = prof["top"][0]
+        print(
+            f"  profile: {prof.get('samples')} sample(s), hottest "
+            f"{hot['func']} (self {hot['self']})"
+            + (f" -> {prof['flame_path']}" if prof.get("flame_path")
+               else ""),
+            flush=True,
+        )
 
     return rc
 

@@ -1,0 +1,207 @@
+"""Summarize a profiling-plane artifact: the CPU attribution report.
+
+    python scripts/profile_summary.py PROFILE [--top N] [--threads]
+                                      [--diff BASE] [--folded OUT]
+
+``PROFILE`` is either
+
+* a **collapsed fleet flame** (``stack count`` lines — what
+  ``scripts/loadcurve.py --flame`` and the nightly CI artifact write;
+  stacks are ``proc;thread;mod.fn;...``), or
+* a **LOADCURVE round** (``LOADCURVE_r*.json``): the per-stage CPU
+  cost table per sweep step plus the recorded top functions at the
+  knee and at saturation.
+
+The format is sniffed from the content (JSON object → round), not the
+suffix.  For a flame:
+
+* default — top-N functions by SELF samples (where the CPU actually
+  was), with cumulative counts alongside;
+* ``--threads``  — per-``proc;thread`` sample totals (the profiler
+  keys attribution by thread NAME — this is why every long-lived
+  thread is named at its spawn site);
+* ``--diff BASE`` — subtract another flame (per-stack, clamped at 0)
+  and rank what GREW: the before/after lens for a serving
+  optimisation ("which functions did the change add CPU to");
+* ``--folded OUT`` — write the (possibly diffed) folded stacks back
+  out, flamegraph.pl / speedscope-ready.
+
+Exit status: 0 on success, 2 when an input is unreadable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from multiraft_tpu.distributed.profile import (  # noqa: E402
+    diff_folded,
+    from_collapsed,
+    to_collapsed,
+    top_functions,
+)
+
+
+def load_profile(path: str) -> Any:
+    """A parsed round dict (JSON object) or a folded dict (collapsed
+    text); raises ValueError when neither."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+        raise ValueError(f"{path}: JSON but not a round object")
+    except json.JSONDecodeError:
+        pass
+    folded = from_collapsed(text)
+    if not folded:
+        raise ValueError(f"{path}: neither a LOADCURVE round nor "
+                         f"collapsed folded stacks")
+    return folded
+
+
+def _fmt_top(top: List[Dict[str, Any]], indent: str = "  ") -> str:
+    if not top:
+        return f"{indent}(no samples)"
+    w = max(len(t["func"]) for t in top)
+    return "\n".join(
+        f"{indent}{t['func']:<{w}s}  self {t['self']:>7d}  "
+        f"cum {t['cum']:>7d}"
+        for t in top
+    )
+
+
+def summarize_round(doc: Dict[str, Any], topn: int) -> int:
+    """Per-stage CPU table + recorded attribution of a LOADCURVE round."""
+    steps = doc.get("steps") or []
+    if not steps:
+        print("profile_summary: round has no steps", file=sys.stderr)
+        return 2
+    stages = sorted({s for st in steps for s in (st.get("cpu") or {})})
+    if stages:
+        hdr = "  ".join(f"{s:>10s}" for s in stages)
+        print(f"{'offered':>8s} {'ok':>7s} {'procCPU_s':>9s}  {hdr}"
+              f"   (stage CPU seconds per step window)")
+        for st in steps:
+            cpu = st.get("cpu") or {}
+            row = "  ".join(
+                f"{(cpu.get(s) or {}).get('cpu_s', 0.0):>10.3f}"
+                for s in stages
+            )
+            pc = st.get("proc_cpu_s")
+            print(
+                f"{float(st.get('offered_rate') or 0):>8.0f} "
+                f"{int(st.get('ok') or 0):>7d} "
+                f"{pc if pc is not None else float('nan'):>9.3f}  {row}"
+            )
+    else:
+        print("(no cpu.* stage columns — pre-profiling round)")
+    per_op = {
+        k: v for k, v in doc.items()
+        if k.startswith("cpu_") and k.endswith("_us_per_op")
+    }
+    if per_op:
+        print("\nCPU per acknowledged op at the knee:")
+        for k in sorted(per_op):
+            print(f"  {k[len('cpu_'):-len('_us_per_op')]:>9s}: "
+                  f"{per_op[k]:.2f} µs/op")
+    for label, key in (
+        ("knee", "top_funcs_at_knee"),
+        ("saturation", "top_funcs_at_saturation"),
+    ):
+        top = doc.get(key)
+        if top:
+            print(f"\ntop functions at {label}:")
+            print(_fmt_top(top[:topn]))
+    prof = doc.get("profile") or {}
+    if prof.get("top"):
+        print(f"\ntop functions, whole sweep "
+              f"({prof.get('samples')} samples):")
+        print(_fmt_top(prof["top"][:topn]))
+    return 0
+
+
+def summarize_flame(
+    flame: Dict[str, int],
+    topn: int,
+    threads: bool,
+    base: Optional[Dict[str, int]],
+    folded_out: str,
+) -> int:
+    if base is not None:
+        flame = diff_folded(flame, base)
+        print(f"diff: {sum(flame.values())} net new sample(s)")
+    if folded_out:
+        with open(folded_out, "w") as f:
+            f.write(to_collapsed(flame) + "\n")
+        print(f"folded -> {folded_out}")
+    if threads:
+        totals: Dict[str, int] = {}
+        for k, v in flame.items():
+            row = ";".join(k.split(";", 2)[:2])
+            totals[row] = totals.get(row, 0) + v
+        w = max((len(t) for t in totals), default=1)
+        print(f"samples by thread ({sum(totals.values())} total):")
+        for t, n in sorted(totals.items(), key=lambda kv: -kv[1]):
+            print(f"  {t:<{w}s}  {n:>7d}")
+        return 0
+    # Rank with the process prefix stripped (top_functions expects
+    # "thread;frames" keys); a single-process dump passes through.
+    bare: Dict[str, int] = {}
+    for k, v in flame.items():
+        b = k.split(";", 1)[1] if ";" in k else k
+        bare[b] = bare.get(b, 0) + v
+    print(f"top functions by self samples "
+          f"({sum(flame.values())} total):")
+    print(_fmt_top(top_functions(bare, topn)))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="profile_summary")
+    ap.add_argument("profile",
+                    help="collapsed flame file or LOADCURVE round JSON")
+    ap.add_argument("--top", type=int, default=15,
+                    help="functions to show (default 15)")
+    ap.add_argument("--threads", action="store_true",
+                    help="per-thread sample totals instead of functions")
+    ap.add_argument("--diff", default="",
+                    help="baseline flame to subtract before ranking")
+    ap.add_argument("--folded", default="",
+                    help="write the (diffed) folded stacks to this path")
+    ns = ap.parse_args(argv)
+
+    try:
+        doc = load_profile(ns.profile)
+    except (OSError, ValueError) as exc:
+        print(f"profile_summary: {exc}", file=sys.stderr)
+        return 2
+    if isinstance(doc, dict) and not all(
+        isinstance(v, int) for v in doc.values()
+    ):
+        return summarize_round(doc, ns.top)
+    base = None
+    if ns.diff:
+        try:
+            base = load_profile(ns.diff)
+        except (OSError, ValueError) as exc:
+            print(f"profile_summary: {exc}", file=sys.stderr)
+            return 2
+        if not isinstance(base, dict) or not all(
+            isinstance(v, int) for v in base.values()
+        ):
+            print("profile_summary: --diff base must be a collapsed "
+                  "flame", file=sys.stderr)
+            return 2
+    return summarize_flame(doc, ns.top, ns.threads, base, ns.folded)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
