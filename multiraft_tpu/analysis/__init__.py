@@ -7,14 +7,18 @@ with ruff/mypy into the one-shot gate, and ``tests/test_analysis.py``
 enforces zero unsuppressed findings in tier-1.
 
 See :mod:`.core` for the framework, :mod:`.rules` for the per-bug-class
-rules, :mod:`.lockgraph` for the static lock audit and
-:mod:`.lockorder` for the dynamic recorder used by the chaos tests.
+rules, :mod:`.lockgraph` for the static lock audit, :mod:`.planes` and
+:mod:`.registry` for the contract-drift rules (state-plane lifecycle,
+record/chaos/capability/knob registries) and :mod:`.lockorder` for the
+dynamic recorder used by the chaos tests.
 """
 
 from .core import ALL_RULES, Finding, ModuleInfo, Project, Rule, run
 from . import rules as _rules  # noqa: F401  (registration side effect)
 from . import lockgraph as _lockgraph  # noqa: F401
 from . import dataflow as _dataflow  # noqa: F401
+from . import planes as _planes  # noqa: F401
+from . import registry as _registry  # noqa: F401
 from .dataflow import Dataflow, get_dataflow
 from .lockgraph import LockGraph
 from .lockorder import LockOrderRecorder, RecordingLock

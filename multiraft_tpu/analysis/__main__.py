@@ -15,6 +15,8 @@ from .core import ALL_RULES, run
 from . import rules as _rules  # noqa: F401
 from . import lockgraph as _lockgraph  # noqa: F401
 from . import dataflow as _dataflow  # noqa: F401
+from . import planes as _planes  # noqa: F401
+from . import registry as _registry  # noqa: F401
 
 
 def main(argv=None) -> int:
@@ -51,6 +53,14 @@ def main(argv=None) -> int:
         action="store_true",
         help="print per-rule wall-clock timing to stderr",
     )
+    ap.add_argument(
+        "--per-rule",
+        action="store_true",
+        help=(
+            "print per-rule active/suppressed finding counts to "
+            "stderr (the suppression inventory at a glance)"
+        ),
+    )
     ns = ap.parse_args(argv)
     rules = ALL_RULES
     if ns.rule:
@@ -82,6 +92,18 @@ def main(argv=None) -> int:
         ):
             print(f"  rule {rname:<22} {secs * 1000:8.1f} ms",
                   file=sys.stderr)
+    if ns.per_rule:
+        counts = {r.name: [0, 0] for r in rules}
+        for f in active:
+            counts[f.rule][0] += 1
+        for f in suppressed:
+            counts[f.rule][1] += 1
+        for rname in sorted(counts):
+            a, s = counts[rname]
+            print(
+                f"  rule {rname:<22} {a:3d} active {s:3d} suppressed",
+                file=sys.stderr,
+            )
     if ns.verbose and suppressed:
         print(f"-- {len(suppressed)} suppressed --")
         for f in suppressed:

@@ -182,6 +182,8 @@ def run(
         from . import rules as _rules  # noqa: F401
         from . import lockgraph as _lockgraph  # noqa: F401
         from . import dataflow as _dataflow  # noqa: F401
+        from . import planes as _planes  # noqa: F401
+        from . import registry as _registry  # noqa: F401
 
         rules = ALL_RULES
     by_path = {str(m.path): m for m in project.modules}

@@ -37,6 +37,7 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..distributed import flightrec
+from ..utils.knobs import knob_float, knob_int
 from ..utils.trace import Tracer
 
 __all__ = ["load_bundle", "analyze", "build_report", "main"]
@@ -60,11 +61,7 @@ THRASH_MIN = 3
 # end means writes inside the window died unshipped.  Resolved from the
 # same env knob the shipper uses, so doctor and plane agree.
 def _ship_window_us() -> float:
-    raw = os.environ.get("MRT_SHIP_WINDOW_S")
-    try:
-        return float(raw) * 1e6 if raw is not None else 5e6
-    except ValueError:
-        return 5e6
+    return knob_float("MRT_SHIP_WINDOW_S") * 1e6
 
 
 # Degraded-quorum bound for membership changes (placement.py healer):
@@ -72,11 +69,7 @@ def _ship_window_us() -> float:
 # on a reduced quorum past the budget the operator set.  Same env knob
 # the controller uses, so doctor and healer agree.
 def _replace_deadline_us() -> float:
-    raw = os.environ.get("MRT_PLACE_REPLACE_DEADLINE_S")
-    try:
-        return float(raw) * 1e6 if raw is not None else 30e6
-    except ValueError:
-        return 30e6
+    return knob_float("MRT_PLACE_REPLACE_DEADLINE_S") * 1e6
 
 
 # SANITIZE record code → violation kind (sanitize.py writes them).
@@ -119,7 +112,7 @@ _BROWNOUT_NAMES = {0: "healthy", 1: "shedding", 2: "brownout"}
 # function.  ~850‰ rather than 1000‰: the sampler's 1 s windows
 # straddle the onset, diluting the pegged fraction.
 def _cpusat_permille() -> int:
-    return int(os.environ.get("MRT_CPUSAT_PERMILLE", "850"))
+    return knob_int("MRT_CPUSAT_PERMILLE")
 
 
 # -- loading ---------------------------------------------------------------
@@ -766,7 +759,13 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
                     last_hot = r["tag"]
                     out.instant(f"hot:{r['tag']}", ts, track="profile",
                                 pid=pid, busy_permille=r["code"])
-            else:  # NODE_CLOSE / MARK / future types
+            elif t == flightrec.NODE_CLOSE:
+                out.instant(f"close:{r['tag']}", ts, track="marks",
+                            pid=pid, node=r["tag"], clean=True)
+            elif t == flightrec.MARK:
+                out.instant(f"mark:{r['tag']}", ts, track="marks",
+                            pid=pid, tag=r["tag"])
+            else:  # future types: show, don't drop
                 out.instant(r["type_name"], ts, track="marks", pid=pid,
                             tag=r["tag"])
     return out

@@ -81,13 +81,31 @@ def _target_root(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _tainted_names(fn: ast.AST) -> Set[str]:
+def _has_tainted_call(node: ast.AST, call_tainted) -> bool:
+    """Any Call in ``node`` that ``call_tainted`` says returns taint."""
+    if call_tainted is None:
+        return False
+    return any(
+        isinstance(n, ast.Call) and call_tainted(n)
+        for n in ast.walk(node)
+    )
+
+
+def _tainted_names(fn: ast.AST, seeds=(), call_tainted=None) -> Set[str]:
     """Forward may-taint over a function body (statement order, two
-    passes so simple forward references through loops converge)."""
-    taint: Set[str] = set()
+    passes so simple forward references through loops converge).
+
+    ``seeds`` pre-taints parameter names (interprocedural argument
+    flow); ``call_tainted`` is a predicate marking calls whose return
+    value is tainted (interprocedural return flow)."""
+    taint: Set[str] = set(seeds)
 
     def expr_tainted(e: ast.AST) -> bool:
-        return bool(names_in(e) & taint) or _contains_taint_source(e)
+        return (
+            bool(names_in(e) & taint)
+            or _contains_taint_source(e)
+            or _has_tainted_call(e, call_tainted)
+        )
 
     def visit(stmts) -> None:
         for s in stmts:
@@ -220,54 +238,185 @@ class DonatedAliasRule(Rule):
     doc = (
         "pickle/frombuffer-backed memory must be defensively copied "
         "(jnp.array(v, copy=True)) before it reaches donated engine "
-        "state; the donated tick writes through zero-copy aliases."
+        "state — at any call depth; the donated tick writes through "
+        "zero-copy aliases."
     )
 
-    def check(self, project: Project) -> List[Finding]:
-        out: List[Finding] = []
-        for mod in project.modules:
-            for fn in ast.walk(mod.tree):
-                if not isinstance(
-                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+    def _fixpoint(self, project: Project):
+        """Interprocedural taint: which functions RETURN tainted data,
+        and which parameters RECEIVE tainted arguments.  Bounded
+        rounds over the shared dataflow call graph; call-target
+        resolution is cached per Call node (it dominates the cost)."""
+        from .dataflow import get_dataflow, own_nodes
+
+        df = get_dataflow(project)
+        target_cache: Dict[int, list] = {}
+
+        def targets(fi, call: ast.Call) -> list:
+            key = id(call)
+            if key not in target_cache:
+                target_cache[key] = df.callable_targets(fi, call.func)
+            return target_cache[key]
+
+        seeds: Dict[tuple, Set[str]] = {}
+        returns_tainted: Set[tuple] = set()
+
+        # Per-function call lists and has-a-taint-source bits, computed
+        # once: a function with neither (and no seeded params) cannot
+        # gain or pass taint, so rounds skip it outright.
+        fn_calls: Dict[tuple, list] = {}
+        fn_has_source: Dict[tuple, bool] = {}
+        for fi in df.funcs.values():
+            calls = [
+                n for n in ast.walk(fi.node) if isinstance(n, ast.Call)
+            ]
+            fn_calls[fi.fid] = calls
+            fn_has_source[fi.fid] = any(
+                _is_taint_source(c) for c in calls
+            )
+
+        for _ in range(8):
+            changed = False
+            for fi in df.funcs.values():
+                def call_tainted(c: ast.Call, fi=fi) -> bool:
+                    return any(
+                        t.fid in returns_tainted for t in targets(fi, c)
+                    )
+
+                if not (
+                    seeds.get(fi.fid)
+                    or fn_has_source[fi.fid]
+                    or any(call_tainted(c) for c in fn_calls[fi.fid])
                 ):
                     continue
-                taint = _tainted_names(fn)
-                if not taint:
-                    continue
-                for stmt in ast.walk(fn):
-                    if not isinstance(stmt, ast.stmt):
+                taint = _tainted_names(
+                    fi.node, seeds.get(fi.fid, ()), call_tainted
+                )
+                for n in own_nodes(fi.node):
+                    if (
+                        isinstance(n, ast.Return)
+                        and n.value is not None
+                        and fi.fid not in returns_tainted
+                        and (
+                            names_in(n.value) & taint
+                            or _contains_taint_source(n.value)
+                            or _has_tainted_call(n.value, call_tainted)
+                        )
+                    ):
+                        returns_tainted.add(fi.fid)
+                        changed = True
+                    if not isinstance(n, ast.Call):
                         continue
-                    if not _feeds_engine_state(stmt):
+                    tgts = targets(fi, n)
+                    if not tgts:
                         continue
-                    local = taint | _comp_taint(stmt, taint)
-                    for call in ast.walk(stmt):
-                        if not isinstance(call, ast.Call):
-                            continue
-                        if _is_jnp_array_call(call) is not True:
-                            continue
-                        if not call.args:
-                            continue
-                        arg = call.args[0]
-                        if names_in(arg) & local or _contains_taint_source(
-                            arg
+                    for pos, arg in enumerate(n.args):
+                        if not (
+                            names_in(arg) & taint
+                            or _contains_taint_source(arg)
+                            or _has_tainted_call(arg, call_tainted)
                         ):
-                            out.append(
-                                Finding(
-                                    rule=self.name,
-                                    path=str(mod.path),
-                                    line=call.lineno,
-                                    message=(
-                                        "value derived from pickle/"
-                                        "frombuffer reaches engine state "
-                                        "via jnp.asarray without "
-                                        "copy=True; the donated tick "
-                                        "writes through the aliased host "
-                                        "buffer (use jnp.array(v, "
-                                        "copy=True))"
-                                    ),
-                                )
+                            continue
+                        for t in tgts:
+                            params = [a.arg for a in t.node.args.args]
+                            # Bound-method call through an attribute:
+                            # positional args land after self/cls.
+                            off = (
+                                1
+                                if params[:1] in (["self"], ["cls"])
+                                and isinstance(n.func, ast.Attribute)
+                                else 0
                             )
-        return out
+                            idx = pos + off
+                            if idx < len(params):
+                                s = seeds.setdefault(t.fid, set())
+                                if params[idx] not in s:
+                                    s.add(params[idx])
+                                    changed = True
+                    for kw in n.keywords:
+                        if kw.arg is None or not (
+                            names_in(kw.value) & taint
+                            or _contains_taint_source(kw.value)
+                            or _has_tainted_call(kw.value, call_tainted)
+                        ):
+                            continue
+                        for t in tgts:
+                            params = {a.arg for a in t.node.args.args}
+                            if kw.arg in params:
+                                s = seeds.setdefault(t.fid, set())
+                                if kw.arg not in s:
+                                    s.add(kw.arg)
+                                    changed = True
+            if not changed:
+                break
+        return df, seeds, returns_tainted, targets, fn_calls, \
+            fn_has_source
+
+    def check(self, project: Project) -> List[Finding]:
+        (df, seeds, returns_tainted, targets, fn_calls,
+         fn_has_source) = self._fixpoint(project)
+        out: List[Finding] = []
+        for fi in df.funcs.values():
+            fn = fi.node
+
+            def call_tainted(c: ast.Call, fi=fi) -> bool:
+                return any(
+                    t.fid in returns_tainted for t in targets(fi, c)
+                )
+
+            if not (
+                seeds.get(fi.fid)
+                or fn_has_source[fi.fid]
+                or any(call_tainted(c) for c in fn_calls[fi.fid])
+            ):
+                continue
+            taint = _tainted_names(
+                fn, seeds.get(fi.fid, ()), call_tainted
+            )
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                if not _feeds_engine_state(stmt):
+                    continue
+                local = taint | _comp_taint(stmt, taint)
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if _is_jnp_array_call(call) is not True:
+                        continue
+                    if not call.args:
+                        continue
+                    arg = call.args[0]
+                    if (
+                        names_in(arg) & local
+                        or _contains_taint_source(arg)
+                        or _has_tainted_call(arg, call_tainted)
+                    ):
+                        out.append(
+                            Finding(
+                                rule=self.name,
+                                path=str(fi.path),
+                                line=call.lineno,
+                                message=(
+                                    "value derived from pickle/"
+                                    "frombuffer reaches engine state "
+                                    "via jnp.asarray without "
+                                    "copy=True; the donated tick "
+                                    "writes through the aliased host "
+                                    "buffer (use jnp.array(v, "
+                                    "copy=True))"
+                                ),
+                            )
+                        )
+        # Nested defs are visited both as their own FuncInfo and via
+        # the enclosing function's statement walk — keep one finding.
+        seen: Set[Tuple[str, int]] = set()
+        unique: List[Finding] = []
+        for f in out:
+            if (f.path, f.line) not in seen:
+                seen.add((f.path, f.line))
+                unique.append(f)
+        return unique
 
 
 # ---------------------------------------------------------------------------

@@ -40,10 +40,10 @@ Kill switch: ``MRT_ADMISSION=0`` skips the install entirely.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..utils.knobs import knob_bool, knob_float, knob_int, knob_str
 from .engine_wire import busy_reply  # noqa: F401  (re-export for tcp.py)
 from .observe import is_control
 
@@ -120,13 +120,6 @@ class TokenBucket:
 
 # -- controller -------------------------------------------------------------
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 class AdmissionController:
     """Dispatch-layer admission: consulted by tcp.py before handler
     lookup, driven by overload.py's brownout machine via
@@ -149,21 +142,21 @@ class AdmissionController:
         # knee-step p99 swung 85->196ms between identical sweeps.
         # Deployments serving a faster path (firehose batching, a
         # beefier host) should raise MRT_ADMIT_RATE to ~0.8x THEIR knee.
-        self.rate = rate if rate is not None else _env_f("MRT_ADMIT_RATE", 1600.0)
+        self.rate = rate if rate is not None else knob_float("MRT_ADMIT_RATE")
         # Bucket depth = 125ms of rate: deep enough to absorb Poisson
         # arrival clumps (sd ~ sqrt(rate) per second), shallow enough
         # that a sustained overload starts shedding within ~an RTT
         # instead of admitting seconds of excess into the queues first.
-        self.burst = burst if burst is not None else _env_f(
+        self.burst = burst if burst is not None else knob_float(
             "MRT_ADMIT_BURST", self.rate / 8.0)
-        self.session_rate = session_rate if session_rate is not None else _env_f(
+        self.session_rate = session_rate if session_rate is not None else knob_float(
             "MRT_ADMIT_SESSION_RATE", self.rate)
         self.inflight_cap = int(inflight_cap if inflight_cap is not None
-                                else _env_f("MRT_ADMIT_INFLIGHT", 512))
+                                else knob_int("MRT_ADMIT_INFLIGHT"))
         # Minimum retry hint per brownout level — bucket deficits at
         # high refill rates are sub-millisecond, which would invite an
         # immediate re-offer; the floor grows as the node browns out.
-        self.base_hint_s = _env_f("MRT_ADMIT_RETRY_S", 0.05)
+        self.base_hint_s = knob_float("MRT_ADMIT_RETRY_S")
         self._now = now
         self._m = metrics
         self._global = TokenBucket(self.rate, self.burst, now=now)
@@ -173,7 +166,7 @@ class AdmissionController:
         # admission factor it maps to.
         self.level = 0
         self._factors = self._parse_factors(
-            os.environ.get("MRT_BROWNOUT_FACTORS", ""))
+            knob_str("MRT_BROWNOUT_FACTORS") or "")
 
     @staticmethod
     def _parse_factors(raw: str) -> Tuple[float, float, float]:
@@ -265,7 +258,7 @@ def install_admission(node: Any, **kw: Any) -> Optional[AdmissionController]:
     """Attach an AdmissionController to a serving node (the engine
     front doors call this next to install_overload_watch).  Gated on
     ``MRT_ADMISSION`` (default on)."""
-    if os.environ.get("MRT_ADMISSION", "1") in ("0", "false", "no"):
+    if not knob_bool("MRT_ADMISSION"):
         return None
     adm = AdmissionController(metrics=node.obs.metrics, **kw)
     node.admission = adm

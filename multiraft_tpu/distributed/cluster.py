@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..sim.scheduler import TIMEOUT
+from ..utils.knobs import knob_bool
 from .disk import DiskPersister
 from .launch import (
     BlockingClerkBase as _BlockingClerkBase,
@@ -79,7 +80,7 @@ def serve_kv(
     )
     node.add_service("KVServer", srv)
     node.add_service("Raft", srv.rf)
-    if os.environ.get("MRT_DEBUG"):
+    if knob_bool("MRT_DEBUG"):
         def _dump() -> None:
             print(f"[{time.monotonic():.2f}] {srv.rf!r}", file=sys.stderr, flush=True)
             sched.call_after(1.0, _dump)

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..sim.scheduler import TIMEOUT
+from ..utils.knobs import knob_str
 from .launch import (
     BlockingClerkBase as _BlockingClerkBase,
     check_ready as _check_ready,
@@ -75,7 +76,7 @@ class EngineProcessCluster:
             "ports": _reserve_ports(1, host),
             "groups": groups,
             "seed": seed,
-            "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+            "platform": knob_str("MRT_ENGINE_PLATFORM"),
         }
         if chaos_seed is not None:
             # Fault-injection mode: the server installs chaos hooks +
@@ -179,7 +180,7 @@ class _SplitClusterBase:
                 "delay_elections": (
                     int(delay_elections[i]) if delay_elections else 0
                 ),
-                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+                "platform": knob_str("MRT_ENGINE_PLATFORM"),
             }
             if data_dir is not None:
                 spec["data_dir"] = os.path.join(data_dir, f"proc-{i}")
@@ -350,7 +351,7 @@ class EngineFleetCluster:
                     if g not in gl
                 },
                 "seed": seed + i,
-                "platform": os.environ.get("MRT_ENGINE_PLATFORM", "cpu"),
+                "platform": knob_str("MRT_ENGINE_PLATFORM"),
             }
             if spare_slots:
                 # Idle engine groups the placement controller adopts

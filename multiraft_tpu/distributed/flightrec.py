@@ -49,6 +49,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional
 
+from ..utils.knobs import knob_int, knob_str
 from .observe import now_us
 
 __all__ = [
@@ -286,14 +287,14 @@ def get_recorder(name: str = "") -> Optional[FlightRecorder]:
     disabled (``MRT_FLIGHTREC_DIR`` unset).  The first caller creates
     ``flight-<pid>.ring`` and names it; later callers share it."""
     global _proc_rec
-    d = os.environ.get("MRT_FLIGHTREC_DIR")
+    d = knob_str("MRT_FLIGHTREC_DIR")
     if not d:
         return None
     with _proc_lock:
         if _proc_rec is None or _proc_rec.closed:
             _proc_rec = FlightRecorder(
                 os.path.join(d, f"flight-{os.getpid()}.ring"),
-                slots=int(os.environ.get("MRT_FLIGHTREC_SLOTS", "8192")),
+                slots=knob_int("MRT_FLIGHTREC_SLOTS"),
                 name=name or f"pid{os.getpid()}",
             )
     return _proc_rec

@@ -11,6 +11,7 @@ import sys
 from typing import Any, List
 
 from ..sim.scheduler import TIMEOUT
+from ..utils.knobs import knob_str
 from .realtime import RealtimeScheduler
 from .tcp import RpcNode
 
@@ -38,7 +39,7 @@ def launch_server(spec: dict, label: Any) -> subprocess.Popen:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    log_dir = os.environ.get("MRT_SERVER_LOG_DIR")
+    log_dir = knob_str("MRT_SERVER_LOG_DIR")
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
         stderr = open(os.path.join(log_dir, f"server-{label}.err"), "a")

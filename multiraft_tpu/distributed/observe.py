@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..utils.knobs import knob_bool, knob_int
 from ..utils.metrics import Metrics
 from ..utils.trace import Tracer
 
@@ -147,7 +148,7 @@ def _rss_mb() -> Optional[float]:
 
 STAGES = ("wire", "dispatch", "handler", "engine", "ack", "flush", "total")
 
-_STAGECLOCK = os.environ.get("MRT_STAGECLOCK", "1") not in ("", "0")
+_STAGECLOCK = knob_bool("MRT_STAGECLOCK")
 
 
 def stageclock_enabled() -> bool:
@@ -204,7 +205,7 @@ class Observability:
         self, name: Optional[str] = None, max_events: Optional[int] = None
     ) -> None:
         if max_events is None:
-            max_events = int(os.environ.get("MRT_OBS_MAX_EVENTS", "50000"))
+            max_events = knob_int("MRT_OBS_MAX_EVENTS")
         self.name = name or f"pid{os.getpid()}"
         self.metrics = Metrics()
         self.tracer = Tracer(max_events=max_events)

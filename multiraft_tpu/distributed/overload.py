@@ -58,9 +58,9 @@ engaged" distinctly from "queueing collapse".
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
+from ..utils.knobs import knob_bool, knob_float, knob_int
 from ..utils.metrics import Hist
 from . import flightrec
 from .observe import ObsControl
@@ -77,13 +77,6 @@ __all__ = [
 # Minimum samples in a window before its p99 means anything — a
 # two-sample window's "p99" is just its max.
 _MIN_WINDOW_COUNT = 20
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 # -- brownout state machine -------------------------------------------------
@@ -108,9 +101,9 @@ class BrownoutMachine:
     def __init__(self, up: Optional[int] = None,
                  down: Optional[int] = None) -> None:
         self.up = max(1, int(up if up is not None
-                             else _env_f("MRT_BROWNOUT_UP", 2)))
+                             else knob_int("MRT_BROWNOUT_UP")))
         self.down = max(1, int(down if down is not None
-                               else _env_f("MRT_BROWNOUT_DOWN", 8)))
+                               else knob_int("MRT_BROWNOUT_DOWN")))
         self.state = HEALTHY
         self._over = 0   # consecutive tripping ticks
         self._under = 0  # consecutive clean ticks
@@ -138,13 +131,13 @@ class OverloadWatch:
         self.node = node
         self.interval = (
             interval if interval is not None
-            else _env_f("MRT_OVERLOAD_INTERVAL", 0.25)
+            else knob_float("MRT_OVERLOAD_INTERVAL")
         )
-        self.p99_bound_s = _env_f("MRT_OVERLOAD_P99_MS", 100.0) / 1e3
+        self.p99_bound_s = knob_float("MRT_OVERLOAD_P99_MS") / 1e3
         self.gauge_bounds: Dict[str, float] = {
-            "gauge.replyq": _env_f("MRT_OVERLOAD_REPLYQ", 1024.0),
-            "gauge.backlog": _env_f("MRT_OVERLOAD_BACKLOG", 4096.0),
-            "gauge.wal_pending": _env_f("MRT_OVERLOAD_WAL", 4096.0),
+            "gauge.replyq": knob_float("MRT_OVERLOAD_REPLYQ"),
+            "gauge.backlog": knob_float("MRT_OVERLOAD_BACKLOG"),
+            "gauge.wal_pending": knob_float("MRT_OVERLOAD_WAL"),
         }
         self._ctl = ObsControl(node)
         self._prev: Dict[str, Hist] = {}  # stage hist snapshots, last tick
@@ -271,7 +264,7 @@ def install_overload_watch(
     """Attach the watch to a serving node (no-op when
     ``MRT_OVERLOAD_WATCH=0``).  Returns the watch, kept reachable on
     ``node.overload_watch``."""
-    if os.environ.get("MRT_OVERLOAD_WATCH", "1") in ("", "0"):
+    if not knob_bool("MRT_OVERLOAD_WATCH"):
         return None
     watch = OverloadWatch(node, interval=interval)
     node.overload_watch = watch

@@ -61,12 +61,12 @@ vocabulary (``scripts/trace_summary.py --placements`` renders them).
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..services.shardctrler import rebalance_weighted
+from ..utils.knobs import knob_bool, knob_float, knob_int
 from ..transport import codec
 
 __all__ = [
@@ -106,23 +106,16 @@ RABORT = "RcfgAbort"
 HISTORY_CAP = 256
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 def place_knobs() -> Dict[str, float]:
     """The MRT_PLACE_* knob set, env-resolved (docs in module header)."""
     return {
-        "scrape_s": _env_f("MRT_PLACE_SCRAPE_S", 0.5),
-        "dead_s": _env_f("MRT_PLACE_DEAD_S", 3.0),
-        "cooldown_s": _env_f("MRT_PLACE_COOLDOWN_S", 5.0),
-        "min_gain": _env_f("MRT_PLACE_MIN_GAIN", 0.25),
-        "max_moves": int(_env_f("MRT_PLACE_MAX_MOVES", 1)),
-        "replace": _env_f("MRT_PLACE_REPLACE", 1.0) != 0.0,
-        "replace_deadline_s": _env_f("MRT_PLACE_REPLACE_DEADLINE_S", 30.0),
+        "scrape_s": knob_float("MRT_PLACE_SCRAPE_S"),
+        "dead_s": knob_float("MRT_PLACE_DEAD_S"),
+        "cooldown_s": knob_float("MRT_PLACE_COOLDOWN_S"),
+        "min_gain": knob_float("MRT_PLACE_MIN_GAIN"),
+        "max_moves": knob_int("MRT_PLACE_MAX_MOVES"),
+        "replace": knob_bool("MRT_PLACE_REPLACE"),
+        "replace_deadline_s": knob_float("MRT_PLACE_REPLACE_DEADLINE_S"),
     }
 
 

@@ -67,6 +67,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ..utils.knobs import knob_bool, knob_float, knob_int
+
 __all__ = [
     "SamplingProfiler",
     "profiler_enabled",
@@ -81,7 +83,7 @@ __all__ = [
     "diff_folded",
 ]
 
-_PROFILE = os.environ.get("MRT_PROFILE", "1") not in ("", "0")
+_PROFILE = knob_bool("MRT_PROFILE")
 
 
 def _default_hz() -> float:
@@ -96,19 +98,17 @@ def _default_hz() -> float:
     ~5%, 19 Hz stays under the 2% default-on budget (BENCHMARKS
     "Continuous profiling").  Both primes, off OS-tick harmonics.
     ``MRT_PROFILE_HZ`` overrides unconditionally."""
-    env = os.environ.get("MRT_PROFILE_HZ")
-    if env:
-        return float(env)
     try:
         ncpu = len(os.sched_getaffinity(0))  # type: ignore[attr-defined]
     except AttributeError:  # non-Linux
         ncpu = os.cpu_count() or 1
-    return 67.0 if ncpu > 1 else 19.0
+    return knob_float("MRT_PROFILE_HZ",
+                      default=67.0 if ncpu > 1 else 19.0)
 
 
 _DEF_HZ = _default_hz()
-_DEF_DEPTH = int(os.environ.get("MRT_PROFILE_DEPTH", "48"))
-_DEF_MAX_STACKS = int(os.environ.get("MRT_PROFILE_MAX_STACKS", "5000"))
+_DEF_DEPTH = knob_int("MRT_PROFILE_DEPTH")
+_DEF_MAX_STACKS = knob_int("MRT_PROFILE_MAX_STACKS")
 
 OVERFLOW_FRAME = "(overflow)"
 TRUNC_FRAME = "(...)"

@@ -26,6 +26,7 @@ from typing import Any, Callable, Generator, Optional
 
 from ..sim.scheduler import TIMEOUT, Future, Timer
 from ..utils.cpus import usable_cpus
+from ..utils.knobs import knob_bool
 from .sanitize import get_sanitizer
 
 __all__ = [
@@ -453,12 +454,10 @@ class PumpCadence:
     HOT_PUMPS = 3   # stay hot this many pumps past the last work
 
     def __init__(self, interval: float) -> None:
-        import os
-
         self.interval = interval
         self.hot_interval = interval / self.HOT_DIV
-        default = "1" if usable_cpus() > 1 else "0"
-        self.enabled = os.environ.get("MRT_PUMP_HOT", default) == "1"
+        self.enabled = knob_bool("MRT_PUMP_HOT",
+                                 default=usable_cpus() > 1)
         self._hot = 0
 
     def next_delay(self, busy: bool) -> float:

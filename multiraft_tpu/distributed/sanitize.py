@@ -38,12 +38,12 @@ Env vars:
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.knobs import knob_bool, knob_float
 from . import flightrec
 
 __all__ = [
@@ -178,7 +178,7 @@ _san_lock = threading.Lock()
 
 
 def enabled() -> bool:
-    return os.environ.get("MRT_SANITIZE", "") == "1"
+    return knob_bool("MRT_SANITIZE")
 
 
 def get_sanitizer() -> Optional[Sanitizer]:
@@ -189,9 +189,7 @@ def get_sanitizer() -> Optional[Sanitizer]:
     with _san_lock:
         if _san is None:
             _san = Sanitizer(
-                strict=os.environ.get("MRT_SANITIZE_STRICT", "") == "1",
-                budget_ms=float(
-                    os.environ.get("MRT_SANITIZE_CB_BUDGET_MS", "250")
-                ),
+                strict=knob_bool("MRT_SANITIZE_STRICT"),
+                budget_ms=knob_float("MRT_SANITIZE_CB_BUDGET_MS"),
             )
     return _san

@@ -58,6 +58,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..services.shardkv import SERVING, key2shard
+from ..utils.knobs import knob_bool, knob_float, knob_int
 from ..transport import codec
 
 __all__ = [
@@ -190,20 +191,11 @@ def ship_knobs() -> Dict[str, float]:
     * ``MRT_SHIP_SYNC`` — 1 = acks gate on shipment (zero acknowledged-
       write loss; the durable chaos gate runs with this on).
     """
-    defaults = {"window_s": 5.0, "tail_cap": 512.0, "sync": 0.0}
-    env = {
-        "window_s": "MRT_SHIP_WINDOW_S",
-        "tail_cap": "MRT_SHIP_TAIL_CAP",
-        "sync": "MRT_SHIP_SYNC",
+    return {
+        "window_s": knob_float("MRT_SHIP_WINDOW_S"),
+        "tail_cap": float(knob_int("MRT_SHIP_TAIL_CAP")),
+        "sync": 1.0 if knob_bool("MRT_SHIP_SYNC") else 0.0,
     }
-    out = {}
-    for k, var in env.items():
-        raw = os.environ.get(var)
-        try:
-            out[k] = float(raw) if raw is not None else defaults[k]
-        except ValueError:
-            out[k] = defaults[k]
-    return out
 
 
 # ---------------------------------------------------------------------------

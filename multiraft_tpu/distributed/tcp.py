@@ -71,6 +71,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..sim.scheduler import Future
 from ..transport import codec
+from ..utils.knobs import knob_bool, knob_int, knob_str
 from . import flightrec
 from .admission import lane_of
 from .engine_wire import busy_reply
@@ -114,7 +115,7 @@ _BULK_REPLY_BYTES = 2048
 # timed out and retried, while the newest replies still have a waiting
 # caller; session dedup keeps the retry exactly-once, the same
 # machinery that already covers chaos-dropped replies.
-_REPLY_Q_CAP = int(os.environ.get("MRT_REPLY_Q_CAP", "4096"))
+_REPLY_Q_CAP = knob_int("MRT_REPLY_Q_CAP")
 # Frame length prefix (big-endian u32) — must match transport.cpp's
 # framing; send_parts writes raw so Python adds it per frame.
 _U32 = struct.Struct(">I")
@@ -189,9 +190,9 @@ class RpcNode:
         # path — no hello (so peers never negotiate oob/repb) and
         # replies ship immediately per frame instead of through the
         # per-iteration flush.  A/B lever and escape hatch.
-        self._legacy_wire = bool(os.environ.get("MRT_WIRE_LEGACY"))
+        self._legacy_wire = knob_bool("MRT_WIRE_LEGACY")
         # MRT_DEBUG_RPC=1 traces every frame to stderr (wire-level debug).
-        self._dbg = bool(os.environ.get("MRT_DEBUG_RPC"))
+        self._dbg = knob_bool("MRT_DEBUG_RPC")
         # The per-process observability plane: counters + bounded span
         # buffer, always on (a dict bump and one dict append per RPC),
         # scrapeable over the node's own socket via the "Obs" service.
@@ -240,7 +241,7 @@ class RpcNode:
         # empty files.
         self.tracer = None
         self._trace_path = None
-        trace_dir = os.environ.get("MRT_TRACE_DIR")
+        trace_dir = knob_str("MRT_TRACE_DIR")
         if trace_dir and listen:
             os.makedirs(trace_dir, exist_ok=True)
             self.tracer = self.obs.tracer
@@ -259,8 +260,8 @@ class RpcNode:
         # this purpose).  MRT_SPIN_US overrides.
         from ..utils.cpus import usable_cpus
 
-        default_spin = "40" if usable_cpus() > 1 else "0"
-        self._tr.set_spin(int(os.environ.get("MRT_SPIN_US", default_spin)))
+        default_spin = 40 if usable_cpus() > 1 else 0
+        self._tr.set_spin(knob_int("MRT_SPIN_US", default=default_spin))
         # Span construction is gated off the untraced hot path: only
         # tagged requests (trace_id present) or a trace-dir run build
         # span dicts; everything else is a counter bump (see _dispatch).

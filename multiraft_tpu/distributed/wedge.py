@@ -46,20 +46,13 @@ read, and a watch tick must never take the serving loop down.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional, Set
 
+from ..utils.knobs import knob_bool, knob_float, knob_int
 from . import flightrec
 from .observe import ObsControl
 
 __all__ = ["WedgeWatch", "install_wedge_watch"]
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 class WedgeWatch:
@@ -70,11 +63,11 @@ class WedgeWatch:
         self.node = node
         self.interval = (
             interval if interval is not None
-            else _env_f("MRT_WEDGE_INTERVAL", 0.25)
+            else knob_float("MRT_WEDGE_INTERVAL")
         )
         self.stall_ticks = max(1, int(
             stall_ticks if stall_ticks is not None
-            else _env_f("MRT_WEDGE_TICKS", 8)
+            else knob_int("MRT_WEDGE_TICKS")
         ))
         self._ctl = ObsControl(node)
         self._prev_commit: Optional[List[int]] = None
@@ -166,7 +159,7 @@ def install_wedge_watch(
     ``MRT_WEDGE_WATCH=0``).  Returns the watch, kept reachable on
     ``node.wedge_watch`` (ObsControl.gauges reads it for
     ``gauge.wedged_groups``)."""
-    if os.environ.get("MRT_WEDGE_WATCH", "1") in ("", "0"):
+    if not knob_bool("MRT_WEDGE_WATCH"):
         return None
     watch = WedgeWatch(node, interval=interval)
     node.wedge_watch = watch

@@ -49,11 +49,12 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.knobs import knob_bool
 
 __all__ = [
     "EngineConfig", "EngineState", "Mailbox", "init_state",
@@ -63,21 +64,17 @@ __all__ = [
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
 
-def _env_on(name: str, default: str = "1") -> bool:
-    return os.environ.get(name, default) not in ("", "0")
-
-
 def prevote_default() -> bool:
     """PreVote election mode, ON unless ``MRT_PREVOTE=0`` (kill switch).
     Read at EngineConfig construction, so the legacy arm of the CI A/B
     matrix flips it per-process without touching call sites."""
-    return _env_on("MRT_PREVOTE")
+    return knob_bool("MRT_PREVOTE")
 
 
 def check_quorum_default() -> bool:
     """Check-quorum leader self-demotion, ON unless
     ``MRT_CHECK_QUORUM=0`` (kill switch, paired with MRT_PREVOTE)."""
-    return _env_on("MRT_CHECK_QUORUM")
+    return knob_bool("MRT_CHECK_QUORUM")
 
 
 def membership_default() -> bool:
@@ -86,7 +83,7 @@ def membership_default() -> bool:
     masked dual-quorum reductions are value-identical to the legacy
     single-quorum ones (see the math note on EngineConfig.membership),
     so default-on changes no behavior until a config entry lands."""
-    return _env_on("MRT_MEMBERSHIP")
+    return knob_bool("MRT_MEMBERSHIP")
 
 # The tick's metrics schema — single source of truth for the mesh
 # path's out_specs (engine/mesh.py) and the host's per-device scalar

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..distributed import flightrec
 from ..distributed.observe import now_us
+from ..utils.knobs import knob_str
 from .observe import FleetObserver
 
 __all__ = ["collect_bundle"]
@@ -96,7 +97,7 @@ def collect_bundle(
             with open(os.path.join(out_dir, "windows.json"), "w") as f:
                 json.dump(_jsonable(list(windows)), f, indent=2)
 
-        fdir = flight_dir or os.environ.get("MRT_FLIGHTREC_DIR")
+        fdir = flight_dir or knob_str("MRT_FLIGHTREC_DIR")
         rings: List[str] = []
         if fdir and os.path.isdir(fdir):
             rdir = os.path.join(out_dir, "rings")

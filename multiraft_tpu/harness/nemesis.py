@@ -51,6 +51,7 @@ from ..distributed.chaos import ChaosRule
 from ..distributed.observe import now_us
 from ..distributed.tcp import RpcNode
 from ..sim.scheduler import TIMEOUT
+from ..utils.knobs import knob_str
 
 __all__ = [
     "make_schedule",
@@ -746,7 +747,7 @@ class Nemesis:
         exactly the runs worth a black-box readout, and by the time a
         human looks, the fleet is gone — so collection is automatic
         and best-effort (never masks the verification error)."""
-        root = os.environ.get("MRT_POSTMORTEM_DIR")
+        root = knob_str("MRT_POSTMORTEM_DIR")
         if not root:
             return None
         from .bundle import collect_bundle  # local: avoid import cycle

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""One-shot static gate: graftlint + ruff + mypy-on-core.
+"""One-shot static gate: graftlint + knobs-doc drift + ruff + mypy.
 
 ``python scripts/check.py`` from the repo root.  Exit 0 iff every
 available check passes.  ruff and mypy are optional dependencies —
@@ -78,6 +78,11 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="print graftlint's per-rule wall clock to stderr",
     )
+    ap.add_argument(
+        "--per-rule",
+        action="store_true",
+        help="print graftlint's per-rule finding counts to stderr",
+    )
     args = ap.parse_args(argv)
 
     failed: list[str] = []
@@ -89,6 +94,8 @@ def main(argv: list[str] | None = None) -> int:
     ]
     if args.timings:
         lint_cmd.append("--timings")
+    if args.per_rule:
+        lint_cmd.append("--per-rule")
     t0 = time.perf_counter()
     if not _run("graftlint", lint_cmd):
         failed.append("graftlint")
@@ -107,6 +114,15 @@ def main(argv: list[str] | None = None) -> int:
             f"(budget {GRAFTLINT_BUDGET_S:.0f}s)",
             flush=True,
         )
+
+    # Knob registry ⇄ docs drift: docs/KNOBS.md must match the KNOBS
+    # table, and every MRT_* token in the docs / workflow YAML must be
+    # a declared knob.  Stdlib-only, so it always runs.
+    if not _run(
+        "knobs-doc",
+        [sys.executable, "-m", "multiraft_tpu.utils.knobs", "--check"],
+    ):
+        failed.append("knobs-doc")
 
     if _have("ruff"):
         if not _run(

@@ -1,0 +1,28 @@
+"""Fixture: the PR 1 restore segfault, two calls deep — pickle-backed
+arrays flow through a loader helper and an unpacker before reaching
+donated engine state via ``jnp.asarray`` without ``copy=True``.  The
+intraprocedural rule missed this shape; the interprocedural taint
+(argument + return flow over the call graph) must catch it.
+"""
+
+import pickle
+
+import jax.numpy as jnp
+
+
+def _load_blob(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _unpack(blob):
+    # Still the same pickle-owned buffers, one frame later.
+    return blob["arrays"]
+
+
+class Driver:
+    def restore(self, path):
+        arrays = _unpack(_load_blob(path))
+        self.state = EngineState(  # noqa: F821 - fixture stub
+            **{k: jnp.asarray(v) for k, v in arrays.items()}
+        )

@@ -1,0 +1,37 @@
+"""Fixture: chaos kind-vocabulary drift — a fault site using a kind
+``CHAOS_KIND_CODES`` never registered (its flight-record events carry
+code 0), and ``make_schedule`` emitting a window kind no nemesis verb
+handles (the run raises mid-schedule).
+"""
+
+CHAOS_KIND_CODES = {"drop": 1, "delay": 2}
+
+
+class ChaosState:
+    def _hit(self, path, kind):
+        pass
+
+    def apply(self, path):
+        self._hit(path, "drop")
+        self._hit(path, "floor")  # not in CHAOS_KIND_CODES
+
+
+def make_schedule(include=("delay", "drop", "burn")):
+    events = []
+    for kind in include:
+        if kind == "delay":
+            events.append((0.0, "delay_storm", {}))
+        elif kind == "drop":
+            events.append((0.0, "drop_storm", {}))
+        elif kind == "burn":
+            events.append((0.0, "burn_storm", {}))  # no verb handles it
+    return events
+
+
+class Nemesis:
+    def _start(self, kind, params):
+        if kind == "delay_storm":
+            return "delaying"
+        if kind == "drop_storm":
+            return "dropping"
+        raise ValueError(kind)

@@ -1,0 +1,16 @@
+"""Fixture: a one-entry knob registry (the module defining ``KNOBS``
+is exempt from the raw-read arm — it implements the accessors)."""
+
+
+class Knob:
+    def __init__(self, name, type, default, module, doc):
+        self.name = name
+        self.type = type
+        self.default = default
+        self.module = module
+        self.doc = doc
+
+
+KNOBS = (
+    Knob("MRT_DECLARED", "int", 1, "mod", "the one declared knob"),
+)

@@ -1,0 +1,19 @@
+"""Fixture: env-knob drift — a raw ``os.environ`` read bypassing the
+typed registry, and an accessor call naming a knob ``KNOBS`` never
+declared (no type, no default, no docs row).
+"""
+
+import os
+
+from .knobs import KNOBS  # noqa: F401
+
+
+def knob_int(name, default=None):
+    return default
+
+
+def settings():
+    rogue = os.environ.get("MRT_ROGUE", "1")  # raw read
+    missing = knob_int("MRT_MISSING")  # undeclared name
+    declared = knob_int("MRT_DECLARED")
+    return rogue, missing, declared

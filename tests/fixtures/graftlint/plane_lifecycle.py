@@ -1,0 +1,54 @@
+"""Fixture: the two lifecycle regressions the plane rules encode.
+
+* ``restart_replica`` clears ``voted_for`` (a PERSISTENT plane) — the
+  PR 15 double-vote bug: a crash-restart must keep the vote — and
+  forgets to reset the VOLATILE ``alive`` flag.
+* ``reset_replica`` clears only its own row of ``votes`` — the PR 16
+  stale-column bug: the ``[g, :, p]`` cross-replica column keeps the
+  dead incarnation's vote in every peer's tally.
+"""
+
+PERSISTENT = "persistent"
+VOLATILE = "volatile"
+LEADERSHIP = "leadership"
+CONFIG = "config"
+
+STATE_PLANES = {
+    "tick_no": PERSISTENT,
+    "term": PERSISTENT,
+    "voted_for": PERSISTENT,
+    "role": VOLATILE,
+    "commit": VOLATILE,
+    "alive": VOLATILE,
+    "votes": LEADERSHIP,
+    "match_idx": LEADERSHIP,
+    "voters_old": CONFIG,
+}
+
+CROSS_COLUMNS = ("votes", "match_idx")
+GLOBAL_FIELDS = ("tick_no",)
+
+
+class Driver:
+    def restart_replica(self, g, p):
+        st = self.state
+        self.state = st._replace(
+            role=st.role.at[g, p].set(0),
+            commit=st.commit.at[g, p].set(0),
+            # alive is never reset: a stale liveness bit survives
+            voted_for=st.voted_for.at[g, p].set(-1),  # persistent!
+        )
+
+    def reset_replica(self, g, p):
+        st = self.state
+        self.state = st._replace(
+            term=st.term.at[g, p].set(0),
+            voted_for=st.voted_for.at[g, p].set(-1),
+            role=st.role.at[g, p].set(0),
+            commit=st.commit.at[g, p].set(0),
+            alive=st.alive.at[g, p].set(False),
+            # row-only clear: the [g, :, p] column keeps stale votes
+            votes=st.votes.at[g, p].set(False),
+            # the correct shape, for contrast: row AND column wiped
+            match_idx=st.match_idx.at[g, p].set(1).at[g, :, p].set(1),
+        )

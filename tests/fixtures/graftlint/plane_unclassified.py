@@ -1,0 +1,22 @@
+"""Fixture: plane-registry drift — a new ``EngineState`` field shipped
+without a plane classification (nobody decided whether checkpoints and
+lifecycle resets cover it), and a stale registry entry outliving the
+field it classified.
+"""
+
+from typing import NamedTuple
+
+PERSISTENT = "persistent"
+VOLATILE = "volatile"
+
+STATE_PLANES = {
+    "term": PERSISTENT,
+    "commit": VOLATILE,
+    "gone": VOLATILE,  # stale: the field was removed, the entry kept
+}
+
+
+class EngineState(NamedTuple):
+    term: int
+    commit: int
+    lease_dl: int  # new field, never classified

@@ -1,0 +1,25 @@
+"""Fixture: flight-record registry drift — a colliding type code, a
+recorded event type missing from ``_TYPE_NAMES``, and types the
+postmortem doctor never decodes.
+"""
+
+RPC_OUT = 1
+ROLE = 10
+NODE_CLOSE = 10  # collides with ROLE: readers cannot tell them apart
+MARK = 12
+FLUSH = 20  # recorded below but never registered in _TYPE_NAMES
+
+_TYPE_NAMES = {
+    RPC_OUT: "rpc_out",
+    ROLE: "role",
+    NODE_CLOSE: "node_close",
+    MARK: "mark",
+}
+
+
+class Recorder:
+    def record(self, type_code, tag=""):
+        pass
+
+    def flush_marker(self):
+        self.record(FLUSH, tag="flush")

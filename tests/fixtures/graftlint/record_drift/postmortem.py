@@ -1,0 +1,14 @@
+"""Fixture: a postmortem doctor whose decoders lag the recorder —
+``NODE_CLOSE`` and ``MARK`` events silently vanish from reports.
+"""
+
+from . import flightrec
+
+
+def decode(record):
+    t = record["type"]
+    if t == flightrec.RPC_OUT:
+        return "rpc_out"
+    if t == flightrec.ROLE:
+        return "role"
+    return "?"

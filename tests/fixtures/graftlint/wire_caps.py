@@ -1,0 +1,14 @@
+"""Fixture: hello capability drift — a negotiation site testing a
+capability this build never offers (dead branch or payload drift), and
+a declared capability no site ever negotiates.
+"""
+
+_WIRE_CAPS = ("oob", "busy")
+
+
+def dispatch(conn, caps):
+    if caps is not None and "oob" in caps:
+        return "oob"
+    if "zstd" in caps:  # never declared in _WIRE_CAPS
+        return "zstd"
+    return None

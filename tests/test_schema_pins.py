@@ -1,0 +1,149 @@
+"""Schema fingerprints pinned against their version counters.
+
+Three registries whose silent drift has bitten before are pinned
+here so changing them forces a deliberate, versioned update:
+
+* the engine state-plane classification (``engine/state_planes.py``)
+  vs. ``CKPT_VERSION`` — adding/removing/reordering an ``EngineState``
+  or ``Mailbox`` field changes the checkpoint schema, so the pinned
+  fingerprint AND the version must move together;
+* the flight-record type-code table vs. the postmortem doctor;
+* the bench_compare family columns vs. what the benchmark scenarios
+  actually emit in the committed trajectory rounds.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+jax = pytest.importorskip("jax")
+
+from multiraft_tpu.engine import state_planes  # noqa: E402
+from multiraft_tpu.engine.core import EngineState, Mailbox  # noqa: E402
+from multiraft_tpu.engine.host import EngineDriver  # noqa: E402
+
+
+# -- checkpoint schema ------------------------------------------------------
+
+
+def test_plane_classification_is_complete():
+    assert state_planes.check_classification() == []
+
+
+def test_state_fingerprint_pinned_to_ckpt_version():
+    """The EngineState plane set IS the checkpoint schema.  If this
+    assertion fails you changed a field or its classification: bump
+    ``EngineDriver.CKPT_VERSION``, handle the old layout in
+    ``load()``, and update BOTH pins here."""
+    assert EngineDriver.CKPT_VERSION == 4
+    assert state_planes.state_fingerprint() == "0de8517b5539f7a7"
+
+
+def test_mailbox_fingerprint_pinned_to_ckpt_version():
+    """Mailbox fields ride the same checkpoint bundle; same rules as
+    the EngineState pin above."""
+    assert EngineDriver.CKPT_VERSION == 4
+    assert state_planes.mailbox_fingerprint() == "848c10d67baba41c"
+
+
+def test_fingerprint_is_order_sensitive():
+    fields = EngineState._fields
+    reordered = (fields[1], fields[0]) + fields[2:]
+    assert state_planes._fingerprint(
+        reordered, state_planes.STATE_PLANES
+    ) != state_planes.state_fingerprint()
+
+
+def test_cross_columns_are_leadership_planes():
+    for f in state_planes.CROSS_COLUMNS:
+        assert state_planes.STATE_PLANES[f] == state_planes.LEADERSHIP
+    for f in state_planes.GLOBAL_FIELDS:
+        assert f in EngineState._fields
+    assert set(state_planes.MAILBOX_PLANES) == set(Mailbox._fields)
+
+
+# -- flight-record registry -------------------------------------------------
+
+
+def test_flightrec_type_codes_unique_and_registered():
+    from multiraft_tpu.distributed import flightrec
+
+    codes = {}
+    for name, value in vars(flightrec).items():
+        if name.isupper() and not name.startswith("_") and (
+            isinstance(value, int)
+            and value in flightrec._TYPE_NAMES
+        ):
+            codes.setdefault(value, []).append(name)
+    # every registered code maps back to exactly one constant
+    dupes = {v: ns for v, ns in codes.items() if len(ns) > 1}
+    assert dupes == {}, f"colliding flight-record codes: {dupes}"
+    # and the table names every code (no bare-number decodes)
+    assert set(flightrec._TYPE_NAMES) == set(codes)
+
+
+def test_postmortem_doctor_covers_every_record_type():
+    """Textual coverage: every _TYPE_NAMES constant must be referenced
+    by the doctor (the graftlint record-codes rule enforces the same
+    statically; this keeps the contract visible in the test suite)."""
+    from multiraft_tpu.distributed import flightrec
+
+    src = (REPO / "multiraft_tpu" / "analysis" / "postmortem.py").read_text()
+    names = {
+        name
+        for name, value in vars(flightrec).items()
+        if name.isupper() and not name.startswith("_")
+        and isinstance(value, int)
+        and value in flightrec._TYPE_NAMES
+    }
+    missing = {
+        n for n in names if f"flightrec.{n}" not in src
+    }
+    assert missing == set(), (
+        f"postmortem doctor never references: {sorted(missing)}"
+    )
+
+
+# -- bench trajectory columns ----------------------------------------------
+
+
+def _load_bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", REPO / "scripts" / "bench_compare.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadcurve_round_has_family_columns():
+    bc = _load_bench_compare()
+    data = json.loads((REPO / "LOADCURVE_r03.json").read_text())
+    for family in ("loadcurve", "cpu"):
+        for key, _label, _higher in bc.FAMILIES[family]["metrics"]:
+            assert key in data, (
+                f"LOADCURVE_r03.json lacks {family} column '{key}' — "
+                f"the scenario's emitted keys drifted from "
+                f"bench_compare.FAMILIES"
+            )
+
+
+def test_placement_round_has_family_columns():
+    bc = _load_bench_compare()
+    data = json.loads((REPO / "PLACEMENT_r03.json").read_text())
+    keys = {k for k, _l, _h in bc.FAMILIES["placement"]["metrics"]}
+    # r03 is the self-healing round: its durability and replacement
+    # columns must exist (earlier columns may legitimately be n/a).
+    for key in ("replace_replica_s", "degraded_quorum_window_s",
+                "lost_acked_writes"):
+        assert key in keys, f"'{key}' dropped from FAMILIES[placement]"
+        assert key in data, (
+            f"PLACEMENT_r03.json lacks '{key}' — the scenario's "
+            f"emitted keys drifted from bench_compare.FAMILIES"
+        )
