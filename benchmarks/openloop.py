@@ -427,7 +427,7 @@ def sweep(
         # format, flamegraph.pl/speedscope-ready) goes to flame_out.
         if flame:
             from multiraft_tpu.distributed.profile import (
-                to_collapsed, top_functions,
+                SERVING_THREAD_PREFIXES, to_collapsed, top_functions,
             )
 
             # Strip the process prefix for ranking (top_functions
@@ -440,7 +440,7 @@ def sweep(
             for k, v in flame.items():
                 b = k.split(";", 1)[1] if ";" in k else k
                 bare[b] = bare.get(b, 0) + v
-                if b.startswith("multiraft-loop"):
+                if b.startswith(SERVING_THREAD_PREFIXES):
                     serving[b] = serving.get(b, 0) + v
             out["profile"] = {
                 "samples": sum(flame.values()),

@@ -883,8 +883,11 @@ class UnboundedQueueRule(Rule):
 # ---------------------------------------------------------------------------
 
 # The durability layer's whole contract is sync-on-pump (group commit):
-# its fsyncs are the product, not a stall bug.
-_BLOCK_ALLOW_MODULES = {"wal", "disk"}
+# its fsyncs are the product, not a stall bug.  engine_pump is the
+# engine pipeline's dedicated device-wait thread: blocking there is the
+# design — it exists precisely so the scheduler loop never blocks on a
+# readback (distributed/engine_pump.py).
+_BLOCK_ALLOW_MODULES = {"wal", "disk", "engine_pump"}
 
 
 def _blocking_what(call: ast.Call) -> Optional[str]:

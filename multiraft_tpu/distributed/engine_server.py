@@ -27,6 +27,7 @@ Wire protocol: ``EngineKV.command`` / ``EngineShardKV.command`` over
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Optional, Sequence
@@ -53,7 +54,9 @@ from .engine_wire import (
     make_mesh,
     route_group,
 )
+from ..utils.knobs import knob_bool, knob_float, knob_int
 from .admission import install_admission
+from .engine_pump import PUMP_THREAD_PREFIX, EnginePump, LoopOccupancy
 from .overload import install_overload_watch
 from .wedge import install_wedge_watch
 from .realtime import (
@@ -106,7 +109,9 @@ class EngineKVService:
         self.sched = sched
         self.kv = kv
         self.G = kv.driver.cfg.G
-        self._cadence = PumpCadence(pump_interval)
+        self._cadence = PumpCadence(
+            knob_float("MRT_PUMP_IDLE_S", default=pump_interval)
+        )
         self._ticks = ticks_per_pump
         self._stopped = False
         self._dur = durability
@@ -126,6 +131,31 @@ class EngineKVService:
         self._frec = flightrec.get_recorder()
         self._pumps = 0
         self._last_frontier = (-1, -1, -1)
+        # Asynchronous engine pipeline (engine_pump.py): the loop
+        # dispatches fused tick batches and completes them when the
+        # dedicated pump thread has fetched the stacked metrics; the
+        # legacy synchronous pump stays selectable per pump (kill
+        # switch, mesh drivers, reorder chaos).  Durable servers pin
+        # the depth to 1 so each checkpoint sees a drained pipeline
+        # (EngineDriver.save refuses otherwise).
+        self._pipe = None
+        self._depth = 1
+        self._pump_timer = None
+        self._occ = LoopOccupancy(self.m)
+        if knob_bool("MRT_ENGINE_PIPELINE"):
+            loop_name = getattr(getattr(sched, "_thread", None), "name", "")
+            suffix = (
+                loop_name[len("multiraft-loop"):]
+                if loop_name.startswith("multiraft-loop") else ""
+            )
+            self._pipe = EnginePump(sched, name=PUMP_THREAD_PREFIX + suffix)
+            self._depth = (
+                1 if durability is not None
+                else max(1, knob_int("MRT_PIPELINE_DEPTH"))
+            )
+            pump_ticks = knob_int("MRT_PUMP_TICKS")
+            if pump_ticks > 0:
+                self._ticks = pump_ticks
         if durability is not None:
             # WAL at APPLY time (commit order): evict-and-resubmit can
             # commit ops in a different order than submission, and
@@ -135,6 +165,14 @@ class EngineKVService:
                 durability.log(("kv", _OPNAME[op.op], op.key, op.value,
                                 op.client_id, op.command_id)),
             )
+        if self._pipe is not None and kv.driver.fused_eligible():
+            # Warm the fused n-tick program NOW, before the first
+            # client byte: its first invocation pays the jit compile on
+            # this (loop) thread, and paying it mid-serving stalls the
+            # first rate step's tail (measured ~100 ms on the r04
+            # sweep's opening step).  The backlog is empty at
+            # construction, so this is two liveness ticks.
+            self.kv.pump(self._ticks)
         sched.call_soon(self._pump_loop)
 
     @property
@@ -152,6 +190,9 @@ class EngineKVService:
 
     def stop(self) -> None:
         self._stopped = True
+        pipe = getattr(self, "_pipe", None)
+        if pipe is not None:
+            pipe.stop()
 
     def final_checkpoint(self) -> bool:
         """Graceful-shutdown hook (CLI SIGTERM): fold everything into
@@ -159,12 +200,49 @@ class EngineKVService:
         when the server is not durable."""
         if self._dur is None:
             return False
+        self._drain_pipeline()  # driver.save refuses in-flight batches
         self._dur.checkpoint()
         return True
 
+    def _arm_pump(self, delay: float) -> None:
+        """Single-timer discipline: exactly one pending _pump_loop
+        timer, re-armed earlier when a completion says there is work."""
+        t = self._pump_timer
+        if t is not None:
+            t.cancel()
+        self._pump_timer = self.sched.call_after(delay, self._pump_loop)
+
     def _pump_loop(self) -> None:
+        self._pump_timer = None
         if self._stopped:
             return
+        d = self.kv.driver
+        if self._pipe is not None and d.fused_eligible():
+            # Pipelined path: dispatch a fused batch WITHOUT waiting —
+            # the engine-pump thread blocks on the readback and posts
+            # _pump_done back here.  The loop is free for wire work
+            # while the device computes.
+            if len(d._inflight) < self._depth:
+                # Push queued replies first (see the sync path below).
+                flush = getattr(self.sched, "flush_io", None)
+                if flush is not None:
+                    flush()
+                cp0 = time.thread_time()
+                pending = d.dispatch_ticks(self._ticks)
+                pending.t_loop_cpu = time.thread_time() - cp0
+                self._occ.add(time.perf_counter() - pending.t_dispatch)
+                self._pipe.submit(
+                    pending.fetch,
+                    functools.partial(self._pump_done, pending),
+                )
+            self._arm_pump(self._cadence.next_delay(service_busy(self.kv)))
+            return
+        self._pump_sync()
+
+    def _pump_sync(self) -> None:
+        """Legacy synchronous pump (MRT_ENGINE_PIPELINE=0, mesh
+        drivers, reorder chaos in flight): the whole device step runs
+        on the loop thread."""
         # About to grind for up to several milliseconds: push any
         # queued replies onto the wire first, or a client whose op
         # resolved last tick waits out this whole one before it can
@@ -178,6 +256,38 @@ class EngineKVService:
         self.kv.pump(self._ticks)
         dt = time.perf_counter() - t0
         cdt = time.thread_time() - cp0
+        self._occ.add(dt)
+        self._record_pump(dt, cdt)
+        self._after_pump_durability()
+        self._arm_pump(self._cadence.next_delay(service_busy(self.kv)))
+
+    def _pump_done(self, pending, rec) -> None:
+        """Loop-side completion of a dispatched batch (posted by the
+        engine-pump thread with the fetched stacked metrics): fold the
+        bookkeeping, sweep the frontier, observe, re-arm."""
+        if isinstance(rec, BaseException):
+            raise rec  # device failure: surface on the owning loop
+        d = self.kv.driver
+        if pending not in d._inflight:
+            return  # already drained (final_checkpoint) or torn down
+        t0 = time.perf_counter()
+        cp0 = time.thread_time()
+        d.complete_ticks(pending, rec)
+        self.kv.after_step(pending.n)
+        now = time.perf_counter()
+        # Wall covers dispatch→completion (the client-visible pump
+        # latency); CPU counts only the LOOP-side share — the split the
+        # profiler uses to show the loop is no longer device-blocked.
+        dt = now - pending.t_dispatch
+        cdt = (time.thread_time() - cp0) + pending.t_loop_cpu
+        self._occ.add(now - t0)
+        self._record_pump(dt, cdt)
+        self._after_pump_durability()
+        if self._stopped:
+            return
+        self._arm_pump(self._cadence.next_delay(service_busy(self.kv)))
+
+    def _record_pump(self, dt: float, cdt: float) -> None:
         self.m.inc("pump.count")
         self.m.observe("pump.wall_s", dt)
         # Wall-vs-CPU split: a tick whose wall ≫ CPU is device-bound
@@ -210,6 +320,8 @@ class EngineKVService:
                     flightrec.STATE, a=frontier[0], b=frontier[1],
                     c=frontier[2],
                 )
+
+    def _after_pump_durability(self) -> None:
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
             if self._write_seqs:
@@ -217,10 +329,16 @@ class EngineKVService:
                     k: v for k, v in self._write_seqs.items()
                     if not self._dur.synced(v)
                 }
-        self.sched.call_after(
-            self._cadence.next_delay(service_busy(self.kv)),
-            self._pump_loop,
-        )
+
+    def _drain_pipeline(self) -> None:
+        """Complete every in-flight batch synchronously (checkpoint /
+        shutdown path): blocks the loop, which is the point — nothing
+        else may observe a half-accounted engine."""
+        d = self.kv.driver
+        while d._inflight:
+            p = d._inflight[0]
+            d.complete_ticks(p, p.fetch())
+            self.kv.after_step(p.n)
 
     def replay_wal(self) -> int:
         """Recovery replay — delegated to

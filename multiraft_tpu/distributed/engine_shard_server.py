@@ -14,6 +14,7 @@ processes), and durable serving (checkpoint + WAL + recovery via
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Optional, Sequence
@@ -35,6 +36,8 @@ from .engine_wire import (
     EngineCmdReply,
     make_mesh,
 )
+from ..utils.knobs import knob_bool, knob_float, knob_int
+from .engine_pump import PUMP_THREAD_PREFIX, EnginePump, LoopOccupancy
 from .realtime import (
     PumpCadence,
     RealtimeScheduler,
@@ -87,9 +90,30 @@ class EngineShardKVService:
     ) -> None:
         self.sched = sched
         self.skv = skv
-        self._cadence = PumpCadence(pump_interval)
+        self._cadence = PumpCadence(
+            knob_float("MRT_PUMP_IDLE_S", default=pump_interval)
+        )
         self._ticks = ticks_per_pump
         self._stopped = False
+        # Asynchronous engine pipeline — see EngineKVService; same
+        # dispatch/complete split, same durable depth pin.
+        self._pipe = None
+        self._depth = 1
+        self._pump_timer = None
+        if knob_bool("MRT_ENGINE_PIPELINE"):
+            loop_name = getattr(getattr(sched, "_thread", None), "name", "")
+            suffix = (
+                loop_name[len("multiraft-loop"):]
+                if loop_name.startswith("multiraft-loop") else ""
+            )
+            self._pipe = EnginePump(sched, name=PUMP_THREAD_PREFIX + suffix)
+            self._depth = (
+                1 if durability is not None
+                else max(1, knob_int("MRT_PIPELINE_DEPTH"))
+            )
+            pump_ticks = knob_int("MRT_PUMP_TICKS")
+            if pump_ticks > 0:
+                self._ticks = pump_ticks
         self.peers = dict(peers or {})
         # A fleet process whose peer map is momentarily empty (all gids
         # local, or rebuilt by a placement push) must KEEP answering
@@ -105,6 +129,7 @@ class EngineShardKVService:
         # Observability plane (see EngineKVService): the owning node's,
         # lazily defaulted via the `obs` property for stub construction.
         self._obs = obs
+        self._occ = LoopOccupancy(self.m)
         # seq of the WAL record covering each applied insert — the GC
         # gate below refuses to ask the old owner to delete until the
         # inserted blob (possibly the last copy) is fsynced here.
@@ -176,6 +201,13 @@ class EngineShardKVService:
                 # acked the shipment covering the record (the zero-
                 # acknowledged-write-loss mode of the chaos gate).
                 self._dur.extra_sync_gate = self._plane.covered
+        if self._pipe is not None and skv.driver.fused_eligible():
+            # Warm the fused n-tick program before serving: its first
+            # invocation pays the jit compile on this (loop) thread —
+            # mid-serving it stalls the opening rate step's tail.  No
+            # orchestration during construction; the backlog is empty,
+            # so this is two liveness ticks.
+            self.skv.pump(self._ticks, orchestrate=False)
         sched.call_soon(self._pump_loop)
 
     @property
@@ -703,21 +735,90 @@ class EngineShardKVService:
 
     def stop(self) -> None:
         self._stopped = True
+        pipe = getattr(self, "_pipe", None)
+        if pipe is not None:
+            pipe.stop()
 
     def final_checkpoint(self) -> bool:
         """Graceful-shutdown hook — see EngineKVService."""
         if self._dur is None:
             return False
+        self._drain_pipeline()  # driver.save refuses in-flight batches
         self._dur.checkpoint()
         return True
 
+    def _arm_pump(self, delay: float) -> None:
+        """Single-timer discipline — see EngineKVService."""
+        t = self._pump_timer
+        if t is not None:
+            t.cancel()
+        self._pump_timer = self.sched.call_after(delay, self._pump_loop)
+
     def _pump_loop(self) -> None:
+        self._pump_timer = None
         if self._stopped:
             return
+        d = self.skv.driver
+        if self._pipe is not None and d.fused_eligible():
+            # Pipelined path — see EngineKVService._pump_loop.
+            if len(d._inflight) < self._depth:
+                flush = getattr(self.sched, "flush_io", None)
+                if flush is not None:
+                    flush()
+                cp0 = time.thread_time()
+                pending = d.dispatch_ticks(self._ticks)
+                pending.t_loop_cpu = time.thread_time() - cp0
+                self._occ.add(time.perf_counter() - pending.t_dispatch)
+                self._pipe.submit(
+                    pending.fetch,
+                    functools.partial(self._pump_done, pending),
+                )
+            self._arm_pump(self._cadence.next_delay(service_busy(self.skv)))
+            return
+        self._pump_sync()
+
+    def _pump_sync(self) -> None:
+        """Legacy synchronous pump (MRT_ENGINE_PIPELINE=0, mesh
+        drivers, reorder chaos in flight)."""
         t0 = time.perf_counter()
+        cp0 = time.thread_time()
         self.skv.pump(self._ticks)
+        dt = time.perf_counter() - t0
+        self._occ.add(dt)
+        self._record_pump(dt, time.thread_time() - cp0)
+        self._after_pump_durability()
+        self._arm_pump(self._cadence.next_delay(service_busy(self.skv)))
+
+    def _pump_done(self, pending, rec) -> None:
+        """Loop-side completion of a dispatched batch — see
+        EngineKVService._pump_done."""
+        if isinstance(rec, BaseException):
+            raise rec
+        d = self.skv.driver
+        if pending not in d._inflight:
+            return  # already drained (final_checkpoint) or torn down
+        t0 = time.perf_counter()
+        cp0 = time.thread_time()
+        d.complete_ticks(pending, rec)
+        self.skv.after_step(pending.n, orchestrate=True)
+        now = time.perf_counter()
+        self._occ.add(now - t0)
+        self._record_pump(
+            now - pending.t_dispatch,
+            (time.thread_time() - cp0) + pending.t_loop_cpu,
+        )
+        self._after_pump_durability()
+        if self._stopped:
+            return
+        self._arm_pump(self._cadence.next_delay(service_busy(self.skv)))
+
+    def _record_pump(self, dt: float, cdt: float) -> None:
         self.m.inc("pump.count")
-        self.m.observe("pump.wall_s", time.perf_counter() - t0)
+        self.m.observe("pump.wall_s", dt)
+        self.m.observe("pump.cpu_s", cdt)
+        self.m.observe("cpu.engine_s", cdt)
+
+    def _after_pump_durability(self) -> None:
         if self._dur is not None:
             self._dur.after_pump()  # group fsync + periodic checkpoint
             for attr in ("_insert_seqs", "_write_seqs", "_admin_seqs",
@@ -730,10 +831,15 @@ class EngineShardKVService:
                     })
         if self._plane is not None:
             self._plane.ship_round()
-        self.sched.call_after(
-            self._cadence.next_delay(service_busy(self.skv)),
-            self._pump_loop,
-        )
+
+    def _drain_pipeline(self) -> None:
+        """Complete every in-flight batch synchronously (checkpoint /
+        shutdown path) — see EngineKVService."""
+        d = self.skv.driver
+        while d._inflight:
+            p = d._inflight[0]
+            d.complete_ticks(p, p.fetch())
+            self.skv.after_step(p.n, orchestrate=True)
 
     def replay_wal(self) -> int:
         """Recovery replay — delegated to

@@ -70,6 +70,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 from ..utils.knobs import knob_bool, knob_float, knob_int
 
 __all__ = [
+    "SERVING_THREAD_PREFIXES",
     "SamplingProfiler",
     "profiler_enabled",
     "get_profiler",
@@ -84,6 +85,17 @@ __all__ = [
 ]
 
 _PROFILE = knob_bool("MRT_PROFILE")
+
+# Thread-name prefixes counting as SERVING-side CPU in ranking cuts
+# (loadcurve per-window attribution, openloop sweeps): the scheduler
+# loops and, since the asynchronous pipeline, the engine-pump threads
+# that block on device readbacks on the loops' behalf
+# (distributed/engine_pump.py).  A new serving thread family must be
+# added here or its CPU silently drops out of the serving headline.
+SERVING_THREAD_PREFIXES: Tuple[str, ...] = (
+    "multiraft-loop",
+    "multiraft-pump",
+)
 
 
 def _default_hz() -> float:

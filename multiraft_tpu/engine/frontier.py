@@ -87,6 +87,15 @@ class FrontierService:
         """Advance the engine and apply the committed frontier
         (DeferredConsensus.pump)."""
         self.driver.step(n_ticks)
+        self.after_step(n_ticks)
+
+    def after_step(self, n_ticks: int = 1) -> None:
+        """The host half of :meth:`pump`: everything after the engine
+        advance — frontier sweep, apply, orphan sweep.  The pipelined
+        serving loop calls this from ``complete_ticks`` handoff (the
+        engine advance happened on dispatch), the synchronous path via
+        :meth:`pump`.  Requires ``driver.last_metrics`` to reflect the
+        ticks being accounted for."""
         self._pre_sweep()
         commit = np.asarray(self.driver.last_metrics["commit_index"])
         now = self.driver.tick

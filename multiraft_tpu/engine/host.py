@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.knobs import knob_bool
 from ..utils.metrics import Metrics
 from .core import (
     SCALAR_METRIC_KEYS,
@@ -227,9 +228,17 @@ class EngineDriver:
         # (engine/split.py).  None = skip the extra metric readback.
         self.on_payload_bound: Optional[Any] = None
         # Optional utils.trace.Tracer: each tick becomes a wall-clock
-        # span carrying its metrics.  Forces a device sync per tick, so
-        # it is a diagnostic mode, not a throughput mode.
+        # span carrying its metrics.  The fused path buffers the spans
+        # from the stacked metrics and emits once per pump; only the
+        # serial loop pays a per-tick sync for them.
         self.tracer = None
+        # Asynchronous engine pipeline (engine/pipeline.py).
+        # MRT_ENGINE_PIPELINE=0 is the kill switch: serial per-tick
+        # stepping plus the synchronous pump loop, for clean A/B.
+        self._pipeline_on = knob_bool("MRT_ENGINE_PIPELINE")
+        # Dispatched-but-not-completed PendingTicks, oldest first.
+        # Bounded by the serving pipeline depth (MRT_PIPELINE_DEPTH).
+        self._inflight: list = []
 
     # -- fault injection --------------------------------------------------
 
@@ -709,6 +718,44 @@ class EngineDriver:
     # -- tick loop --------------------------------------------------------
 
     def step(self, n: int = 1) -> Dict[str, Any]:
+        """Advance ``n`` ticks.  Multi-tick calls on a pipeline-enabled
+        driver run the fused device scan (engine/pipeline.py — one host
+        sync per call instead of one per tick); everything else —
+        single ticks, mesh drivers, reorder chaos in flight,
+        ``MRT_ENGINE_PIPELINE=0`` — takes the serial per-tick loop.
+        Both paths are bit-identical by contract
+        (tests/test_engine_pipeline.py).
+
+        Synchronous callers (admin_sync, checkpoint replay, tests) may
+        land here while the serving loop still has dispatched batches
+        in flight: drain them first, in dispatch order — safe because
+        ``step`` already must run on the owning thread, and the serving
+        loop's ``_pump_done`` ignores batches completed from under it."""
+        while self._inflight:
+            p = self._inflight[0]
+            self.complete_ticks(p, p.fetch())
+        if n > 1 and self.fused_eligible():
+            pending = self.dispatch_ticks(n)
+            return self.complete_ticks(pending, pending.fetch())
+        return self._step_serial(n)
+
+    def fused_eligible(self) -> bool:
+        """True when the fused scan path may run: pipeline enabled, no
+        mesh tick (its scalar metrics arrive as per-device lanes), and
+        no reorder chaos active or held (``_apply_reorder`` rewrites
+        the mailbox on host between ticks — inherently unfusable)."""
+        return (
+            self._pipeline_on
+            and self._mesh_tick is None
+            and self.reorder_prob == 0.0
+            and not self._delayed
+        )
+
+    def _step_serial(self, n: int = 1) -> Dict[str, Any]:
+        assert not self._inflight, (
+            "serial step with fused tick batches in flight — complete "
+            "them first, or the two tick streams interleave"
+        )
         cfg = self.cfg
         self.metrics.inc("ticks", n)
         for _ in range(n):
@@ -787,6 +834,115 @@ class EngineDriver:
                 )
         return self.last_metrics
 
+    # -- fused pipeline (engine/pipeline.py) ------------------------------
+
+    def dispatch_ticks(self, n: int):
+        """Dispatch a fused ``n``-tick batch to the device WITHOUT
+        waiting for it: JAX async dispatch makes the returned arrays
+        futures, so this only pays trace/enqueue cost on the calling
+        (scheduler-loop) thread.  Requires :meth:`fused_eligible`.
+
+        The host tick counter and state/inbox advance immediately —
+        payload binding and backlog bookkeeping are deferred to
+        :meth:`complete_ticks` once the stacked metrics are fetched
+        (``PendingTicks.fetch``, safe off-thread)."""
+        from .pipeline import PendingTicks, step_ticks
+
+        cfg = self.cfg
+        t_dispatch = time.perf_counter()
+        self.metrics.inc("ticks", n)
+        tick0 = self.tick
+        bl = jnp.asarray(
+            np.minimum(self.backlog, np.int64(2**31 - 1)).astype(np.int32)
+        )
+        for p in self._inflight:
+            # Batches already dispatched will consume part of the host
+            # backlog when they complete; the device must not ingest
+            # those commands again (the depth ≥ 2 double-ingest hazard).
+            # accepts_dev never left the device, so this stays async.
+            bl = jnp.maximum(bl - p.accepts_dev, 0)
+        with_drop = self.drop_prob > 0.0
+        with_edges = not bool(self.edge_up.all())
+        if with_edges:
+            if self._edge_dev is None:
+                # copy=True: see _mask_partitions.
+                self._edge_dev = jnp.array(self.edge_up, copy=True)
+            edge_mask = self._edge_dev
+        else:
+            edge_mask = jnp.zeros((), jnp.bool_)  # static-dead operand
+        state, inbox, _bl_left, rec = step_ticks(
+            cfg, self.state, self.inbox, n, with_drop, with_edges,
+            bl, jnp.float32(self.drop_prob), edge_mask,
+            jnp.int32(tick0), self.key,
+        )
+        self.state, self.inbox = state, inbox
+        self.tick = tick0 + n
+        pending = PendingTicks(
+            n=n, tick0=tick0, rec=rec,
+            accepts_dev=jnp.sum(rec["accepted"], axis=0),
+            t_dispatch=t_dispatch,
+        )
+        self._inflight.append(pending)  # graftlint: disable=unbounded-queue
+        return pending
+
+    def complete_ticks(self, pending, host_rec) -> Dict[str, Any]:
+        """Fold a fetched batch back into host bookkeeping: per-tick
+        backlog decrements and payload binding replayed in tick order
+        from the stacked record, the commit accumulator, last_metrics,
+        and (tracer mode) the buffered per-tick spans — one host sync
+        per pump where the serial loop paid one per tick.  Must run on
+        the owning (scheduler) thread, in dispatch order."""
+        assert self._inflight and self._inflight[0] is pending, (
+            "complete_ticks out of dispatch order"
+        )
+        self._inflight.pop(0)
+        accepted = host_rec["accepted"]  # i32[n, G]
+        starts = host_rec["start_index"]
+        terms = host_rec["accept_term"] if self.on_payload_bound else None
+        # np.nonzero on [n, G] is row-major: tick-major, group-minor —
+        # exactly the serial loop's binding order.
+        for i, g in zip(*np.nonzero(accepted)):
+            k = int(accepted[i, g])
+            self.backlog[g] -= k
+            self._bind_accepted(
+                int(g), k, int(starts[i, g]),
+                int(terms[i, g]) if terms is not None else None,
+            )
+        self._commits_dev = (
+            getattr(self, "_commits_dev", 0) + int(host_rec["commits"].sum())
+        )
+        self.last_metrics = {k: v[-1] for k, v in host_rec.items()}
+        if self.tracer:
+            self._emit_tick_spans(pending, host_rec)
+        return self.last_metrics
+
+    def _emit_tick_spans(self, pending, rec) -> None:
+        """Tracer spans for a completed fused batch: the per-tick wall
+        clock no longer exists (ticks fused on device), so the batch
+        wall is spread evenly across its ticks.  Commit/leader fields
+        come from the stacked record — no extra device syncs."""
+        n = pending.n
+        now = time.perf_counter()
+        per = max(now - pending.t_dispatch, 1e-9) / n
+        t = pending.t_dispatch
+        commits_total = int(rec["commits"].sum())
+        for i in range(n):
+            self.metrics.observe("tick_wall_s", per)
+            self.tracer.span(
+                "tick",
+                t * 1e6,
+                per * 1e6,
+                track="engine",
+                tick=pending.tick0 + 1 + i,
+                commits=int(rec["commits"][i]),
+                leaders=int(rec["leaders"][i]),
+            )
+            t += per
+        self.tracer.counter(
+            "consensus", now * 1e6,
+            {"commits": commits_total, "backlog": int(self.backlog.sum())},
+        )
+
     @property
     def commits_total(self) -> int:
         return int(getattr(self, "_commits_dev", 0)) + self.total_commits
@@ -826,6 +982,16 @@ class EngineDriver:
         """Atomically write a full checkpoint.  ``extra`` carries
         service-level state (e.g. ``FrontierService.state_dict()``) so
         engine and services checkpoint at the same tick boundary."""
+        if self._inflight:
+            # state/inbox already reflect the dispatched batches but
+            # backlog/payload bookkeeping does not — a checkpoint here
+            # would tear the tick boundary.  The durable serving loop
+            # drains the pipeline before checkpointing (and pins the
+            # pipeline depth to 1); see ARCHITECTURE §20.
+            raise RuntimeError(
+                "save() with fused tick batches in flight — drain the "
+                "pipeline (complete_ticks) before checkpointing"
+            )
         blob = {
             "version": self.CKPT_VERSION,
             "mesh_devices": (

@@ -1,0 +1,141 @@
+"""Fused multi-tick device pipeline for the serving path.
+
+The serial :meth:`EngineDriver.step` loop pays two host round-trips per
+tick whenever commands are in flight: the ``np.minimum`` backlog clip
+that builds ``new_cmds`` (host → device), and the accepted/starts/terms
+readback that binds payloads (device → host).  At serving shapes the
+readback dominates the pump — LOADCURVE_r03 measured ``host.step`` at
+538 µs/op against 29 µs/op for ingress decode.
+
+:func:`step_ticks` removes both: one ``lax.scan`` advances
+``ticks_per_pump`` ticks entirely on device, carrying the backlog
+decrement in the scan carry (``new_cmds`` is recomputed per tick from
+the carried backlog, so accepted commands are never re-ingested), and
+stacking the per-tick metrics so the host syncs ONCE per pump and
+replays the payload binding from the stacked record.  The fault model
+rides inside the scan: per-tick drop masks (same ``fold_in(tick_key,
+0xFA)`` stream as the serial loop) and the partition edge mask, so a
+chaos run fuses identically to a clean one.  Host-side reorder
+(`_apply_reorder`) is inherently unfusable — drivers with reordering
+in flight fall back to the serial loop (see
+``EngineDriver.fused_eligible``).
+
+Bit-parity with the serial loop is a hard contract
+(tests/test_engine_pipeline.py pins it via the state_planes content
+fingerprints): same keys (``fold_in(key, tick0 + 1 + i)`` reproduces
+the serial per-tick fold), same ingest clip, same decrement order.
+
+:class:`PendingTicks` is the dispatch/complete split on top of it: the
+scheduler loop dispatches a batch without waiting (JAX async dispatch
+makes the returned arrays futures), a dedicated pump thread blocks in
+:meth:`PendingTicks.fetch`, and the loop folds the fetched record back
+in :meth:`EngineDriver.complete_ticks` — so socket I/O, decode and
+acks proceed during device compute (distributed/engine_pump.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import EngineConfig, EngineState, Mailbox, tick_impl
+from .host import apply_faults, mask_active
+
+__all__ = ["step_ticks", "PendingTicks"]
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 3, 4, 5), donate_argnums=(1, 2)
+)
+def step_ticks(
+    cfg: EngineConfig,
+    state: EngineState,
+    inbox: Mailbox,
+    n_ticks: int,
+    with_drop: bool,
+    with_edges: bool,
+    backlog: jnp.ndarray,  # i32[G]: host backlog (clipped), scan carry
+    drop_prob: jnp.ndarray,  # f32 scalar (unused when not with_drop)
+    edge_mask: jnp.ndarray,  # bool[G,P,P]; dummy when not with_edges
+    tick0: jnp.ndarray,  # i32 scalar: host tick BEFORE this batch
+    key: jax.Array,
+):
+    """``n_ticks`` consensus rounds fused under one scan, with the
+    backlog/new_cmds computation in the carry and every per-tick metric
+    stacked (``rec[k]`` has a leading ``[n_ticks]`` axis).
+
+    Returns ``(state, inbox, backlog_left, rec)``.  ``with_drop`` /
+    ``with_edges`` are static so the clean path compiles none of the
+    fault machinery; ``tick0`` and ``backlog`` are device values so a
+    moving tick counter never retraces."""
+
+    def body(carry, i):
+        st, mb, bl = carry
+        # Parity with the serial loop: it increments the host tick
+        # FIRST, then folds — tick i of this batch is tick0 + 1 + i.
+        tick_key = jax.random.fold_in(key, tick0 + 1 + i)
+        new_cmds = jnp.minimum(bl, jnp.int32(cfg.INGEST))
+        st, mb, m = tick_impl(cfg, st, mb, new_cmds, tick_key)
+        if with_drop:
+            mb = apply_faults(
+                mb, jax.random.fold_in(tick_key, 0xFA), drop_prob, cfg
+            )
+        if with_edges:
+            mb = mask_active(mb, lambda _, a: a & edge_mask)
+        bl = bl - m["accepted"]
+        return (st, mb, bl), m
+
+    (state, inbox, backlog), rec = jax.lax.scan(
+        body, (state, inbox, backlog), jnp.arange(n_ticks, dtype=jnp.int32)
+    )
+    return state, inbox, backlog, rec
+
+
+class PendingTicks:
+    """A dispatched, not-yet-completed fused tick batch.
+
+    Created by :meth:`EngineDriver.dispatch_ticks` (scheduler loop,
+    non-blocking); :meth:`fetch` blocks until the stacked metrics are
+    on host and is the ONE call safe to run off the loop thread (the
+    engine-pump thread's whole job); the result then goes back to the
+    loop for :meth:`EngineDriver.complete_ticks`.
+
+    ``accepts_dev`` stays on device: later dispatches subtract it from
+    the host backlog so an in-flight batch's accepted commands are
+    never re-ingested (the pipeline-depth ≥ 2 double-ingest hazard).
+    """
+
+    __slots__ = (
+        "n", "tick0", "rec", "accepts_dev", "t_dispatch", "t_loop_cpu",
+    )
+
+    def __init__(
+        self,
+        n: int,
+        tick0: int,
+        rec: Dict[str, jnp.ndarray],
+        accepts_dev: jnp.ndarray,
+        t_dispatch: float,
+    ) -> None:
+        self.n = n
+        self.tick0 = tick0
+        self.rec = rec
+        self.accepts_dev = accepts_dev
+        self.t_dispatch = t_dispatch
+        # Loop-side CPU the dispatch burned (the serving loop's share
+        # of this pump; completion adds its own) — set by the caller.
+        self.t_loop_cpu = 0.0
+
+    def fetch(self) -> Dict[str, np.ndarray]:
+        """Block until the batch's stacked metrics are host-resident.
+        Pure device wait + copy: touches no driver state, so it is
+        safe off the scheduler loop by construction."""
+        return {k: np.asarray(v) for k, v in self.rec.items()}
+
+    def _replace_wall(self, t: float) -> None:  # pragma: no cover - tests
+        self.t_dispatch = t

@@ -792,6 +792,16 @@ class BatchedShardKV(FrontierService):
         self._orchestrate_enabled = orchestrate
         super().pump(n_ticks)
 
+    def after_step(self, n_ticks: int = 1, orchestrate=None) -> None:
+        """Pipelined-pump entry (FrontierService.after_step): the
+        engine advance happened at dispatch; this is the host half.
+        ``orchestrate=None`` keeps the gate :meth:`pump` set (the base
+        pump routes through here), a bool overrides it — the pipelined
+        serving loop passes True explicitly."""
+        if orchestrate is not None:
+            self._orchestrate_enabled = orchestrate
+        super().after_step(n_ticks)
+
     def _post_pump(self) -> None:
         if self._orchestrate_enabled:
             self._orchestrate()

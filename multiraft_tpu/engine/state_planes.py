@@ -52,6 +52,7 @@ __all__ = [
     "check_classification",
     "state_fingerprint",
     "mailbox_fingerprint",
+    "content_fingerprint",
 ]
 
 PERSISTENT = "persistent"
@@ -192,3 +193,22 @@ def mailbox_fingerprint() -> str:
     from .core import Mailbox
 
     return _fingerprint(Mailbox._fields, MAILBOX_PLANES)
+
+
+def content_fingerprint(nt) -> str:
+    """sha256 over the VALUE bytes of every field of an ``EngineState``
+    or ``Mailbox`` instance, in field order (name + dtype + raw bytes
+    per field).  Where :func:`state_fingerprint` pins the SCHEMA, this
+    witnesses the CONTENT — the tick-parity contract's assertion that
+    the fused pipeline (engine/pipeline.py) and the serial step loop
+    produce bit-identical state (tests/test_engine_pipeline.py).
+    Forces a device→host sync: test/diagnostic use only."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for name, value in zip(type(nt)._fields, nt):
+        a = np.asarray(value)
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]

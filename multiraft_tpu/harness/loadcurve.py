@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from ..distributed.profile import top_functions
+from ..distributed.profile import SERVING_THREAD_PREFIXES, top_functions
 from ..utils.metrics import Hist
 from .observe import FleetObserver
 
@@ -186,11 +186,12 @@ def profile_window(
         unprefixed[bare] = unprefixed.get(bare, 0) + int(v)
         # The sampler records every thread every tick — a main thread
         # parked in sleep shows the same sample rate as a pegged loop.
-        # The serving-thread cut ranks only the per-node loop threads
-        # ("multiraft-loop*", which also run the engine pump), so the
-        # headline names what serving CPU was spent on rather than
-        # where idle threads happened to be parked.
-        if bare.startswith("multiraft-loop"):
+        # The serving-thread cut ranks only the serving-side threads
+        # (SERVING_THREAD_PREFIXES: the per-node loops plus their
+        # engine-pump device-wait threads), so the headline names what
+        # serving CPU was spent on rather than where idle threads
+        # happened to be parked.
+        if bare.startswith(SERVING_THREAD_PREFIXES):
             serving[bare] = serving.get(bare, 0) + int(v)
     return {
         "samples": sum(flame.values()),

@@ -100,6 +100,18 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("MRT_ENGINE_PLATFORM", "str", "cpu", "distributed.engine_cluster",
          "JAX platform the engine server process initializes "
          "(cpu/tpu); engine-cluster launches pin it per child."),
+    # -- distributed.engine_pump ---------------------------------------------
+    Knob("MRT_PIPELINE_DEPTH", "int", 2, "distributed.engine_pump",
+         "In-flight fused tick batches the pipelined pump keeps "
+         "dispatched (overlaps host bookkeeping with device compute); "
+         "durable servers pin it to 1 so every checkpoint sees a "
+         "drained pipeline."),
+    Knob("MRT_PUMP_IDLE_S", "float", 0.002, "distributed.engine_pump",
+         "Idle engine-pump cadence in seconds (the adaptive cadence's "
+         "slow interval when no traffic is flowing)."),
+    Knob("MRT_PUMP_TICKS", "int", 0, "distributed.engine_pump",
+         "Fused device ticks per dispatched pipeline batch (0 = the "
+         "server's ticks_per_pump)."),
     # -- distributed.flightrec ----------------------------------------------
     Knob("MRT_FLIGHTREC_DIR", "str", None, "distributed.flightrec",
          "Directory for the crash-safe flight-recorder rings; unset "
@@ -228,6 +240,11 @@ KNOBS: Tuple[Knob, ...] = (
          "Joint-consensus membership change support (kill switch)."),
     Knob("MRT_PREVOTE", "bool", True, "engine.core",
          "PreVote election mode (kill switch for the legacy CI arm)."),
+    # -- engine.host --------------------------------------------------------
+    Knob("MRT_ENGINE_PIPELINE", "bool", True, "engine.host",
+         "Asynchronous engine pipeline: fused multi-tick device scan "
+         "plus a dedicated pump thread; 0 restores the serial per-tick "
+         "step and the synchronous pump loop for clean A/B."),
     # -- harness.nemesis ----------------------------------------------------
     Knob("MRT_POSTMORTEM_DIR", "str", None, "harness.nemesis",
          "Directory where a failed chaos run drops its evidence "

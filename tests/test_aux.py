@@ -144,11 +144,21 @@ def test_tracer_engine_tick_spans():
 
     d = EngineDriver(EngineConfig(G=4, P=3), seed=0)
     d.tracer = Tracer()
-    d.step(20)
+    d.step(20)  # one fused pump: per-tick spans, ONE consensus counter
     ticks = [e for e in d.tracer.events if e["name"] == "tick"]
     assert len(ticks) == 20
     assert [e["args"]["tick"] for e in ticks] == list(range(1, 21))
     counters = [e for e in d.tracer.events if e["ph"] == "C"]
+    assert len(counters) == 1
+
+    # The serial loop (pipeline kill switch) keeps per-tick counters.
+    d2 = EngineDriver(EngineConfig(G=4, P=3), seed=0)
+    d2._pipeline_on = False
+    d2.tracer = Tracer()
+    d2.step(20)
+    ticks = [e for e in d2.tracer.events if e["name"] == "tick"]
+    assert len(ticks) == 20
+    counters = [e for e in d2.tracer.events if e["ph"] == "C"]
     assert len(counters) == 20
 
 
