@@ -251,6 +251,7 @@ def fire_schedule(
         shed = sum(sheds)
         p50 = h.percentile(0.50)
         p99 = h.percentile(0.99)
+        p999 = h.percentile(0.999)
         return {
             "sent": n,
             "replied": replied,
@@ -261,6 +262,9 @@ def fire_schedule(
             "achieved_ops_per_sec": round(ok / wall, 1) if wall else 0.0,
             "client_p50_ms": round(1e3 * p50, 3) if p50 is not None else None,
             "client_p99_ms": round(1e3 * p99, 3) if p99 is not None else None,
+            "client_p999_ms": (
+                round(1e3 * p999, 3) if p999 is not None else None
+            ),
             "client_mean_ms": (
                 round(1e3 * h.total / h.count, 3) if h.count else None
             ),

@@ -75,6 +75,9 @@ def _replace_deadline_us() -> float:
 # SANITIZE record code → violation kind (sanitize.py writes them).
 _SANITIZE_KINDS = {v: k for k, v in flightrec.SANITIZE_KIND_CODES.items()}
 
+# TAIL record code → queue-wait name (tail.py writes them).
+_TAIL_WAITS = {v: k for k, v in flightrec.TAIL_WAIT_CODES.items()}
+
 
 def _covering_window(
     windows: List[Dict[str, Any]], ts: float,
@@ -120,18 +123,20 @@ def _cpusat_permille() -> int:
 
 def load_bundle(path: str) -> Dict[str, Any]:
     """Load a bundle dir, a directory of rings, or one ``.ring`` file
-    into ``{"dir", "manifest", "snapshots", "windows", "rings"}``.
-    Unreadable rings are skipped with a note in ``"skipped"`` — one
-    corrupt file must not block the rest of the postmortem."""
+    into ``{"dir", "manifest", "snapshots", "windows", "tails",
+    "rings"}``.  Unreadable rings are skipped with a note in
+    ``"skipped"`` — one corrupt file must not block the rest of the
+    postmortem."""
     out: Dict[str, Any] = {
         "dir": path, "manifest": {}, "snapshots": {}, "windows": [],
-        "rings": [], "skipped": [],
+        "tails": {}, "rings": [], "skipped": [],
     }
     if os.path.isfile(path):
         ring_paths = [path]
         out["dir"] = os.path.dirname(path) or "."
     else:
-        for name in ("manifest.json", "snapshots.json", "windows.json"):
+        for name in ("manifest.json", "snapshots.json", "windows.json",
+                     "tails.json"):
             p = os.path.join(path, name)
             if os.path.exists(p):
                 try:
@@ -338,6 +343,44 @@ def analyze(bundle: Dict[str, Any]) -> Dict[str, Any]:
                     (r["tag"] for r in reversed(profs) if r["tag"]), ""
                 ),
             }
+        # Tail-microscope breadcrumbs (TAIL, tail.py): over-SLO and
+        # new-slowest completions — code=dominant-wait, a=total_us,
+        # b=wait_us, c=carrying engine tick, tag=rid.  The ring's
+        # slowest request survives SIGKILL; summarized per ring, and
+        # escalated to a tail_outlier anomaly when it breached the SLO
+        # — anchored on the request, naming the dominating wait and
+        # (when the ledger covers it) the nemesis window it rode out.
+        tails = [r for r in recs if r["type"] == flightrec.TAIL]
+        if tails:
+            slow = max(tails, key=lambda r: r["a"])
+            wait = _TAIL_WAITS.get(slow["code"], f"code{slow['code']}")
+            info["tail"] = {
+                "records": len(tails),
+                "slowest_ms": round(slow["a"] / 1e3, 3),
+                "dominant_wait": wait,
+                "rid": slow["tag"],
+                "tick": slow["c"],
+            }
+            if slow["a"] / 1e3 > knob_float("MRT_TAIL_SLO_MS"):
+                detail = (
+                    f"slowest request {slow['tag'] or '<untagged>'}: "
+                    f"{slow['a'] / 1e3:.1f} ms total, "
+                    f"{slow['b'] / 1e3:.1f} ms in the '{wait}' wait"
+                    + (f", engine tick {slow['c']}" if slow["c"] else "")
+                )
+                win = _covering_window(
+                    bundle.get("windows") or [], aligned(slow["ts"])
+                )
+                if win is not None:
+                    detail += (
+                        f"; during fault window '{win['kind']}' on "
+                        f"proc(s) {win.get('procs')}"
+                    )
+                anomalies.append({
+                    "ts": aligned(slow["ts"]), "proc": label,
+                    "kind": "tail_outlier", "detail": detail,
+                    "aligned": off is not None,
+                })
         # Overload-watch trips → ONE collapse anomaly per ring,
         # anchored on the FIRST saturated stage (a collapse can leave
         # hundreds of trip records; the first one names where the
@@ -762,6 +805,16 @@ def rings_to_trace(bundle: Dict[str, Any]) -> Tracer:
             elif t == flightrec.NODE_CLOSE:
                 out.instant(f"close:{r['tag']}", ts, track="marks",
                             pid=pid, node=r["tag"], clean=True)
+            elif t == flightrec.TAIL:
+                # Slow-request breadcrumb: span back over the request's
+                # whole lifetime so the outlier overlaps the pump ticks
+                # and RPC spans that produced it.
+                out.span(
+                    f"tail:{r['tag'] or 'request'}", ts - r["a"], r["a"],
+                    track="tail", pid=pid,
+                    wait=_TAIL_WAITS.get(r["code"], r["code"]),
+                    wait_us=r["b"], tick=r["c"], seq=r["seq"],
+                )
             elif t == flightrec.MARK:
                 out.instant(f"mark:{r['tag']}", ts, track="marks",
                             pid=pid, tag=r["tag"])
@@ -882,6 +935,15 @@ def build_report(bundle: Dict[str, Any], analysis: Dict[str, Any]) -> str:
                 f"{pr['samples']} sample(s), peak busy "
                 f"{pr['peak_busy_permille']}‰"
                 + (f", hottest {pr['hottest']}" if pr["hottest"] else "")
+            )
+        if "tail" in p:
+            tl = p["tail"]
+            add(
+                f"    tail: {tl['records']} breadcrumb(s), slowest "
+                f"{tl['slowest_ms']:.1f} ms"
+                + (f" (rid {tl['rid']})" if tl["rid"] else "")
+                + f", dominant wait {tl['dominant_wait']}"
+                + (f", tick {tl['tick']}" if tl["tick"] else "")
             )
         if "shipments" in p:
             gids = ", ".join(

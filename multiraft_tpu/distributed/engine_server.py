@@ -130,6 +130,7 @@ class EngineKVService:
         # pump loop writes one TICK record per pump and nothing else.
         self._frec = flightrec.get_recorder()
         self._pumps = 0
+        self._pump_t_dispatch = 0.0
         self._last_frontier = (-1, -1, -1)
         # Asynchronous engine pipeline (engine_pump.py): the loop
         # dispatches fused tick batches and completes them when the
@@ -297,12 +298,18 @@ class EngineKVService:
         # the pump IS the engine stage's CPU (observe.py vocabulary).
         self.m.observe("pump.cpu_s", cdt)
         self.m.observe("cpu.engine_s", cdt)
+        # Pump sequencing for the tail plane: tick id + dispatch stamp
+        # (now − wall) let a committing request attribute its parked
+        # time to the fused tick that carried it.  Unconditional — the
+        # flight-ring gate below must not decide whether requests know
+        # their tick.
+        self._pumps += 1
+        self._pump_t_dispatch = time.perf_counter() - dt
         fr = self._frec
         if fr is not None:
             # Tick boundary + (on change only) the consensus frontier.
             # Everything here is host-side bookkeeping the pump already
             # computed — no device readback is added.
-            self._pumps += 1
             d = self.kv.driver
             commits = int(d.commits_total)
             fr.record(
@@ -551,6 +558,7 @@ class EngineKVService:
         def run():
             t_start = self.sched.now
             deadline = t_start + self.DEADLINE_S
+            t_parked = 0.0
             while self.sched.now < deadline:
                 cs0 = time.thread_time() if stages is not None else 0.0
                 t = self.kv.submit(
@@ -571,6 +579,10 @@ class EngineKVService:
                     self.m.observe(
                         "cpu.handler_s", time.thread_time() - cs0
                     )
+                    # Parked from here until a pump carries the
+                    # proposal (re-stamped per resubmit — churn waits
+                    # are engine latency, not pump-queue latency).
+                    t_parked = time.perf_counter()
                 if stages is not None and not stages.engine:
                     # First submit closes the handler leg; resubmits
                     # stay inside the engine leg (they ARE the engine's
@@ -588,6 +600,18 @@ class EngineKVService:
                         # apply.  The durability gate below lands in
                         # the ack leg (folded at dispatch completion).
                         stages.fold(self.m, "engine")
+                        # Tail attribution: which fused tick carried
+                        # the commit, and how long the proposal sat
+                        # parked before that tick was dispatched (the
+                        # rest of the engine leg is device work).
+                        # getattr: stub handlers built via __new__
+                        # (tests) carry no pump state.
+                        stages.tick = getattr(self, "_pumps", -1)
+                        stages.pump_wait_s = max(
+                            0.0,
+                            getattr(self, "_pump_t_dispatch", 0.0)
+                            - t_parked,
+                        )
                     # Ack only once the apply-time WAL record is
                     # fsynced (absent = pruned = already durable, or
                     # a duplicate applied before this incarnation).

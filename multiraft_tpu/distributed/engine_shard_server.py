@@ -130,6 +130,9 @@ class EngineShardKVService:
         # lazily defaulted via the `obs` property for stub construction.
         self._obs = obs
         self._occ = LoopOccupancy(self.m)
+        # Pump sequencing for the tail plane (see _record_pump).
+        self._pumps = 0
+        self._pump_t_dispatch = 0.0
         # seq of the WAL record covering each applied insert — the GC
         # gate below refuses to ask the old owner to delete until the
         # inserted blob (possibly the last copy) is fsynced here.
@@ -817,6 +820,12 @@ class EngineShardKVService:
         self.m.observe("pump.wall_s", dt)
         self.m.observe("pump.cpu_s", cdt)
         self.m.observe("cpu.engine_s", cdt)
+        # Pump sequencing for the tail plane (twin of the flat engine
+        # server's): tick id + dispatch stamp so a committing request
+        # can attribute its parked time to the fused tick that
+        # carried it.
+        self._pumps += 1
+        self._pump_t_dispatch = time.perf_counter() - dt
 
     def _after_pump_durability(self) -> None:
         if self._dur is not None:
@@ -1000,6 +1009,7 @@ class EngineShardKVService:
         def run():
             t_start = self.sched.now
             deadline = t_start + self.DEADLINE_S
+            t_parked = 0.0
             while self.sched.now < deadline:
                 cfg = self.skv.query_latest()
                 gid = cfg.shards[key2shard(args.key)]
@@ -1018,11 +1028,16 @@ class EngineShardKVService:
                     gid, args.op, args.key, args.value,
                     client_id=args.client_id, command_id=args.command_id,
                 )
-                if stages is not None and not stages.engine:
-                    # First submit closes the handler leg (routing +
-                    # config queries); re-routes stay in the engine leg.
-                    stages.engine = True
-                    stages.fold(self.m, "handler")
+                if stages is not None:
+                    if not stages.engine:
+                        # First submit closes the handler leg (routing
+                        # + config queries); re-routes stay in the
+                        # engine leg.
+                        stages.engine = True
+                        stages.fold(self.m, "handler")
+                    # Parked from here until a pump carries the
+                    # proposal (re-stamped per re-route).
+                    t_parked = time.perf_counter()
                 sub_deadline = min(
                     self.sched.now + self.RESUBMIT_S, deadline
                 )
@@ -1034,6 +1049,15 @@ class EngineShardKVService:
                     # Commit observed; the fsync gate below lands in
                     # the ack leg (folded at dispatch completion).
                     stages.fold(self.m, "engine")
+                    # Tail attribution: carrying tick + parked time
+                    # (getattr: stub handlers built via __new__ in
+                    # tests carry no pump state).
+                    stages.tick = getattr(self, "_pumps", -1)
+                    stages.pump_wait_s = max(
+                        0.0,
+                        getattr(self, "_pump_t_dispatch", 0.0)
+                        - t_parked,
+                    )
                 # Ack gates on the apply-time WAL record being fsynced
                 # (absent = pruned/duplicate = already durable).
                 while self._dur is not None:

@@ -112,6 +112,11 @@ PROF = 19        # code=cpu_busy_permille a=samples b=distinct_stacks
 #                  names what it was burning CPU on; code is process
 #                  CPU over wall for the window ×1000 — the doctor's
 #                  cpu_saturation vs queueing_collapse evidence)
+TAIL = 20        # code=dominant-wait code (TAIL_WAIT_CODES) a=total_us
+#                  b=dominant_wait_us c=engine_tick_id / tag=rid
+#                  (tail.py exemplar breadcrumb, written for over-SLO
+#                  and new-slowest completions: a SIGKILL'd process
+#                  still names its slowest request and where it waited)
 
 _TYPE_NAMES = {
     RPC_OUT: "rpc_out",
@@ -133,6 +138,7 @@ _TYPE_NAMES = {
     WEDGE: "wedge",
     CONFIG: "config",
     PROF: "prof",
+    TAIL: "tail",
 }
 
 # ChaosState fault kinds → compact codes for CHAOS records.
@@ -161,6 +167,16 @@ SANITIZE_KIND_CODES = {"lock_order": 1, "queue_bound": 2, "callback_budget": 3}
 #            engaged", distinct from queueing collapse.
 OVERLOAD_KIND_CODES = {"stage_p99": 1, "gauge": 2, "gauge_ctx": 3,
                        "brownout": 4}
+
+# Queue-wait vocabulary → compact codes for TAIL records (tail.py).
+# The four WAITS a request can park in, distinct from the work stages
+# (handler/engine CPU): wire = send→socket-readable→decode (chaos
+# delay/floor reschedules land here), dispatch = decode→dispatch,
+# pump = proposal submitted→its fused tick batch dispatched,
+# flush = reply queued→flushed to the socket.  The doctor names the
+# dominant wait back from the code.
+TAIL_WAIT_CODES = {"wire": 1, "dispatch": 2, "pump": 3, "flush": 4,
+                   "work": 5}
 
 
 def type_name(etype: int) -> str:

@@ -27,6 +27,12 @@ The service verbs:
   Drain-on-read like ``Obs.trace`` (pass ``{"reset": False}`` for a
   non-destructive peek); control-exempt like every Obs verb, so chaos
   cannot partition the profiler away.
+* ``Obs.tail``     — drain the process's tail-exemplar store
+  (tail.py): the per-request lifecycle records retained since the
+  previous scrape (over-SLO guaranteed + windowed top-k + reservoir).
+  Same drain-on-read / ``{"reset": False}`` contract as
+  ``Obs.profile``, and chaos-exempt for the same reason — the tail
+  microscope must stay readable during the overload it documents.
 
 Timestamps everywhere are ``time.perf_counter() * 1e6`` — the same
 clock the RPC spans and engine tick spans already use, so one process's
@@ -171,14 +177,30 @@ class StageClock:
     engine service folded handler/engine stages, so the dispatcher's
     completion fold knows whether it is closing ``ack`` (engine op) or
     ``handler`` (plain RPC).
+
+    Lifecycle capture (the tail microscope, tail.py): when the node's
+    tail plane is on, ``vec`` holds the request's own stage vector —
+    every fold lands in it as well as the histogram — and the engine
+    services deposit the pump-batch wait and engine tick id, so the
+    completed request carries its full stage+wait decomposition to the
+    tail store.  ``vec`` stays ``None`` with the tail plane off: the
+    pure-StageClock path allocates nothing extra.
     """
 
-    __slots__ = ("rid", "last", "engine")
+    __slots__ = ("rid", "last", "engine", "t0", "vec", "tick",
+                 "pump_wait_s", "ambient")
 
-    def __init__(self, rid: str, last: float) -> None:
+    def __init__(
+        self, rid: str, last: float, vec: Optional[Dict[str, float]] = None
+    ) -> None:
         self.rid = rid
         self.last = last
         self.engine = False
+        self.t0 = last
+        self.vec = vec
+        self.tick = -1
+        self.pump_wait_s = 0.0
+        self.ambient: Optional[Dict[str, Any]] = None
 
     def fold(
         self, metrics: Metrics, stage: str, now: Optional[float] = None
@@ -189,6 +211,8 @@ class StageClock:
         if dt < 0.0:
             dt = 0.0
         metrics.observe(f"stage.{stage}_s", dt)
+        if self.vec is not None:
+            self.vec[stage] = self.vec.get(stage, 0.0) + dt
         self.last = now
         return dt
 
@@ -459,6 +483,26 @@ class ObsControl:
             "profile": (
                 None if prof is None
                 else (prof.drain() if reset else prof.snapshot())
+            ),
+        }
+
+    def tail(self, args: Any = None) -> Dict[str, Any]:
+        """Drain the process's tail-exemplar store (tail.py) — the
+        per-request lifecycle records retained since the previous
+        scrape.  ``{"reset": False}`` peeks without draining (bundle
+        collection uses this: evidence gathering must not consume the
+        evidence).  ``tail`` is None when the plane is off
+        (MRT_TAIL=0 / MRT_STAGECLOCK=0) — an explicit marker, so a
+        fleet merge can tell "no slow requests" from "not looking"."""
+        reset = not (isinstance(args, dict) and args.get("reset") is False)
+        store = getattr(self._node, "tail", None)
+        return {
+            "name": self._node.obs.name,
+            "pid": os.getpid(),
+            "now_us": now_us(),
+            "tail": (
+                None if store is None
+                else (store.drain() if reset else store.snapshot())
             ),
         }
 

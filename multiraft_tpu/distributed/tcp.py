@@ -86,6 +86,7 @@ from .observe import (
 from .profile import maybe_start_profiler
 from .realtime import IoScheduler
 from .sanitize import get_sanitizer
+from .tail import TailStore, exemplar_from_clock, tail_enabled
 
 __all__ = ["RpcNode", "TcpClientEnd"]
 
@@ -207,11 +208,19 @@ class RpcNode:
         # for the overhead budget).
         self._stageclock = stageclock_enabled()
         self._cur_stages: Optional[StageClock] = None
-        # conn → reply-enqueue perf_counter stamps, strictly parallel
-        # to _outq (appended/shed/flushed/closed together), so the
-        # flush fold knows how long each reply coalesced.  LOOP THREAD
-        # ONLY, bounded by _REPLY_Q_CAP like its twin.
-        self._outq_stamps: Dict[int, List[float]] = {}
+        # conn → (reply-enqueue perf_counter stamp, StageClock|None)
+        # pairs, strictly parallel to _outq (appended/shed/flushed/
+        # closed together), so the flush fold knows how long each reply
+        # coalesced and can finalize the request's tail exemplar with
+        # its reply-queue age included.  LOOP THREAD ONLY, bounded by
+        # _REPLY_Q_CAP like its twin.
+        self._outq_stamps: Dict[int, List[Tuple[float, Optional[StageClock]]]] = {}
+        # Loop-thread breadcrumb carrying the finished request's
+        # StageClock from _done's reply() call into _reply's stamp
+        # append (reply() is synchronous on the loop thread; a chaos
+        # reply delay drops the breadcrumb, losing only that
+        # exemplar).
+        self._reply_st: Optional[StageClock] = None
         install_obs(self)
         # Continuous sampling profiler (profile.py): one per-process
         # daemon sampler shared by every node, default-on (MRT_PROFILE
@@ -222,6 +231,15 @@ class RpcNode:
         # (MRT_FLIGHTREC_DIR).  None = disabled = zero hot-path cost
         # beyond one `is None` check per frame.
         self._frec = flightrec.get_recorder(name=name or "")
+        # Tail microscope (tail.py): bounded per-request lifecycle
+        # exemplar store, drained fleet-wide via Obs.tail.  Rides on
+        # the stage-clock plane (no stamps → no lifecycle vector), so
+        # both MRT_STAGECLOCK=0 and MRT_TAIL=0 compile it out; None =
+        # off = no per-request dict, no offer.
+        self.tail: Optional[TailStore] = (
+            TailStore(frec=self._frec)
+            if (self._stageclock and tail_enabled()) else None
+        )
         # Runtime sanitizer (MRT_SANITIZE=1, sanitize.py): wraps this
         # node's and its transport's locks in order-recording proxies
         # (acyclicity asserted on every new edge) and checks the reply
@@ -586,6 +604,28 @@ class RpcNode:
             lane = lane_of(svc_meth, trace_id)
             hint = adm.admit(conn, lane)
             if hint is not None:
+                tl = self.tail
+                if tl is not None and type(trace_id) is tuple:
+                    # Shed requests bypass the stage clocks (nothing
+                    # downstream runs) but still belong in the tail
+                    # story: the exemplar records the admission outcome
+                    # and the two waits the request DID accrue before
+                    # being refused.  Stat histograms stay untouched —
+                    # sheds must not skew the stage percentiles.
+                    s_rid, s_t_send = trace_id
+                    now = time.perf_counter()
+                    tr = t_read if t_read is not None else now
+                    wire = max(0.0, tr - s_t_send)
+                    disp = max(0.0, now - tr)
+                    tl.offer({
+                        "rid": s_rid, "outcome": "shed", "tick": -1,
+                        "total_s": round(wire + disp, 6),
+                        "stages": {"wire": round(wire, 6),
+                                   "dispatch": round(disp, 6)},
+                        "waits": {"wire": round(wire, 6),
+                                  "dispatch": round(disp, 6),
+                                  "pump": 0.0, "flush": 0.0},
+                    })
                 self._shed(conn, req_id, hint)
                 return
         # Control replies bypass reply chaos (same exemption as the
@@ -606,7 +646,13 @@ class RpcNode:
             rid, t_send = trace_id
             trace_id = rid
             if self._stageclock:
-                st = StageClock(rid, t_send)
+                # The lifecycle vector dict exists only when the tail
+                # plane will read it — stage histograms alone need no
+                # per-request allocation.
+                st = StageClock(
+                    rid, t_send,
+                    vec={} if self.tail is not None else None,
+                )
                 st.fold(
                     obs.metrics, "wire",
                     t_read if t_read is not None else t0,
@@ -633,6 +679,12 @@ class RpcNode:
                 # this closes the ack leg (commit → reply enqueue);
                 # plain handlers close their whole body as handler.
                 st.fold(obs.metrics, "ack" if st.engine else "handler")
+                if st.vec is not None:
+                    # Ambient context rides on the exemplar: what the
+                    # process looked like the moment this request
+                    # finished (the exemplar is finalized — and the
+                    # reply-queue age folded — at flush).
+                    st.ambient = self._tail_ambient(conn_)
             if frec is not None and not is_control(svc_meth):
                 frec.record(
                     flightrec.RPC_HANDLE, a=int(dt * 1e6),
@@ -647,7 +699,10 @@ class RpcNode:
                 obs.tracer.span(
                     svc_meth, t0 * 1e6, dt * 1e6, track="rpc", **sargs
                 )
+            if st is not None and st.vec is not None:
+                self._reply_st = st
             reply(conn_, req_id_, value)
+            self._reply_st = None
             if c0 is not None:
                 # cpu.ack_s: completion bookkeeping + reply enqueue
                 # (the flush write itself lands in cpu.flush_s).
@@ -694,6 +749,32 @@ class RpcNode:
             )
         else:
             _done(conn, req_id, result)
+
+    def _tail_ambient(self, conn: int) -> Dict[str, Any]:
+        """Completion-time context for a tail exemplar (loop thread,
+        cheap attribute reads only): the queue depths and degradation
+        state a human asks about first when staring at an outlier —
+        was the process deep in replies, shedding, browned out, or
+        inside a chaos window when this request finished?"""
+        amb: Dict[str, Any] = {"replyq": len(self._outq.get(conn, ()))}
+        adm = self.admission
+        if adm is not None:
+            amb["inflight"] = adm.inflight_total()
+            amb["adm_level"] = adm.level
+        ow = getattr(self, "overload_watch", None)
+        if ow is not None:
+            amb["brownout"] = ow.brownout.state
+        ch = self.chaos
+        if ch is not None:
+            active = [
+                k for k in ("all_in", "all_out", "reply")
+                if getattr(ch, k, None) is not None
+            ]
+            if ch.peer_out:
+                active.append("peer_out")
+            if active:
+                amb["chaos"] = active
+        return amb
 
     def _shed(self, conn: int, req_id: int, retry_after_s: float) -> None:
         """Admission refused the request.  A busy-capable peer gets an
@@ -754,7 +835,7 @@ class RpcNode:
                 sq = self._outq_stamps.setdefault(conn, [])
                 if len(sq) >= len(q):
                     sq.pop(0)  # twin of the shed above
-                sq.append(time.perf_counter())  # graftlint: disable=unbounded-queue
+                sq.append((time.perf_counter(), self._reply_st))  # graftlint: disable=unbounded-queue
             if self._san is not None:
                 self._san.guard_queue("rpc.outq", len(q), _REPLY_Q_CAP)
             # Bulk blob replies (a firehose frame's results) gate a
@@ -806,10 +887,29 @@ class RpcNode:
             # Flush-stage fold: how long each reply coalesced between
             # enqueue and this vectored write (stat-only; folded even
             # for a failed send — the reply left the queue either way).
+            # Stamps carrying a StageClock fold through it instead, so
+            # the flush leg lands in the lifecycle vector too and the
+            # completed exemplar — total now closed t0→flush — goes to
+            # the tail store.
             t_flush = time.perf_counter()
+            tl = self.tail
             for stamps in stamps_by_conn.values():
-                for ts in stamps:
-                    m.observe("stage.flush_s", t_flush - ts)
+                for ts, st in stamps:
+                    if st is None:
+                        m.observe("stage.flush_s", t_flush - ts)
+                        continue
+                    st.fold(m, "flush", t_flush)
+                    if tl is not None:
+                        # Deferred build: the store decides from the
+                        # total alone whether this completion is kept;
+                        # dropped ones (saturation past the SLO cap)
+                        # never materialize their exemplar dicts.
+                        tl.offer_deferred(
+                            max(0.0, st.last - st.t0),
+                            lambda st=st: exemplar_from_clock(
+                                st, ambient=st.ambient
+                            ),
+                        )
         for conn, pairs in q.items():
             caps = self._peer_caps.get(conn)
             oob = caps is not None and "oob" in caps

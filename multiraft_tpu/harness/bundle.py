@@ -12,6 +12,9 @@ still fresh:
 * ``snapshots.json`` — final ``Obs.snapshot`` per process, with
   explicit ``{"missing": true}`` markers for the dead
   (:meth:`FleetObserver.snapshot_all`).
+* ``tails.json``     — per-process tail exemplars (``Obs.tail``,
+  peeked non-destructively), best-effort: the slowest requests each
+  survivor was holding, with full stage/wait vectors.
 * ``rings/``         — every ``flight-<pid>.ring`` from the flight
   recorder directory, copied byte-for-byte.  The rings are the only
   evidence that survives SIGKILL; copying them into the bundle pins
@@ -83,6 +86,23 @@ def collect_bundle(
         snaps = observer.snapshot_all()
         with open(os.path.join(out_dir, "snapshots.json"), "w") as f:
             json.dump(snaps, f, indent=2, sort_keys=True, default=str)
+
+        try:
+            # Tail exemplars, NON-destructively (reset=False): evidence
+            # collection must not consume the window a concurrent
+            # loadcurve scrape is about to drain.  Best-effort — a
+            # fleet with MRT_TAIL=0 just reports tail: null rows.
+            tails = observer.tail_all(reset=False)
+            if any(
+                isinstance(t, dict) and t.get("tail") is not None
+                for t in tails.values()
+            ):
+                with open(os.path.join(out_dir, "tails.json"), "w") as f:
+                    json.dump(
+                        tails, f, indent=2, sort_keys=True, default=str
+                    )
+        except Exception:
+            pass  # same contract as the timeline: rings are load-bearing
 
         try:
             tr = observer.merged_timeline(

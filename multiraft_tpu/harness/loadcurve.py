@@ -30,6 +30,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..distributed.profile import SERVING_THREAD_PREFIXES, top_functions
+from ..distributed.tail import dominant_wait, merge_drains
 from ..utils.metrics import Hist
 from .observe import FleetObserver
 
@@ -41,6 +42,7 @@ __all__ = [
     "gauge_peaks",
     "window_proc_cpu_s",
     "profile_window",
+    "tail_window",
     "find_knee",
     "max_sustainable",
     "run_sweep",
@@ -202,6 +204,54 @@ def profile_window(
     }
 
 
+def tail_window(
+    obs: FleetObserver,
+    p99_ms: Optional[float] = None,
+    keep: int = 8,
+) -> Optional[Dict[str, Any]]:
+    """Drain the fleet's tail-exemplar stores (``Obs.tail``) and fold
+    the window into the step's tail digest: retention counters, the
+    ``keep`` slowest exemplars verbatim (full stage + wait vectors —
+    the waterfall rows), and the dominant-wait attribution of the tail
+    slice.  The slice is every retained exemplar at/above the step's
+    client p99 when one is given (those ARE the p99+ requests), else
+    the ``keep`` slowest — so ``dominant`` answers "what did the p99
+    wait on this step".  ``None`` when no process runs the tail plane
+    (MRT_TAIL=0): absent, not zeros, so readers can tell "off" from
+    "quiet"."""
+    drains = [
+        (d or {}).get("tail") for d in obs.tail_all().values()
+    ]
+    if not any(isinstance(d, dict) for d in drains):
+        return None
+    merged = merge_drains(drains)
+    # slo + topk are both sorted slowest-first; the merged tail keeps
+    # the guaranteed outliers ahead of the windowed top-k.
+    retained = merged["slo"] + merged["topk"]
+    retained.sort(key=lambda e: -(e.get("total_s") or 0.0))
+    if p99_ms is not None:
+        cut = p99_ms / 1e3
+        tail_slice = [e for e in retained if (e.get("total_s") or 0.0) >= cut]
+    else:
+        tail_slice = []
+    if not tail_slice:
+        tail_slice = retained[:keep]
+    waits: Dict[str, int] = {}
+    for e in tail_slice:
+        w = dominant_wait(e)
+        waits[w] = waits.get(w, 0) + 1
+    return {
+        "seen": merged["seen"],
+        "over_slo": merged["over_slo"],
+        "dropped_slo": merged["dropped_slo"],
+        "exemplars": retained[:keep],
+        "dominant_waits": waits,
+        "dominant": (
+            max(waits.items(), key=lambda kv: kv[1])[0] if waits else None
+        ),
+    }
+
+
 def gauge_peaks(after: Dict[str, Dict[str, Any]]) -> Dict[str, float]:
     """Max of each queue gauge across the fleet at scrape time — the
     step's congestion witness next to its latency decomposition."""
@@ -283,6 +333,7 @@ def run_sweep(
     steps: List[Dict[str, Any]] = []
     before = scrape_hists(obs)
     obs.profile_all()  # drain: the ladder starts with a clean window
+    obs.tail_all()     # ditto for the tail-exemplar stores
     for rate in rates:
         res = dict(fire_step(float(rate)))
         after = scrape_hists(obs)
@@ -293,6 +344,9 @@ def run_sweep(
         res["cpu"] = cpu_stage_stats(win)
         res["gauges"] = gauge_peaks(after)
         res["proc_cpu_s"] = window_proc_cpu_s(before, after)
+        tails = tail_window(obs, p99_ms=res.get("client_p99_ms"))
+        if tails is not None:
+            res["tail"] = tails
         if flame_acc is not None:
             for k, v in prof.pop("flame").items():
                 flame_acc[k] = flame_acc.get(k, 0) + v
@@ -334,6 +388,7 @@ def build_loadcurve(
             "achieved_ops_per_sec": achieved,
             "client_p50_ms": [s.get("client_p50_ms") for s in steps],
             "client_p99_ms": p99s,
+            "client_p999_ms": [s.get("client_p999_ms") for s in steps],
         },
         "knee": knee,
         # Flat mirrors of the headline numbers, so the trajectory gate
@@ -344,6 +399,18 @@ def build_loadcurve(
         "p99_target_ms": p99_target_ms,
         "max_sustainable_ops_per_sec": sustainable,
     }
+    if knee_i is not None:
+        # Tail-microscope headline columns at the comparable operating
+        # point: the extreme tail (p99.9) at the knee, and which queue
+        # wait dominated the knee step's retained tail exemplars
+        # (tail.py attribution).  Absent in pre-tail rounds → n/a in
+        # the gate, never a regression.
+        p999 = steps[knee_i].get("client_p999_ms")
+        if p999 is not None:
+            out["p999_at_knee_ms"] = p999
+        dom = (steps[knee_i].get("tail") or {}).get("dominant")
+        if dom is not None:
+            out["tail_dominant_wait"] = dom
     # CPU-attribution headline columns (bench_compare --family cpu):
     # per-stage CPU-µs per acknowledged op at the KNEE step — the
     # comparable operating point — plus the profiler's top functions

@@ -150,6 +150,24 @@ class FleetObserver:
             f"{a[0]}:{a[1]}": self.profile(a, reset) for a in self.addrs
         }
 
+    def tail(
+        self, addr: Addr, reset: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """One process's tail-exemplar store (``Obs.tail``, tail.py).
+        Drain-on-read by default — same windowing discipline as
+        :meth:`profile`; ``reset=False`` peeks (bundle collection)."""
+        args = None if reset else {"reset": False}
+        return self.call(addr, "tail", args, timeout=5.0)
+
+    def tail_all(
+        self, reset: bool = True
+    ) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Scrape ``Obs.tail`` fleet-wide: ``{"host:port": reply}``,
+        ``None`` for unreachable processes."""
+        return {
+            f"{a[0]}:{a[1]}": self.tail(a, reset) for a in self.addrs
+        }
+
     @staticmethod
     def fleet_flame(
         dumps: Dict[str, Optional[Dict[str, Any]]],

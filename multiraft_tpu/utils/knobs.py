@@ -209,6 +209,23 @@ KNOBS: Tuple[Knob, ...] = (
     Knob("MRT_SHIP_WINDOW_S", "float", 5.0, "distributed.stateplane",
          "Snapshot shipment cadence; the bound on data loss when "
          "async shipping races a death."),
+    # -- distributed.tail ---------------------------------------------------
+    Knob("MRT_TAIL", "bool", True, "distributed.tail",
+         "Per-request lifecycle exemplars with tail-based sampling "
+         "(the Obs.tail plane); off removes the per-request record."),
+    Knob("MRT_TAIL_RESERVOIR", "int", 64, "distributed.tail",
+         "Reservoir size for NORMAL (under-SLO) request exemplars "
+         "kept per drain window."),
+    Knob("MRT_TAIL_SLO_CAP", "int", 4096, "distributed.tail",
+         "Hard bound on guaranteed over-SLO exemplars held between "
+         "drains; overflow is counted, not stored."),
+    Knob("MRT_TAIL_SLO_MS", "float", 250.0, "distributed.tail",
+         "Total-latency SLO in ms; every request over it is retained "
+         "verbatim (up to MRT_TAIL_SLO_CAP) until the next Obs.tail "
+         "drain and breadcrumbed into the flight ring."),
+    Knob("MRT_TAIL_TOPK", "int", 16, "distributed.tail",
+         "Windowed top-k: the k slowest requests since the last drain "
+         "are retained even when under the SLO."),
     # -- distributed.tcp ----------------------------------------------------
     Knob("MRT_DEBUG_RPC", "bool", False, "distributed.tcp",
          "Per-frame RPC debug logging on the wire path."),

@@ -93,6 +93,10 @@ FAMILIES: Dict[str, Dict[str, Any]] = {
             ("max_sustainable_ops_per_sec", "max sustainable ops/s", True),
             ("knee_ops_per_sec", "knee offered rate (ops/s)", True),
             ("p99_at_knee_ms", "p99 at knee (ms)", False),
+            # Tail-microscope columns (r05+; absent in earlier rounds →
+            # n/a, never a regression).
+            ("p999_at_knee_ms", "p99.9 at knee (ms)", False),
+            ("tail_dominant_wait", "dominant tail wait", False),
         ],
     },
     "placement": {
@@ -172,6 +176,17 @@ def _get(doc: Dict[str, Any], key: str) -> Optional[float]:
     return None
 
 
+# Informational string-valued columns: rendered in the table (the
+# trajectory of labels is the point — e.g. the dominant tail wait
+# migrating from "pump" to "wire" across rounds) but never gated.
+_STR_KEYS = {"tail_dominant_wait"}
+
+
+def _get_str(doc: Dict[str, Any], key: str) -> Optional[str]:
+    v = doc.get(key)
+    return v if isinstance(v, str) else None
+
+
 def _p99_at_rate(doc: Dict[str, Any], rate: float) -> Optional[float]:
     """Client p99 of the sweep step at exactly ``rate`` offered ops/s,
     from a loadcurve result's ``curve`` arrays (None if the round
@@ -216,6 +231,14 @@ def compare(
         + f" {'fresh':>10s} {'delta':>9s}"
     )
     for key, label, higher_better in fam["metrics"]:
+        if key in _STR_KEYS:
+            lines.append(
+                f"{label:28s} "
+                + " ".join(f"{(_get_str(doc, key) or 'n/a'):>10s}"
+                           for _, doc in history)
+                + f" {(_get_str(fresh, key) or 'n/a'):>10s} {'n/a':>9s}"
+            )
+            continue
         fv = _get(fresh, key)
         traj = [_get(doc, key) for _, doc in history]
         lv = _get(latest, key)

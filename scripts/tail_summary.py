@@ -1,0 +1,155 @@
+"""Render tail exemplars as per-stage waterfall tables.
+
+    python scripts/tail_summary.py <file> [-n N] [--step knee|IDX]
+
+``<file>`` is any artifact that carries tail exemplars:
+
+* a loadcurve round / sweep report (``LOADCURVE_r*.json`` or the
+  nightly's ``loadcurve.json``): each rate step's ``tail`` digest —
+  ``--step knee`` (default) renders the knee step, ``--step 3`` a
+  specific step, ``--step all`` every step;
+* a bundle's ``tails.json`` (per-process ``Obs.tail`` peeks);
+* a raw merged drain (``{"slo": [...], "topk": [...]}``).
+
+For each of the N slowest requests the waterfall shows where the time
+went, stage by stage, queue WAITS marked against work — the answer to
+"what did THIS p99.9 request wait on", next to the queue-depth context
+captured when it completed (reply-queue depth, admitted inflight,
+brownout state, active chaos)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from multiraft_tpu.distributed.tail import dominant_wait  # noqa: E402
+
+# Lifecycle order of the waterfall rows: (label, source dict, key).
+# Waits and work interleave in the order the request experiences them.
+_ROWS = (
+    ("wire", "waits"),
+    ("dispatch", "waits"),
+    ("handler", "work"),
+    ("pump", "waits"),
+    ("engine", "work"),
+    ("ack", "work"),
+    ("flush", "waits"),
+)
+_BAR_W = 24
+
+
+def _exemplars_from(doc: Any, step_sel: str) -> List[Dict[str, Any]]:
+    """Pull exemplar dicts out of whatever artifact shape we were
+    handed (see module docstring)."""
+    if isinstance(doc, dict) and "steps" in doc:
+        steps = doc["steps"]
+        if step_sel == "all":
+            chosen = list(range(len(steps)))
+        elif step_sel == "knee":
+            knee = doc.get("knee") or {}
+            i = knee.get("index")
+            chosen = [i] if isinstance(i, int) else [len(steps) - 1]
+        else:
+            chosen = [int(step_sel)]
+        out: List[Dict[str, Any]] = []
+        for i in chosen:
+            tail = (steps[i] or {}).get("tail") or {}
+            for ex in tail.get("exemplars") or []:
+                ex = dict(ex)
+                ex.setdefault("_where", f"step {i} "
+                              f"@{steps[i].get('offered_rate')} ops/s")
+                out.append(ex)
+        return out
+    if isinstance(doc, dict) and ("slo" in doc or "topk" in doc):
+        return list(doc.get("slo") or []) + list(doc.get("topk") or [])
+    if isinstance(doc, dict):
+        # tails.json: {"host:port": {"tail": {...}|null, ...}, ...}
+        out = []
+        for proc, reply in doc.items():
+            tail = (reply or {}).get("tail") if isinstance(reply, dict) \
+                else None
+            if not isinstance(tail, dict):
+                continue
+            for ex in (tail.get("slo") or []) + (tail.get("topk") or []):
+                ex = dict(ex)
+                ex.setdefault("_where", proc)
+                out.append(ex)
+        return out
+    return []
+
+
+def _fmt_ambient(amb: Dict[str, Any]) -> str:
+    parts = []
+    for k in ("replyq", "inflight", "adm_level", "brownout"):
+        if k in amb:
+            parts.append(f"{k} {amb[k]}")
+    if "chaos" in amb:
+        parts.append(f"chaos {','.join(amb['chaos'])}")
+    return "  ".join(parts)
+
+
+def render(ex: Dict[str, Any]) -> List[str]:
+    total = float(ex.get("total_s") or 0.0)
+    head = (
+        f"rid {ex.get('rid', '?')}  total {total * 1e3:.1f} ms"
+        f"  outcome {ex.get('outcome', '?')}"
+        f"  dominant wait: {dominant_wait(ex)}"
+    )
+    tick = ex.get("tick")
+    if isinstance(tick, int) and tick >= 0:
+        head += f"  tick {tick}"
+    if ex.get("_where"):
+        head += f"  [{ex['_where']}]"
+    lines = [head]
+    amb = ex.get("ambient")
+    if isinstance(amb, dict) and amb:
+        lines.append(f"  at completion: {_fmt_ambient(amb)}")
+    for name, src in _ROWS:
+        v = float((ex.get(src) or {}).get(name) or 0.0)
+        if v <= 0.0 and name not in (ex.get(src) or {}):
+            continue  # stage never reached (e.g. shed before handler)
+        frac = v / total if total > 0 else 0.0
+        bar = "#" * max(0, round(frac * _BAR_W))
+        lines.append(
+            f"  {name:<9}|{bar:<{_BAR_W}}| {v * 1e3:9.2f} ms"
+            f" {100 * frac:5.1f}%"
+            + ("  (wait)" if src == "waits" else "")
+        )
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tail_summary")
+    ap.add_argument("file", help="loadcurve round, tails.json, or drain")
+    ap.add_argument("-n", type=int, default=5,
+                    help="slowest N requests to render (default 5)")
+    ap.add_argument("--step", default="knee",
+                    help="loadcurve step: 'knee' (default), 'all', or "
+                         "an index")
+    ns = ap.parse_args(argv)
+
+    with open(ns.file) as f:
+        doc = json.load(f)
+    exemplars = _exemplars_from(doc, ns.step)
+    if not exemplars:
+        print("no tail exemplars in this artifact "
+              "(MRT_TAIL=0 fleet, or a pre-tail round)")
+        return 0
+    exemplars.sort(key=lambda e: -(e.get("total_s") or 0.0))
+    shown = exemplars[:ns.n]
+    print(f"{len(exemplars)} exemplar(s); slowest {len(shown)}:")
+    for ex in shown:
+        print()
+        for line in render(ex):
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,16 +122,44 @@ def _load_bench_compare():
     return mod
 
 
+# Tail-microscope columns landed in r05; earlier rounds legitimately
+# lack them (bench_compare reads them as n/a, never a regression).
+_TAIL_COLUMNS = {"p999_at_knee_ms", "tail_dominant_wait"}
+
+
 def test_loadcurve_round_has_family_columns():
     bc = _load_bench_compare()
     data = json.loads((REPO / "LOADCURVE_r03.json").read_text())
     for family in ("loadcurve", "cpu"):
         for key, _label, _higher in bc.FAMILIES[family]["metrics"]:
+            if key in _TAIL_COLUMNS:
+                continue
             assert key in data, (
                 f"LOADCURVE_r03.json lacks {family} column '{key}' — "
                 f"the scenario's emitted keys drifted from "
                 f"bench_compare.FAMILIES"
             )
+
+
+def test_loadcurve_tail_round_has_tail_columns():
+    """r05 is the tail-microscope round: its headline columns must
+    exist there (and the digest the summary renderer reads must ride
+    the knee step)."""
+    bc = _load_bench_compare()
+    path = REPO / "LOADCURVE_r05.json"
+    if not path.exists():
+        pytest.skip("LOADCURVE_r05.json not recorded yet")
+    data = json.loads(path.read_text())
+    for key in _TAIL_COLUMNS:
+        assert key in data, (
+            f"LOADCURVE_r05.json lacks tail column '{key}'"
+        )
+    knee_i = (data.get("knee") or {}).get("index")
+    assert isinstance(knee_i, int)
+    tail = data["steps"][knee_i].get("tail")
+    assert tail and tail.get("exemplars"), (
+        "knee step carries no tail exemplars"
+    )
 
 
 def test_placement_round_has_family_columns():

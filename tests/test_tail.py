@@ -1,0 +1,428 @@
+"""Tail-microscope tests: the bounded exemplar store's retention
+contracts (guaranteed over-SLO, windowed top-k, uniform reservoir,
+drain-on-read), the lifecycle capture end to end over live sockets
+(full stage + wait vectors, ambient context, engine tick attribution),
+the SIGKILL-surviving TAIL ring breadcrumb, and the slow_link chaos
+run whose slowest exemplar must blame the wire wait — with the
+postmortem doctor naming the covering nemesis window."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from multiraft_tpu.analysis import postmortem
+from multiraft_tpu.distributed import flightrec
+from multiraft_tpu.distributed.native import native_available
+from multiraft_tpu.distributed.observe import StageClock, now_us
+from multiraft_tpu.distributed.tail import (
+    WAITS,
+    TailStore,
+    dominant_wait,
+    exemplar_from_clock,
+    merge_drains,
+)
+from multiraft_tpu.utils.metrics import Metrics
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native transport did not build"
+)
+
+
+def _ex(rid: str, total_s: float, **waits) -> dict:
+    w = {k: 0.0 for k in WAITS}
+    w.update(waits)
+    return {"rid": rid, "total_s": total_s, "waits": w}
+
+
+# ---------------------------------------------------------------------------
+# TailStore retention contracts (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestTailStore:
+    def test_over_slo_guaranteed_up_to_cap_overflow_counted(self):
+        st = TailStore(slo_ms=10.0, reservoir=4, topk=2, slo_cap=3)
+        for i in range(5):
+            st.offer(_ex(f"slow.{i}", 0.020 + i * 1e-3))
+        v = st.snapshot()
+        assert v["over_slo"] == 5
+        assert [e["rid"] for e in v["slo"]] == ["slow.0", "slow.1",
+                                                "slow.2"]
+        assert v["dropped_slo"] == 2  # overflow never silent
+        assert v["seen"] == 5
+
+    def test_topk_keeps_window_slowest_normals(self):
+        st = TailStore(slo_ms=1000.0, reservoir=2, topk=3, slo_cap=8)
+        import random
+
+        totals = [i * 1e-3 for i in range(1, 21)]
+        random.Random(5).shuffle(totals)
+        for i, t in enumerate(totals):
+            st.offer(_ex(f"n.{i}", t))
+        v = st.snapshot()
+        assert v["over_slo"] == 0 and v["slo"] == []
+        # The three slowest of the window, slowest first.
+        assert [e["total_s"] for e in v["topk"]] == pytest.approx(
+            [0.020, 0.019, 0.018]
+        )
+
+    def test_reservoir_is_bounded_and_samples_everyone(self):
+        st = TailStore(slo_ms=1000.0, reservoir=8, topk=2, slo_cap=2)
+        for i in range(1000):
+            st.offer(_ex(f"r.{i}", 1e-3))
+        v = st.snapshot()
+        assert len(v["reservoir"]) == 8
+        assert v["seen"] == v["seen_total"] == 1000
+        # Replacement actually happened: not just the first 8 offers.
+        assert any(
+            int(e["rid"].split(".")[1]) >= 8 for e in v["reservoir"]
+        )
+
+    def test_drain_resets_window_snapshot_does_not(self):
+        st = TailStore(slo_ms=10.0, reservoir=4, topk=2, slo_cap=4)
+        st.offer(_ex("a", 0.5))
+        st.offer(_ex("b", 0.001))
+        assert st.snapshot()["seen"] == 2  # peek...
+        assert st.snapshot()["seen"] == 2  # ...is repeatable
+        d = st.drain()
+        assert d["seen"] == 2 and len(d["slo"]) == 1
+        v = st.snapshot()
+        assert v["seen"] == 0 and v["slo"] == [] and v["topk"] == []
+        assert v["seen_total"] == 2  # lifetime counter survives drains
+
+    def test_breadcrumbs_on_over_slo_and_new_slowest(self):
+        class FakeRec:
+            def __init__(self):
+                self.recs = []
+
+            def record(self, etype, code=0, a=0, b=0, c=0, tag=""):
+                self.recs.append((etype, code, a, b, c, tag))
+
+        fr = FakeRec()
+        st = TailStore(slo_ms=100.0, reservoir=4, topk=2, slo_cap=4,
+                       frec=fr)
+        st.offer(_ex("first", 0.001, wire=0.001))   # new slowest
+        st.offer(_ex("faster", 0.0005))             # neither → no crumb
+        st.offer(_ex("worst", 0.4, dispatch=0.3))   # over SLO
+        assert [r[5] for r in fr.recs] == ["first", "worst"]
+        etype, code, a, b, c, tag = fr.recs[-1]
+        assert etype == flightrec.TAIL
+        assert code == flightrec.TAIL_WAIT_CODES["dispatch"]
+        assert a == 400000 and b == 300000  # µs
+        # Past the SLO cap, over-SLO offers that are NOT retained ring
+        # only when they set a new window slowest — saturation must
+        # not turn every completion into a ring write.
+        st2 = TailStore(slo_ms=1.0, reservoir=2, topk=2, slo_cap=2,
+                        frec=fr)
+        n0 = len(fr.recs)
+        st2.offer(_ex("o1", 0.10, wire=0.1))   # stored + slowest
+        st2.offer(_ex("o2", 0.09, wire=0.09))  # stored
+        st2.offer(_ex("o3", 0.08, wire=0.08))  # capped, not slowest
+        st2.offer(_ex("o4", 0.20, wire=0.2))   # capped BUT new slowest
+        assert [r[5] for r in fr.recs[n0:]] == ["o1", "o2", "o4"]
+
+    def test_offer_deferred_skips_builds_for_dropped_offers(self):
+        st = TailStore(slo_ms=10.0, reservoir=0, topk=1, slo_cap=2)
+        builds = [0]
+
+        def offer(rid, total):
+            def build():
+                builds[0] += 1
+                return _ex(rid, total)
+            st.offer_deferred(total, build)
+
+        offer("a", 0.5)   # stored (and new slowest)
+        offer("b", 0.4)   # stored
+        b2 = builds[0]
+        for i in range(100):  # saturation: over-SLO, capped, not slowest
+            offer(f"c{i}", 0.3)
+        assert builds[0] == b2  # none materialized
+        offer("d", 0.9)   # capped but new slowest -> built for the ring
+        v = st.snapshot()
+        assert v["over_slo"] == 103 and v["dropped_slo"] == 101
+        assert [e["rid"] for e in v["slo"]] == ["a", "b"]
+        # Fast normals past a full top-1 with no reservoir: no builds.
+        offer("n1", 0.002)  # fills top-1
+        b3 = builds[0]
+        offer("n2", 0.001)
+        assert builds[0] == b3
+
+    def test_dominant_wait_and_work_fallback(self):
+        assert dominant_wait(_ex("x", 1.0, pump=0.9, wire=0.1)) == "pump"
+        assert dominant_wait({"rid": "y", "total_s": 1.0}) == "work"
+
+    def test_merge_drains_sums_and_sorts(self):
+        a = {"seen": 2, "over_slo": 1, "dropped_slo": 0,
+             "slo": [_ex("a1", 0.3)], "topk": [_ex("a2", 0.01)],
+             "reservoir": [_ex("a3", 0.005)]}
+        b = {"seen": 3, "over_slo": 2, "dropped_slo": 1,
+             "slo": [_ex("b1", 0.5), _ex("b2", 0.28)],
+             "topk": [], "reservoir": []}
+        m = merge_drains([a, None, b])
+        assert m["seen"] == 5 and m["over_slo"] == 3
+        assert m["dropped_slo"] == 1
+        assert [e["rid"] for e in m["slo"]] == ["b1", "a1", "b2"]
+
+    def test_exemplar_from_clock_partitions_pump_from_engine(self):
+        m = Metrics()
+        st = StageClock("rid.1", 0.0, vec={})
+        st.fold(m, "wire", 0.010)
+        st.fold(m, "dispatch", 0.011)
+        st.fold(m, "handler", 0.012)
+        st.engine = True
+        st.fold(m, "engine", 0.112)  # 100 ms engine leg...
+        st.pump_wait_s = 0.080       # ...80 of them parked pre-tick
+        st.tick = 42
+        st.fold(m, "ack", 0.113)
+        st.fold(m, "flush", 0.118)
+        ex = exemplar_from_clock(st, ambient={"replyq": 2})
+        assert ex["tick"] == 42
+        assert ex["waits"]["pump"] == pytest.approx(0.080)
+        assert ex["work"]["engine"] == pytest.approx(0.020)
+        assert ex["waits"]["flush"] == pytest.approx(0.005)
+        assert ex["total_s"] == pytest.approx(0.118)
+        assert ex["ambient"] == {"replyq": 2}
+        # waits + work partition the lifecycle (no double counting).
+        parts = sum(ex["waits"].values()) + sum(ex["work"].values())
+        assert parts == pytest.approx(ex["total_s"])
+        assert dominant_wait(ex) == "pump"
+
+
+# ---------------------------------------------------------------------------
+# Obs.tail over live sockets
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    def ping(self, k):
+        if isinstance(k, int) and k == 7:
+            time.sleep(0.3)  # over the default 250 ms SLO
+        return ("pong", k)
+
+
+@needs_native
+@pytest.mark.timeout_s(60)
+def test_obs_tail_guaranteed_exemplar_over_socket():
+    """A request breaching the SLO must come back from the Obs.tail
+    drain with its full stage + wait vector; drain-on-read resets the
+    window; {"reset": false} peeks."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    server = RpcNode(listen=True)
+    if server.tail is None:
+        server.close()
+        pytest.skip("tail plane off (MRT_TAIL=0 or MRT_STAGECLOCK=0)")
+    server.add_service("Echo", _Echo())
+    client = RpcNode()
+    obs = None
+    try:
+        end = client.client_end(server.host, server.port)
+        for k in range(20):
+            got = client.sched.wait(
+                end.call("Echo.ping", k, trace=f"tt.{k}"), 5.0
+            )
+            assert got == ("pong", k)
+        obs = FleetObserver([(server.host, server.port)])
+        key = f"{server.host}:{server.port}"
+
+        peek = obs.tail(obs.addrs[0], reset=False)
+        t = peek["tail"]
+        assert t is not None and t["seen"] == 20
+        assert t["over_slo"] == 1 and len(t["slo"]) == 1
+        ex = t["slo"][0]
+        assert ex["rid"] == "tt.7" and ex["outcome"] == "ok"
+        assert ex["total_s"] >= 0.3
+        assert set(WAITS) <= set(ex["waits"])
+        for stage in ("wire", "dispatch", "handler", "flush"):
+            assert stage in ex["stages"]
+        # A sleeping handler, not a queue: the work side carries it.
+        assert ex["work"]["handler"] >= 0.29
+        assert "replyq" in ex["ambient"]
+        # Normals rode along: top-k + reservoir populated.
+        assert t["topk"] and t["reservoir"]
+
+        d = obs.tail_all()[key]["tail"]
+        assert d["seen"] == 20  # the peek did not consume the window
+        d2 = obs.tail_all()[key]["tail"]
+        assert d2["seen"] == 0 and d2["slo"] == []  # drained
+    finally:
+        if obs is not None:
+            obs.close()
+        client.close()
+        server.close()
+
+
+@needs_native
+@pytest.mark.timeout_s(240)
+def test_engine_exemplars_carry_tick_and_ring_survives_sigkill(
+    tmp_path, monkeypatch,
+):
+    """Against a real served engine: every over-SLO write drained via
+    Obs.tail carries the full wait vector AND the fused-tick id that
+    committed it; after SIGKILL the ring still holds TAIL breadcrumbs
+    naming the slowest request."""
+    from multiraft_tpu.distributed.engine_cluster import (
+        EngineProcessCluster,
+    )
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    frec_dir = tmp_path / "frec"
+    frec_dir.mkdir()
+    monkeypatch.setenv("MRT_FLIGHTREC_DIR", str(frec_dir))
+    # Every engine write breaches a 1 ms SLO: the guarantee under test.
+    monkeypatch.setenv("MRT_TAIL_SLO_MS", "1.0")
+
+    cluster = EngineProcessCluster(
+        kind="engine_kv", groups=8, seed=3,
+        data_dir=str(tmp_path / "data"),
+    )
+    obs = None
+    n_ops = 10
+    try:
+        cluster.start()
+        server_pid = cluster.proc.pid
+        addr = (cluster.host, cluster.port)
+        obs = FleetObserver([addr])
+        ck = cluster.clerk()
+        try:
+            for i in range(n_ops):
+                ck.append("tailbox", f"({i})")
+        finally:
+            ck.close()
+
+        reply = obs.tail(addr)
+        t = reply["tail"]
+        assert t is not None, "tail plane off in the served engine"
+        writes = [e for e in t["slo"] if e.get("tick", -1) >= 1]
+        assert len(writes) >= n_ops, (
+            f"expected >= {n_ops} over-SLO write exemplars with tick "
+            f"ids, got {len(writes)} of {len(t['slo'])}"
+        )
+        for ex in writes:
+            assert ex["rid"]
+            assert set(WAITS) <= set(ex["waits"])
+            assert {"handler", "engine", "ack"} <= set(ex["work"])
+            assert ex["stages"].get("engine", 0.0) > 0.0
+            assert ex["total_s"] > 1e-3
+
+        cluster.kill()  # SIGKILL, no flush
+
+        rr = flightrec.read_ring(
+            str(frec_dir / f"flight-{server_pid}.ring")
+        )
+        tails = [r for r in rr["records"]
+                 if r["type"] == flightrec.TAIL]
+        assert tails, "no TAIL breadcrumbs in the ring after SIGKILL"
+        slow = max(tails, key=lambda r: r["a"])
+        assert slow["tag"], "TAIL breadcrumb lost its rid"
+        assert slow["a"] > 1000  # µs, over the 1 ms SLO
+        assert slow["code"] in flightrec.TAIL_WAIT_CODES.values()
+    finally:
+        if obs is not None:
+            obs.close()
+        cluster.shutdown()
+
+
+@needs_native
+@pytest.mark.timeout_s(120)
+def test_slow_link_dominates_wire_and_doctor_names_the_window(
+    tmp_path, monkeypatch, capsys,
+):
+    """Seeded chaos: a slow_link latency floor on the server's inbound
+    path.  The slowest exemplar's dominant wait must be the wire stage
+    (the chaos delay lands between client send and dispatch), and the
+    postmortem doctor's tail_outlier anomaly must name the covering
+    nemesis window."""
+    from multiraft_tpu.distributed.tcp import RpcNode
+    from multiraft_tpu.harness.nemesis import ChaosClient
+    from multiraft_tpu.harness.observe import FleetObserver
+
+    frec_dir = tmp_path / "frec"
+    frec_dir.mkdir()
+    monkeypatch.setenv("MRT_FLIGHTREC_DIR", str(frec_dir))
+    # The test-process recorder singleton may have resolved earlier
+    # (disabled); re-resolve under this env, restore after.
+    old_rec = flightrec._proc_rec
+    flightrec._proc_rec = None
+
+    server = obs = ctl = client = None
+    try:
+        from multiraft_tpu.distributed.chaos import install_chaos
+
+        server = RpcNode(listen=True)
+        if server.tail is None:
+            pytest.skip("tail plane off")
+        server.add_service("Echo", _Echo())
+        install_chaos(server, seed=9)
+        client = RpcNode()
+        addr = (server.host, server.port)
+        key = f"{addr[0]}:{addr[1]}"
+        end = client.client_end(*addr)
+        assert client.sched.wait(
+            end.call("Echo.ping", "warm", trace="sl.warm"), 5.0
+        ) == ("pong", "warm")
+
+        ctl = ChaosClient([addr])
+        t_start = now_us()
+        ctl.set_rules(addr, {"all_in": {"floor": 0.35}})
+        for i in range(3):
+            got = client.sched.wait(
+                end.call("Echo.ping", f"s{i}", trace=f"sl.{i}"), 10.0
+            )
+            assert got == ("pong", f"s{i}")
+        ctl.clear(addr)
+        windows = [{
+            "kind": "slow_link", "procs": [key],
+            "t_start_us": t_start, "t_stop_us": now_us(),
+        }]
+
+        obs = FleetObserver([addr])
+        t = obs.tail(addr)["tail"]
+        assert t["over_slo"] >= 3
+        retained = sorted(
+            t["slo"], key=lambda e: -(e.get("total_s") or 0.0)
+        )
+        slowest = retained[0]
+        assert slowest["total_s"] >= 0.35
+        assert dominant_wait(slowest) == "wire", slowest
+        assert slowest["stages"]["wire"] >= 0.3
+
+        # The ring carries the breadcrumbs; the doctor turns the
+        # slowest into a tail_outlier anomaly naming the window.
+        server._frec.flush()
+        bdir = tmp_path / "bundle"
+        rings = bdir / "rings"
+        rings.mkdir(parents=True)
+        ring_name = f"flight-{os.getpid()}.ring"
+        (rings / ring_name).write_bytes(
+            (frec_dir / ring_name).read_bytes()
+        )
+        (bdir / "windows.json").write_text(json.dumps(windows))
+        bundle = postmortem.load_bundle(str(bdir))
+        analysis = postmortem.analyze(bundle)
+        outliers = [a for a in analysis["anomalies"]
+                    if a["kind"] == "tail_outlier"]
+        assert outliers, analysis["anomalies"]
+        detail = outliers[0]["detail"]
+        assert "'wire' wait" in detail
+        assert "fault window 'slow_link'" in detail
+        assert key in detail
+        report = postmortem.build_report(bundle, analysis)
+        assert "tail:" in report
+    finally:
+        if obs is not None:
+            obs.close()
+        if ctl is not None:
+            ctl.close()
+        if client is not None:
+            client.close()
+        if server is not None:
+            server.close()
+        if flightrec._proc_rec is not None:
+            flightrec._proc_rec.close()
+        flightrec._proc_rec = old_rec
